@@ -1,0 +1,13 @@
+"""Test bootstrap: force JAX onto CPU with 8 virtual devices BEFORE jax
+is imported anywhere, so sharding tests exercise real multi-device meshes
+without TPU hardware (SURVEY.md §4 item 4)."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("VDT_PLATFORM", "cpu")

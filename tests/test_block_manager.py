@@ -1,0 +1,77 @@
+import pytest
+
+from vllm_distributed_tpu.engine.block_manager import (
+    NoFreePagesError,
+    PageAllocator,
+)
+from vllm_distributed_tpu.engine.request import Request
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+def make_req(rid="r0", prompt_len=10):
+    return Request(
+        request_id=rid,
+        prompt_token_ids=list(range(prompt_len)),
+        sampling_params=SamplingParams(),
+    )
+
+
+def test_allocate_and_free():
+    alloc = PageAllocator(num_pages=8, page_size=4)
+    # Page 0 reserved for padding.
+    assert alloc.num_free_pages == 7
+    req = make_req(prompt_len=10)
+    new = alloc.allocate(req, 10)  # 10 tokens -> 3 pages
+    assert len(new) == 3
+    assert req.page_ids == new
+    assert 0 not in new
+    assert alloc.num_free_pages == 4
+    alloc.free(req)
+    assert alloc.num_free_pages == 7
+    assert req.page_ids == []
+
+
+def test_incremental_allocation():
+    alloc = PageAllocator(num_pages=8, page_size=4)
+    req = make_req(prompt_len=4)
+    first = alloc.allocate(req, 4)
+    assert len(first) == 1
+    req.num_computed_tokens = 4
+    # Next token needs a new page.
+    second = alloc.allocate(req, 1)
+    assert len(second) == 1
+    req.num_computed_tokens = 5
+    # Tokens 5..7 fit in the same page.
+    third = alloc.allocate(req, 3)
+    assert third == []
+
+
+def test_exhaustion_and_rollback():
+    alloc = PageAllocator(num_pages=4, page_size=4)  # 3 usable
+    r1 = make_req("r1", 8)
+    alloc.allocate(r1, 8)  # 2 pages
+    r2 = make_req("r2", 12)
+    with pytest.raises(NoFreePagesError):
+        alloc.allocate(r2, 12)  # needs 3, only 1 free -> rollback
+    assert alloc.num_free_pages == 1
+    assert alloc.get_page_ids("r2") in ([], None) or alloc.get_page_ids("r2") == []
+
+
+def test_slot_for_token():
+    alloc = PageAllocator(num_pages=8, page_size=4)
+    req = make_req(prompt_len=10)
+    alloc.allocate(req, 10)
+    p = req.page_ids
+    assert alloc.slot_for_token(req, 0) == p[0] * 4
+    assert alloc.slot_for_token(req, 5) == p[1] * 4 + 1
+    assert alloc.slot_for_token(req, 9) == p[2] * 4 + 1
+
+
+def test_can_allocate():
+    alloc = PageAllocator(num_pages=4, page_size=4)
+    r1 = make_req("r1", 8)
+    assert alloc.can_allocate(r1, 8)
+    alloc.allocate(r1, 8)
+    r2 = make_req("r2", 8)
+    assert not alloc.can_allocate(r2, 8)
+    assert alloc.can_allocate(r2, 4)

@@ -1,0 +1,14 @@
+"""vllm-distributed-tpu: a TPU-native distributed LLM serving framework.
+
+A from-scratch reimplementation of the capability surface of
+koush/vllm-distributed (multi-node launcher + the vLLM engine it drives),
+designed TPU-first: JAX/XLA for the compute path, Pallas kernels for paged
+attention, pjit/NamedSharding over a device mesh for TP/DP/EP, XLA
+collectives over ICI/DCN for the data plane, and an asyncio RPC control
+plane over the host network (reference: /root/reference/src/launch.py,
+rpc.py, rpc_reader.py).
+"""
+
+from vllm_distributed_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,383 @@
+"""Engine configuration.
+
+Capability parity with the config surface the reference drives
+(SURVEY.md §2.3): ``AsyncEngineArgs.from_cli_args`` (launch.py:29,399) →
+``EngineArgs.from_cli_args`` here; vLLM's VllmConfig with model / cache /
+parallel / scheduler sub-configs → ``EngineConfig``.  The
+``distributed_executor_backend`` field is pluggable with an executor class,
+which is exactly how the reference injects its CustomExecutor
+(launch.py:400-405).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+_STR_DTYPE_TO_JAX = {
+    "float32": "float32",
+    "fp32": "float32",
+    "bfloat16": "bfloat16",
+    "bf16": "bfloat16",
+    "float16": "bfloat16",  # TPUs have no fp16 MXU path; promote to bf16.
+    "half": "bfloat16",
+}
+
+
+def _load_hf_config(model: str, trust_remote_code: bool = False):
+    """Load a HuggingFace config.json for `model` (local dir or hub id)."""
+    from transformers import AutoConfig
+
+    return AutoConfig.from_pretrained(model, trust_remote_code=trust_remote_code)
+
+
+@dataclass
+class ModelConfig:
+    model: str
+    tokenizer: str | None = None
+    dtype: str = "auto"
+    seed: int = 0
+    max_model_len: int | None = None
+    trust_remote_code: bool = False
+    hf_config: Any = None  # transformers PretrainedConfig, loaded lazily
+    quantization: str | None = None
+
+    def __post_init__(self) -> None:
+        if self.tokenizer is None:
+            self.tokenizer = self.model
+        if self.hf_config is None:
+            self.hf_config = _load_hf_config(self.model, self.trust_remote_code)
+        if self.dtype == "auto":
+            torch_dtype = getattr(self.hf_config, "torch_dtype", None)
+            name = str(torch_dtype).replace("torch.", "") if torch_dtype else "bfloat16"
+            self.dtype = _STR_DTYPE_TO_JAX.get(name, "bfloat16")
+        else:
+            self.dtype = _STR_DTYPE_TO_JAX[self.dtype]
+        derived_max = getattr(self.hf_config, "max_position_embeddings", 2048)
+        if self.max_model_len is None:
+            self.max_model_len = derived_max
+        elif self.max_model_len > derived_max and not _supports_rope_scaling(
+            self.hf_config
+        ):
+            logger.warning(
+                "max_model_len %d exceeds the model's max_position_embeddings %d",
+                self.max_model_len,
+                derived_max,
+            )
+
+    # --- architecture helpers used by the engine/runner ---
+    @property
+    def architecture(self) -> str:
+        archs = getattr(self.hf_config, "architectures", None) or []
+        return archs[0] if archs else self.hf_config.model_type
+
+    def get_num_layers(self) -> int:
+        return getattr(
+            self.hf_config,
+            "num_hidden_layers",
+            getattr(self.hf_config, "n_layer", None),
+        )
+
+    def get_hidden_size(self) -> int:
+        return getattr(
+            self.hf_config, "hidden_size", getattr(self.hf_config, "n_embd", None)
+        )
+
+    def get_num_attention_heads(self) -> int:
+        return getattr(
+            self.hf_config,
+            "num_attention_heads",
+            getattr(self.hf_config, "n_head", None),
+        )
+
+    def get_num_kv_heads(self) -> int:
+        return getattr(
+            self.hf_config, "num_key_value_heads", self.get_num_attention_heads()
+        )
+
+    def get_head_dim(self) -> int:
+        head_dim = getattr(self.hf_config, "head_dim", None)
+        if head_dim is not None:
+            return head_dim
+        return self.get_hidden_size() // self.get_num_attention_heads()
+
+    def get_vocab_size(self) -> int:
+        return self.hf_config.vocab_size
+
+
+def _supports_rope_scaling(hf_config: Any) -> bool:
+    return getattr(hf_config, "rope_scaling", None) is not None
+
+
+@dataclass
+class CacheConfig:
+    """Paged KV cache configuration.
+
+    `page_size` is tokens per page.  `num_pages` may be given explicitly
+    (tests, CPU) or derived from free HBM at engine init
+    (hbm_utilization, the analog of gpu_memory_utilization).
+    """
+
+    page_size: int = 16
+    num_pages: int | None = None
+    hbm_utilization: float = 0.9
+    cache_dtype: str = "auto"  # "auto" follows model dtype
+
+    def __post_init__(self) -> None:
+        if self.page_size & (self.page_size - 1):
+            raise ValueError(f"page_size must be a power of 2, got {self.page_size}")
+
+
+@dataclass
+class ParallelConfig:
+    """Parallelism layout.
+
+    The reference asserts world == tp × pp (launch.py:85-92).  Here the
+    world is a JAX mesh with named axes; TP/EP/DP are sharding annotations
+    over it (SURVEY.md §7 design stance), and world_size counts chips.
+    """
+
+    tensor_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    data_parallel_size: int = 1
+    expert_parallel_size: int = 1
+    enable_expert_parallel: bool = False
+    # Pluggable executor class or name — the injection point the reference
+    # uses for CustomExecutor (launch.py:400-405).
+    distributed_executor_backend: Any = None
+    # Multi-host topology
+    num_hosts: int = 1
+    host_id: int = 0
+    coordinator_address: str | None = None
+
+    @property
+    def world_size(self) -> int:
+        return (
+            self.tensor_parallel_size
+            * self.pipeline_parallel_size
+            * self.data_parallel_size
+        )
+
+    def __post_init__(self) -> None:
+        if self.enable_expert_parallel and self.expert_parallel_size == 1:
+            self.expert_parallel_size = self.tensor_parallel_size
+
+
+@dataclass
+class SchedulerConfig:
+    max_num_seqs: int = 64
+    max_num_batched_tokens: int = 2048
+    enable_chunked_prefill: bool = True
+    max_model_len: int = 2048
+
+    def __post_init__(self) -> None:
+        if self.max_num_batched_tokens < self.max_num_seqs:
+            raise ValueError(
+                "max_num_batched_tokens must be >= max_num_seqs "
+                f"({self.max_num_batched_tokens} < {self.max_num_seqs})"
+            )
+
+
+@dataclass
+class DeviceConfig:
+    # "auto" picks tpu if available else cpu.
+    device: str = "auto"
+
+    def resolved(self) -> str:
+        if self.device != "auto":
+            return self.device
+        import jax
+
+        platform = jax.default_backend()
+        return "cpu" if platform == "cpu" else "tpu"
+
+
+@dataclass
+class ObservabilityConfig:
+    collect_metrics: bool = True
+    profile_dir: str | None = None
+
+
+@dataclass
+class EngineConfig:
+    """Bundle of all sub-configs (the analog of vllm_config, which the
+    reference passes whole to workers at launch.py:162, 238, 284)."""
+
+    model_config: ModelConfig
+    cache_config: CacheConfig
+    parallel_config: ParallelConfig
+    scheduler_config: SchedulerConfig
+    device_config: DeviceConfig
+    observability_config: ObservabilityConfig = field(
+        default_factory=ObservabilityConfig
+    )
+    # KV transfer / disaggregated-prefill hook (SURVEY.md §3.4); None = off.
+    kv_transfer_config: Any = None
+
+    def to_json(self) -> str:
+        def _default(o):
+            if dataclasses.is_dataclass(o):
+                return dataclasses.asdict(o)
+            return str(o)
+
+        d = {
+            k: v
+            for k, v in dataclasses.asdict(self).items()
+            if k not in ("model_config",)
+        }
+        d["model"] = self.model_config.model
+        return json.dumps(d, default=_default)
+
+
+@dataclass
+class EngineArgs:
+    """CLI-buildable engine args (parity: AsyncEngineArgs.from_cli_args,
+    launch.py:29, 399)."""
+
+    model: str = "facebook/opt-125m"
+    tokenizer: str | None = None
+    dtype: str = "auto"
+    seed: int = 0
+    max_model_len: int | None = None
+    trust_remote_code: bool = False
+    quantization: str | None = None
+
+    page_size: int = 16
+    num_kv_pages: int | None = None
+    # None -> resolved late from VDT_HBM_UTILIZATION (default 0.9), so the
+    # env var works on both the CLI and the programmatic path.
+    hbm_utilization: float | None = None
+    kv_cache_dtype: str = "auto"
+
+    tensor_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    data_parallel_size: int = 1
+    enable_expert_parallel: bool = False
+    distributed_executor_backend: Any = None
+    num_hosts: int = 1
+    host_id: int = 0
+    coordinator_address: str | None = None
+
+    max_num_seqs: int = 64
+    max_num_batched_tokens: int | None = None
+    enable_chunked_prefill: bool = True
+
+    device: str = "auto"
+    profile_dir: str | None = None
+    disable_log_stats: bool = False
+
+    @staticmethod
+    def add_cli_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+        parser.add_argument("--model", type=str, default=EngineArgs.model)
+        parser.add_argument("--tokenizer", type=str, default=None)
+        parser.add_argument(
+            "--dtype",
+            type=str,
+            default="auto",
+            choices=["auto", *sorted(_STR_DTYPE_TO_JAX)],
+        )
+        parser.add_argument("--seed", type=int, default=0)
+        parser.add_argument("--max-model-len", type=int, default=None)
+        parser.add_argument("--trust-remote-code", action="store_true")
+        parser.add_argument("--quantization", "-q", type=str, default=None)
+        parser.add_argument("--page-size", "--block-size", type=int, default=16)
+        parser.add_argument("--num-kv-pages", type=int, default=None)
+        parser.add_argument(
+            "--hbm-utilization",
+            "--gpu-memory-utilization",
+            type=float,
+            default=None,
+            help="fraction of free HBM given to the KV cache "
+            "(default: $VDT_HBM_UTILIZATION or 0.9)",
+        )
+        parser.add_argument("--kv-cache-dtype", type=str, default="auto")
+        parser.add_argument(
+            "--tensor-parallel-size", "-tp", type=int, default=1
+        )
+        parser.add_argument(
+            "--pipeline-parallel-size", "-pp", type=int, default=1
+        )
+        parser.add_argument("--data-parallel-size", "-dp", type=int, default=1)
+        parser.add_argument("--enable-expert-parallel", action="store_true")
+        parser.add_argument(
+            "--distributed-executor-backend", type=str, default=None
+        )
+        parser.add_argument("--num-hosts", type=int, default=1)
+        parser.add_argument("--host-id", type=int, default=0)
+        parser.add_argument("--coordinator-address", type=str, default=None)
+        parser.add_argument("--max-num-seqs", type=int, default=64)
+        parser.add_argument("--max-num-batched-tokens", type=int, default=None)
+        parser.add_argument(
+            "--no-enable-chunked-prefill",
+            dest="enable_chunked_prefill",
+            action="store_false",
+        )
+        parser.add_argument("--device", type=str, default="auto")
+        parser.add_argument("--profile-dir", type=str, default=None)
+        parser.add_argument("--disable-log-stats", action="store_true")
+        return parser
+
+    @classmethod
+    def from_cli_args(cls, args: argparse.Namespace) -> "EngineArgs":
+        attrs = [f.name for f in dataclasses.fields(cls)]
+        return cls(
+            **{a: getattr(args, a) for a in attrs if hasattr(args, a)}
+        )
+
+    def create_engine_config(self) -> EngineConfig:
+        model_config = ModelConfig(
+            model=self.model,
+            tokenizer=self.tokenizer,
+            dtype=self.dtype,
+            seed=self.seed,
+            max_model_len=self.max_model_len,
+            trust_remote_code=self.trust_remote_code,
+            quantization=self.quantization,
+        )
+        max_batched = self.max_num_batched_tokens
+        if max_batched is None:
+            max_batched = max(2048, self.max_num_seqs)
+        hbm_utilization = self.hbm_utilization
+        if hbm_utilization is None:
+            hbm_utilization = float(os.environ.get("VDT_HBM_UTILIZATION", "0.9"))
+        cache_config = CacheConfig(
+            page_size=self.page_size,
+            num_pages=self.num_kv_pages,
+            hbm_utilization=hbm_utilization,
+            cache_dtype=self.kv_cache_dtype,
+        )
+        parallel_config = ParallelConfig(
+            tensor_parallel_size=self.tensor_parallel_size,
+            pipeline_parallel_size=self.pipeline_parallel_size,
+            data_parallel_size=self.data_parallel_size,
+            enable_expert_parallel=self.enable_expert_parallel,
+            distributed_executor_backend=self.distributed_executor_backend,
+            num_hosts=self.num_hosts,
+            host_id=self.host_id,
+            coordinator_address=self.coordinator_address,
+        )
+        scheduler_config = SchedulerConfig(
+            max_num_seqs=self.max_num_seqs,
+            max_num_batched_tokens=max_batched,
+            enable_chunked_prefill=self.enable_chunked_prefill,
+            max_model_len=model_config.max_model_len,
+        )
+        return EngineConfig(
+            model_config=model_config,
+            cache_config=cache_config,
+            parallel_config=parallel_config,
+            scheduler_config=scheduler_config,
+            device_config=DeviceConfig(device=self.device),
+            observability_config=ObservabilityConfig(
+                collect_metrics=not self.disable_log_stats,
+                profile_dir=self.profile_dir,
+            ),
+        )

@@ -1,0 +1,79 @@
+"""Paged KV-cache page allocator.
+
+The TPU-native analog of vLLM's KV block manager (the engine capability the
+reference delegates to the vllm package — SURVEY.md §2.3, "KV block
+manager").  Pages are fixed-size chunks of `page_size` token slots in a
+flat HBM pool; a request owns an ordered list of page ids (its block
+table).  Allocation is O(1) from a free list; freeing returns pages LIFO so
+recently-touched HBM is reused first.
+
+Slot addressing: token `t` of a request lives at flat slot
+``page_ids[t // page_size] * page_size + t % page_size`` — the layout the
+attention kernels and the KV scatter in the model runner share.
+"""
+
+from __future__ import annotations
+
+from vllm_distributed_tpu.engine.request import Request
+from vllm_distributed_tpu.utils import cdiv
+
+
+class NoFreePagesError(RuntimeError):
+    pass
+
+
+class PageAllocator:
+    def __init__(self, num_pages: int, page_size: int) -> None:
+        self.num_pages = num_pages
+        self.page_size = page_size
+        # Free list as a stack; page 0 is reserved as the null/padding page
+        # so block tables can be padded with 0 safely.
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))
+        # req_id -> page ids
+        self._allocated: dict[str, list[int]] = {}
+
+    @property
+    def num_free_pages(self) -> int:
+        return len(self._free)
+
+    def num_pages_needed(self, num_tokens: int) -> int:
+        return cdiv(num_tokens, self.page_size)
+
+    def can_allocate(self, req: Request, num_new_tokens: int) -> bool:
+        have = len(self._allocated.get(req.request_id, ()))
+        need = self.num_pages_needed(req.num_computed_tokens + num_new_tokens)
+        return need - have <= len(self._free)
+
+    def allocate(self, req: Request, num_new_tokens: int) -> list[int]:
+        """Ensure req owns enough pages to cover `num_computed_tokens +
+        num_new_tokens` tokens. Returns the newly granted page ids."""
+        pages = self._allocated.setdefault(req.request_id, [])
+        need = self.num_pages_needed(req.num_computed_tokens + num_new_tokens)
+        new_pages: list[int] = []
+        while len(pages) < need:
+            if not self._free:
+                # Roll back: caller decides to preempt.
+                for p in new_pages:
+                    pages.remove(p)
+                    self._free.append(p)
+                raise NoFreePagesError(
+                    f"out of KV pages ({self.num_pages} total)"
+                )
+            p = self._free.pop()
+            pages.append(p)
+            new_pages.append(p)
+        req.page_ids = pages
+        return new_pages
+
+    def free(self, req: Request) -> None:
+        pages = self._allocated.pop(req.request_id, [])
+        # LIFO reuse.
+        self._free.extend(reversed(pages))
+        req.page_ids = []
+
+    def get_page_ids(self, req_id: str) -> list[int]:
+        return self._allocated.get(req_id, [])
+
+    def slot_for_token(self, req: Request, token_idx: int) -> int:
+        page = req.page_ids[token_idx // self.page_size]
+        return page * self.page_size + token_idx % self.page_size

@@ -1,0 +1,121 @@
+"""Per-request state tracked by the scheduler/engine."""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+
+from vllm_distributed_tpu.outputs import RequestMetrics
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+class RequestStatus(enum.Enum):
+    WAITING = enum.auto()
+    RUNNING = enum.auto()
+    PREEMPTED = enum.auto()
+    FINISHED_STOPPED = enum.auto()
+    FINISHED_LENGTH = enum.auto()
+    FINISHED_ABORTED = enum.auto()
+
+    @property
+    def is_finished(self) -> bool:
+        return self in (
+            RequestStatus.FINISHED_STOPPED,
+            RequestStatus.FINISHED_LENGTH,
+            RequestStatus.FINISHED_ABORTED,
+        )
+
+
+FINISH_REASON = {
+    RequestStatus.FINISHED_STOPPED: "stop",
+    RequestStatus.FINISHED_LENGTH: "length",
+    RequestStatus.FINISHED_ABORTED: "abort",
+}
+
+
+@dataclass(eq=False)
+class Request:
+    request_id: str
+    prompt_token_ids: list[int]
+    sampling_params: SamplingParams
+    prompt: str | None = None
+    eos_token_id: int | None = None
+    arrival_time: float = field(default_factory=time.monotonic)
+
+    status: RequestStatus = RequestStatus.WAITING
+    # All tokens = prompt + generated output.
+    output_token_ids: list[int] = field(default_factory=list)
+    # How many tokens have had their KV computed (chunked prefill cursor).
+    num_computed_tokens: int = 0
+    # Page ids owned by this request, in order.
+    page_ids: list[int] = field(default_factory=list)
+    # After preemption-resume, KV for already-generated tokens must be
+    # recomputed too; this is the token count to re-prefill up to.
+    resume_target: int = 0
+    metrics: RequestMetrics = field(default_factory=RequestMetrics)
+    stop_reason: int | str | None = None
+    # Cumulative logprobs bookkeeping (filled only when requested).
+    logprobs: list[dict[int, float]] | None = None
+    cumulative_logprob: float = 0.0
+
+    def __post_init__(self) -> None:
+        self.metrics.arrival_time = time.time()
+        if self.sampling_params.logprobs is not None:
+            self.logprobs = []
+
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_token_ids)
+
+    @property
+    def num_output_tokens(self) -> int:
+        return len(self.output_token_ids)
+
+    @property
+    def num_tokens(self) -> int:
+        return self.num_prompt_tokens + self.num_output_tokens
+
+    @property
+    def all_token_ids(self) -> list[int]:
+        return self.prompt_token_ids + self.output_token_ids
+
+    @property
+    def prefill_target(self) -> int:
+        """Tokens whose KV is recomputed in (chunked) prefill before decode
+        resumes: the prompt, or everything known at preemption time."""
+        return max(self.num_prompt_tokens, self.resume_target)
+
+    @property
+    def is_prefill(self) -> bool:
+        return self.num_computed_tokens < self.prefill_target
+
+    @property
+    def max_total_tokens(self) -> int:
+        mt = self.sampling_params.max_tokens
+        if mt is None:
+            return 1 << 60
+        return self.num_prompt_tokens + mt
+
+    def append_output_token(self, token_id: int) -> None:
+        self.output_token_ids.append(token_id)
+
+    def check_stop(self, max_model_len: int) -> RequestStatus | None:
+        """Returns a finished status if the request should stop, else None.
+        Stop-string checking happens in the detokenizer, not here."""
+        sp = self.sampling_params
+        if self.num_output_tokens >= sp.min_tokens:
+            last = self.output_token_ids[-1] if self.output_token_ids else None
+            if (
+                not sp.ignore_eos
+                and self.eos_token_id is not None
+                and last == self.eos_token_id
+            ):
+                self.stop_reason = None
+                return RequestStatus.FINISHED_STOPPED
+            if last is not None and last in sp.stop_token_ids:
+                self.stop_reason = last
+                return RequestStatus.FINISHED_STOPPED
+        if self.num_tokens >= min(self.max_total_tokens, max_model_len):
+            return RequestStatus.FINISHED_LENGTH
+        return None

@@ -1,0 +1,317 @@
+"""Continuous-batching scheduler.
+
+The engine-side capability the reference delegates to vLLM v1's scheduler
+(SURVEY.md §2.3; the scheduler's product, `SchedulerOutput`, is exactly
+what CustomExecutor.execute_model receives at launch.py:322).  Design:
+
+- Single token budget per step (`max_num_batched_tokens`), shared by
+  prefill and decode; chunked prefill lets long prompts trickle through
+  without starving decodes (TPU-friendly: step shapes stay bounded, so the
+  number of distinct compiled programs stays small).
+- Workers mirror request state, so `SchedulerOutput` carries full data only
+  for newly-scheduled requests and deltas for cached ones — matching the
+  reference's control-plane economy (only small control messages cross
+  hosts per step, SURVEY.md §2.5).
+- Preemption by eviction: when KV pages run out, the lowest-priority
+  running request is stopped, its pages freed, and it re-enters the
+  waiting queue for full recompute (same policy family as vLLM's
+  recompute preemption).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+from vllm_distributed_tpu.config import CacheConfig, SchedulerConfig
+from vllm_distributed_tpu.engine.block_manager import (
+    NoFreePagesError,
+    PageAllocator,
+)
+from vllm_distributed_tpu.engine.request import Request, RequestStatus
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class NewRequestData:
+    req_id: str
+    prompt_token_ids: list[int]
+    page_ids: list[int]
+    num_computed_tokens: int
+    num_new_tokens: int
+    sampling_params: SamplingParams
+
+
+@dataclass
+class CachedRequestData:
+    req_id: str
+    new_page_ids: list[int]
+    num_computed_tokens: int
+    num_new_tokens: int
+    # Tokens the worker hasn't seen yet (sampled on the driver side between
+    # steps, e.g. after preemption resume); usually empty because workers
+    # append the tokens they sample themselves.
+    resumed_token_ids: list[int] | None = None
+
+
+@dataclass
+class SchedulerOutput:
+    """One step's worth of work, shipped to every worker."""
+
+    step_id: int
+    new_requests: list[NewRequestData] = field(default_factory=list)
+    cached_requests: list[CachedRequestData] = field(default_factory=list)
+    # req_id -> num tokens to run this step (prefill chunk len or 1).
+    num_scheduled_tokens: dict[str, int] = field(default_factory=dict)
+    total_num_scheduled_tokens: int = 0
+    finished_req_ids: list[str] = field(default_factory=list)
+    preempted_req_ids: list[str] = field(default_factory=list)
+
+    @property
+    def is_empty(self) -> bool:
+        return self.total_num_scheduled_tokens == 0
+
+
+class Scheduler:
+    def __init__(
+        self,
+        scheduler_config: SchedulerConfig,
+        cache_config: CacheConfig,
+        num_pages: int,
+    ) -> None:
+        self.config = scheduler_config
+        self.page_size = cache_config.page_size
+        self.allocator = PageAllocator(num_pages, cache_config.page_size)
+        self.waiting: deque[Request] = deque()
+        self.running: list[Request] = []
+        self.requests: dict[str, Request] = {}
+        self._step_id = 0
+        # Finished/preempted since last step, to notify workers.
+        self._finished_since_last: list[str] = []
+
+    # ---- intake ----
+    def add_request(self, req: Request) -> None:
+        # A request that can never fit in the page pool would wait forever;
+        # reject it up front. +1 covers the first sampled token's slot.
+        usable_pages = self.allocator.num_pages - 1
+        max_len = min(req.max_total_tokens, self.config.max_model_len)
+        if self.allocator.num_pages_needed(max_len) > usable_pages:
+            raise ValueError(
+                f"request {req.request_id} needs up to {max_len} KV slots "
+                f"({self.allocator.num_pages_needed(max_len)} pages) but the "
+                f"cache holds only {usable_pages} pages of "
+                f"{self.page_size} slots"
+            )
+        if req.num_prompt_tokens >= self.config.max_model_len:
+            raise ValueError(
+                f"prompt of request {req.request_id} has "
+                f"{req.num_prompt_tokens} tokens, exceeding max_model_len "
+                f"{self.config.max_model_len}"
+            )
+        if req.num_prompt_tokens == 0:
+            raise ValueError(f"request {req.request_id} has an empty prompt")
+        self.requests[req.request_id] = req
+        self.waiting.append(req)
+
+    def abort_request(self, req_id: str) -> None:
+        req = self.requests.get(req_id)
+        if req is None or req.status.is_finished:
+            return
+        req.status = RequestStatus.FINISHED_ABORTED
+        if req in self.running:
+            self.running.remove(req)
+            self._finished_since_last.append(req_id)
+        elif req in self.waiting:
+            self.waiting.remove(req)
+        self.allocator.free(req)
+        del self.requests[req_id]
+
+    @property
+    def num_unfinished(self) -> int:
+        return len(self.waiting) + len(self.running)
+
+    def has_unfinished_requests(self) -> bool:
+        return self.num_unfinished > 0
+
+    # ---- the step ----
+    def schedule(self) -> SchedulerOutput:
+        out = SchedulerOutput(step_id=self._step_id)
+        self._step_id += 1
+        out.finished_req_ids = self._finished_since_last
+        self._finished_since_last = []
+
+        token_budget = self.config.max_num_batched_tokens
+
+        # 1) decodes + in-flight chunked prefills, in arrival order.
+        #    Iterate over a copy: preemption mutates self.running.
+        scheduled_running: list[Request] = []
+        preempted: set[str] = set()
+        for req in list(self.running):
+            if req.request_id in preempted:
+                continue
+            if token_budget <= 0:
+                break
+            if req.is_prefill:
+                remaining = req.prefill_target - req.num_computed_tokens
+                chunk = min(remaining, token_budget)
+                if not self.config.enable_chunked_prefill and chunk < remaining:
+                    continue
+                num_new = chunk
+            else:
+                num_new = 1
+            got = self._allocate_or_preempt(
+                req, num_new, preempted, scheduled_running
+            )
+            if not got:
+                continue
+            new_pages = got[1]
+            out.num_scheduled_tokens[req.request_id] = num_new
+            out.total_num_scheduled_tokens += num_new
+            token_budget -= num_new
+            out.cached_requests.append(
+                CachedRequestData(
+                    req_id=req.request_id,
+                    new_page_ids=new_pages,
+                    num_computed_tokens=req.num_computed_tokens,
+                    num_new_tokens=num_new,
+                )
+            )
+            scheduled_running.append(req)
+
+        # 2) admit waiting requests while budget and seats remain.
+        while (
+            self.waiting
+            and token_budget > 0
+            and len(self.running) < self.config.max_num_seqs
+        ):
+            req = self.waiting[0]
+            if req.request_id in preempted:
+                break  # do not resume a request preempted this same step
+            remaining_prompt = req.prefill_target - req.num_computed_tokens
+            num_new = min(remaining_prompt, token_budget)
+            if num_new <= 0:
+                break
+            if not self.config.enable_chunked_prefill:
+                if remaining_prompt > token_budget:
+                    break
+                num_new = remaining_prompt
+            # Admission: don't preempt running requests for new ones.
+            if not self.allocator.can_allocate(req, num_new):
+                break
+            self.waiting.popleft()
+            new_pages = self.allocator.allocate(req, num_new)
+            if req.status == RequestStatus.WAITING:
+                import time as _time
+
+                req.metrics.first_scheduled_time = _time.time()
+            resumed = req.status == RequestStatus.PREEMPTED
+            req.status = RequestStatus.RUNNING
+            self.running.append(req)
+            out.num_scheduled_tokens[req.request_id] = num_new
+            out.total_num_scheduled_tokens += num_new
+            token_budget -= num_new
+            out.new_requests.append(
+                NewRequestData(
+                    req_id=req.request_id,
+                    # On preemption-resume the worker state was dropped, so
+                    # resend everything incl. generated tokens.
+                    prompt_token_ids=req.all_token_ids
+                    if resumed
+                    else req.prompt_token_ids,
+                    page_ids=list(req.page_ids),
+                    num_computed_tokens=req.num_computed_tokens,
+                    num_new_tokens=num_new,
+                    sampling_params=req.sampling_params,
+                )
+            )
+
+        out.preempted_req_ids = sorted(preempted)
+        return out
+
+    def _allocate_or_preempt(
+        self,
+        req: Request,
+        num_new: int,
+        preempted: set[str],
+        scheduled_this_step: list[Request],
+    ) -> tuple[bool, list[int]] | None:
+        """Allocate pages for req, evicting lower-priority running requests
+        if needed. Returns (True, new_pages) or None if req itself could not
+        be scheduled (it was preempted).
+
+        A request already scheduled this step must never be chosen as the
+        victim: its page ids are already baked into the SchedulerOutput, so
+        freeing them would hand the same pages to two requests.
+        """
+        while True:
+            try:
+                return True, self.allocator.allocate(req, num_new)
+            except NoFreePagesError:
+                victim = None
+                for cand in reversed(self.running):
+                    if (
+                        cand is not req
+                        and cand.request_id not in preempted
+                        and cand not in scheduled_this_step
+                    ):
+                        victim = cand
+                        break
+                if victim is None:
+                    # Preempt req itself.
+                    self._preempt(req, preempted)
+                    return None
+                self._preempt(victim, preempted)
+
+    def _preempt(self, req: Request, preempted: set[str]) -> None:
+        logger.debug("preempting request %s", req.request_id)
+        self.allocator.free(req)
+        req.status = RequestStatus.PREEMPTED
+        req.num_computed_tokens = 0
+        req.resume_target = req.num_tokens
+        if req in self.running:
+            self.running.remove(req)
+        # Workers drop state on preempted_req_ids in this step's output;
+        # no entry in _finished_since_last (it would collide with the
+        # request's own resume in a later step's new_requests).
+        preempted.add(req.request_id)
+        self.waiting.appendleft(req)
+
+    # ---- post-step bookkeeping ----
+    def update_from_output(
+        self,
+        scheduler_output: SchedulerOutput,
+        sampled_token_ids: dict[str, list[int]],
+    ) -> list[Request]:
+        """Advance request states given the tokens the workers sampled.
+        Returns requests that finished this step."""
+        finished: list[Request] = []
+        for req_id, num in scheduler_output.num_scheduled_tokens.items():
+            req = self.requests.get(req_id)
+            if req is None or req.status != RequestStatus.RUNNING:
+                continue  # aborted mid-step
+            req.num_computed_tokens += num
+            new_tokens = sampled_token_ids.get(req_id, [])
+            for tok in new_tokens:
+                req.append_output_token(tok)
+                status = req.check_stop(self.config.max_model_len)
+                if status is not None:
+                    req.status = status
+                    break
+            if req.status.is_finished:
+                self.running.remove(req)
+                self.allocator.free(req)
+                self._finished_since_last.append(req_id)
+                finished.append(req)
+        return finished
+
+    def finish_request(self, req: Request, status: RequestStatus) -> None:
+        req.status = status
+        if req in self.running:
+            self.running.remove(req)
+            self._finished_since_last.append(req.request_id)
+        if req in self.waiting:
+            self.waiting.remove(req)
+        self.allocator.free(req)

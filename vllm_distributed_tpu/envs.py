@@ -1,0 +1,95 @@
+"""Environment-variable registry.
+
+The reference treats vLLM's ``envs.environment_variables`` as the single
+registry of recognized env vars and uses it as the replication allowlist
+when forwarding driver env vars to remote workers (launch.py:26, 62-72,
+198-208).  We keep that design: every env var the framework understands is
+declared here, and the control plane replicates everything in the registry
+*except* per-host variables to remote hosts.
+"""
+
+from __future__ import annotations
+
+import os
+from collections.abc import Callable
+from typing import Any
+
+# name -> lambda returning the parsed value (lazy so tests can monkeypatch).
+environment_variables: dict[str, Callable[[], Any]] = {
+    # --- control plane ---
+    "VDT_SERVER_PORT": lambda: int(os.environ.get("VDT_SERVER_PORT", "30044")),
+    "VDT_HOST_IP": lambda: os.environ.get("VDT_HOST_IP", ""),
+    # per-step execute timeout, like VLLM_EXECUTE_MODEL_TIMEOUT_SECONDS
+    # (launch.py:334, 343)
+    "VDT_EXECUTE_MODEL_TIMEOUT_SECONDS": lambda: int(
+        os.environ.get("VDT_EXECUTE_MODEL_TIMEOUT_SECONDS", "300")
+    ),
+    "VDT_HEALTH_CHECK_TIMEOUT_SECONDS": lambda: int(
+        os.environ.get("VDT_HEALTH_CHECK_TIMEOUT_SECONDS", "10")
+    ),
+    # --- engine ---
+    "VDT_LOG_LEVEL": lambda: os.environ.get("VDT_LOG_LEVEL", "INFO"),
+    "VDT_COMPILE_CACHE_DIR": lambda: os.environ.get(
+        "VDT_COMPILE_CACHE_DIR", os.path.expanduser("~/.cache/vdt/jax_cache")
+    ),
+    "VDT_HBM_UTILIZATION": lambda: float(
+        os.environ.get("VDT_HBM_UTILIZATION", "0.9")
+    ),
+    # pipeline layer split override, analog of VLLM_PP_LAYER_PARTITION
+    # (docker-compose.yml:38)
+    "VDT_PP_LAYER_PARTITION": lambda: os.environ.get("VDT_PP_LAYER_PARTITION", ""),
+    "VDT_HTTP_TIMEOUT_KEEP_ALIVE": lambda: int(
+        os.environ.get("VDT_HTTP_TIMEOUT_KEEP_ALIVE", "5")
+    ),
+    # force the jax platform (cpu for tests, tpu in prod)
+    "VDT_PLATFORM": lambda: os.environ.get("VDT_PLATFORM", ""),
+    "VDT_USE_PALLAS": lambda: os.environ.get("VDT_USE_PALLAS", "auto"),
+    # --- external, replicated for weight download ---
+    "HF_TOKEN": lambda: os.environ.get("HF_TOKEN", ""),
+    "HUGGING_FACE_HUB_TOKEN": lambda: os.environ.get("HUGGING_FACE_HUB_TOKEN", ""),
+    "HF_HOME": lambda: os.environ.get("HF_HOME", ""),
+}
+
+# Per-host variables that must NOT be replicated to remote workers, the
+# analog of the exclusion set at launch.py:62-69 ({VLLM_HOST_IP,
+# VLLM_HOST_PORT, LOCAL_RANK, CUDA_VISIBLE_DEVICES}).
+NON_REPLICATED_ENV_VARS = {
+    "VDT_HOST_IP",
+    "VDT_SERVER_PORT",
+    "TPU_VISIBLE_DEVICES",
+    "JAX_PLATFORMS",
+    "LOCAL_RANK",
+    "RANK",
+}
+
+# Extra vars replicated even though they are not VDT_* (launch.py:70-72).
+ADDITIONAL_REPLICATED_ENV_VARS = {
+    "HF_TOKEN",
+    "HUGGING_FACE_HUB_TOKEN",
+    "HF_HOME",
+}
+
+
+def replication_env(environ: dict[str, str] | None = None) -> dict[str, str]:
+    """Env vars to copy from the driver to a remote worker.
+
+    Mirrors launch.py:198-208: everything in the registry that is actually
+    set in the driver's environment, minus per-host vars, plus the HF vars.
+    """
+    environ = os.environ if environ is None else environ
+    out: dict[str, str] = {}
+    for name in environment_variables:
+        if name in NON_REPLICATED_ENV_VARS:
+            continue
+        if name in environ:
+            out[name] = environ[name]
+    for name in ADDITIONAL_REPLICATED_ENV_VARS:
+        if name in environ:
+            out[name] = environ[name]
+    return out
+
+
+def __getattr__(name: str) -> Any:
+    if name in environment_variables:
+        return environment_variables[name]()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

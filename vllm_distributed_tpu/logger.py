@@ -1,0 +1,60 @@
+"""Structured logging with host/rank prefixes.
+
+The reference relies on vLLM's init_logger (launch.py:40,54) and forwards
+remote worker tracebacks to the driver log (launch.py:531-535). We provide
+an equivalent: every process tags records with its hostname and, once the
+distributed runtime is initialized, its process index.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import sys
+
+_FORMAT = (
+    "%(levelname)s %(asctime)s [%(hostprefix)s] %(name)s:%(lineno)d  %(message)s"
+)
+_DATEFMT = "%m-%d %H:%M:%S"
+
+_process_tag: str | None = None
+
+
+def set_process_tag(tag: str) -> None:
+    """Set a tag (e.g. "worker-3" or "agent") included in every log record."""
+    global _process_tag
+    _process_tag = tag
+
+
+class _HostPrefixFilter(logging.Filter):
+    def __init__(self) -> None:
+        super().__init__()
+        self._host = socket.gethostname()
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        tag = _process_tag or f"pid{os.getpid()}"
+        record.hostprefix = f"{self._host}/{tag}"
+        return True
+
+
+_configured = False
+
+
+def _configure_root() -> None:
+    global _configured
+    if _configured:
+        return
+    _configured = True
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT, datefmt=_DATEFMT))
+    handler.addFilter(_HostPrefixFilter())
+    root = logging.getLogger("vllm_distributed_tpu")
+    root.setLevel(os.environ.get("VDT_LOG_LEVEL", "INFO").upper())
+    root.addHandler(handler)
+    root.propagate = False
+
+
+def init_logger(name: str) -> logging.Logger:
+    _configure_root()
+    return logging.getLogger(name)

@@ -1,0 +1,66 @@
+"""Request/step output types.
+
+``ModelRunnerOutput`` is the per-step contract returned from workers to the
+executor (the analog of vLLM's ModelRunnerOutput consumed at launch.py:46,
+326).  ``RequestOutput``/``CompletionOutput`` are the user-facing results
+streamed by the engine.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ModelRunnerOutput:
+    """What a worker returns from one execute_model step.
+
+    Only the designated reply rank returns a populated instance; all other
+    ranks return None (reference: launch.py:536-538).
+    """
+
+    # req_id -> newly sampled token ids this step (usually length 1).
+    sampled_token_ids: dict[str, list[int]] = field(default_factory=dict)
+    # req_id -> list of (token_id -> logprob) dicts, parallel to sampled ids.
+    logprobs: dict[str, list[dict[int, float]]] = field(default_factory=dict)
+    # req_id -> number of prompt tokens processed this step (chunked prefill).
+    num_prompt_tokens_processed: dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class CompletionOutput:
+    index: int
+    text: str
+    token_ids: list[int]
+    cumulative_logprob: float | None = None
+    logprobs: list[dict[int, float]] | None = None
+    finish_reason: str | None = None  # "stop" | "length" | "abort"
+    stop_reason: int | str | None = None
+
+    @property
+    def finished(self) -> bool:
+        return self.finish_reason is not None
+
+
+@dataclass
+class RequestOutput:
+    request_id: str
+    prompt: str | None
+    prompt_token_ids: list[int]
+    outputs: list[CompletionOutput]
+    finished: bool
+    metrics: "RequestMetrics | None" = None
+
+
+@dataclass
+class RequestMetrics:
+    arrival_time: float = 0.0
+    first_scheduled_time: float | None = None
+    first_token_time: float | None = None
+    finished_time: float | None = None
+
+    @property
+    def ttft(self) -> float | None:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.arrival_time

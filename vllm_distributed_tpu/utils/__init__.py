@@ -1,0 +1,90 @@
+"""Small shared utilities (network, math, id counters)."""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import inspect
+import itertools
+import socket
+from typing import Any
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(x: int, multiple: int) -> int:
+    return cdiv(x, multiple) * multiple
+
+
+def next_power_of_2(x: int) -> int:
+    if x <= 1:
+        return 1
+    return 1 << (x - 1).bit_length()
+
+
+def get_ip() -> str:
+    """Best-effort primary IP of this host (reference: launch.py:94 uses
+    vllm's get_ip for the collective rendezvous address)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        # Does not actually send packets; picks the interface that would
+        # route to a public address.
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def get_open_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def get_distributed_init_method(ip: str, port: int) -> str:
+    """Coordinator address for jax.distributed.initialize (the analog of the
+    torch rendezvous minted at launch.py:94)."""
+    return f"{ip}:{port}"
+
+
+class Counter:
+    """Monotonic id generator."""
+
+    def __init__(self, start: int = 0) -> None:
+        self._start = start
+        self._it = itertools.count(start)
+
+    def __next__(self) -> int:
+        return next(self._it)
+
+    def reset(self) -> None:
+        self._it = itertools.count(self._start)
+
+
+async def maybe_await(value: Any) -> Any:
+    """Await if awaitable, else pass through (reference rpc.py maybe_await)."""
+    if inspect.isawaitable(value):
+        return await value
+    return value
+
+
+def run_method(obj: Any, method: str | Any, args: tuple, kwargs: dict) -> Any:
+    """Dispatch a method on obj by string name or callable (the contract of
+    vLLM's run_method used at launch.py:529)."""
+    if isinstance(method, str):
+        func = getattr(obj, method)
+    else:
+        func = method.__get__(obj, obj.__class__)
+    return func(*args, **kwargs)
+
+
+@contextlib.contextmanager
+def cancel_task_on_exit(task: asyncio.Task):
+    try:
+        yield task
+    finally:
+        task.cancel()
