@@ -1,6 +1,11 @@
-"""Test bootstrap: force JAX onto CPU with 8 virtual devices BEFORE jax
-is imported anywhere, so sharding tests exercise real multi-device meshes
-without TPU hardware (SURVEY.md §4 item 4)."""
+"""Test bootstrap: force JAX onto CPU with 8 virtual devices so sharding
+tests exercise real multi-device meshes without TPU hardware (SURVEY.md §4
+item 4).
+
+The TPU tunnel's sitecustomize imports jax at interpreter startup, so env
+vars set here are too late for jax's import-time defaults;
+`jax.config.update` before first backend use still works because backends
+initialize lazily."""
 
 import os
 
@@ -11,3 +16,7 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("VDT_PLATFORM", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,200 @@
+"""End-to-end engine tests on CPU against the transformers oracle
+(SURVEY.md §4 items 1-3: the reference ships no tests; this is the test
+pyramid the TPU build adds)."""
+
+import numpy as np
+import pytest
+
+from tests.utils import (
+    hf_greedy_generate,
+    hf_logits,
+    make_tiny_llama,
+    make_tiny_opt,
+)
+from vllm_distributed_tpu.config import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def tiny_llama(tmp_path_factory):
+    return make_tiny_llama(str(tmp_path_factory.mktemp("llama")))
+
+
+@pytest.fixture(scope="module")
+def tiny_opt(tmp_path_factory):
+    return make_tiny_opt(str(tmp_path_factory.mktemp("opt")))
+
+
+def _make_engine(model_dir, **kw):
+    defaults = dict(
+        model=model_dir,
+        skip_tokenizer_init=True,
+        num_kv_pages=128,
+        page_size=16,
+        max_num_seqs=8,
+        max_model_len=256,
+    )
+    defaults.update(kw)
+    return LLMEngine.from_engine_args(EngineArgs(**defaults))
+
+
+def _run_greedy(engine, prompts, max_tokens=8):
+    for i, p in enumerate(prompts):
+        engine.add_request(
+            f"r{i}",
+            prompt_token_ids=p,
+            sampling_params=SamplingParams(
+                temperature=0.0, max_tokens=max_tokens, ignore_eos=True
+            ),
+        )
+    done = {}
+    while engine.has_unfinished_requests():
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+    return [done[f"r{i}"].outputs[0].token_ids for i in range(len(prompts))]
+
+
+def test_llama_greedy_matches_hf(tiny_llama):
+    prompt = [1, 5, 9, 23, 77, 41, 3]
+    expected = hf_greedy_generate(tiny_llama, prompt, 8)
+    engine = _make_engine(tiny_llama)
+    got = _run_greedy(engine, [prompt])[0]
+    assert got == expected
+
+
+def test_llama_prefill_logits_match_hf(tiny_llama):
+    """Single prefill step's last-token logits vs transformers."""
+    from vllm_distributed_tpu.config import ModelConfig
+    from vllm_distributed_tpu.engine.scheduler import (
+        NewRequestData,
+        SchedulerOutput,
+    )
+
+    prompt = [2, 4, 8, 16, 32, 64]
+    ref = hf_logits(tiny_llama, prompt)[-1]
+
+    engine = _make_engine(tiny_llama)
+    worker = engine.executor.worker
+    runner = worker.runner
+    so = SchedulerOutput(
+        step_id=0,
+        new_requests=[
+            NewRequestData(
+                req_id="x",
+                prompt_token_ids=prompt,
+                num_prompt_tokens=len(prompt),
+                page_ids=[1],
+                num_computed_tokens=0,
+                num_new_tokens=len(prompt),
+                sampling_params=SamplingParams(temperature=0.0),
+            )
+        ],
+        num_scheduled_tokens={"x": len(prompt)},
+        total_num_scheduled_tokens=len(prompt),
+    )
+    # Capture logits by running the model forward directly.
+    import jax.numpy as jnp
+
+    from vllm_distributed_tpu.ops.attention import AttentionMetadata
+
+    t_pad, s_pad, pages = 16, 8, 8
+    tokens = np.zeros(t_pad, np.int32)
+    tokens[: len(prompt)] = prompt
+    positions = np.zeros(t_pad, np.int32)
+    positions[: len(prompt)] = np.arange(len(prompt))
+    seq_ids = np.full(t_pad, s_pad - 1, np.int32)
+    seq_ids[: len(prompt)] = 0
+    slots = np.zeros(t_pad, np.int32)
+    slots[: len(prompt)] = 16 + np.arange(len(prompt))  # page 1
+    bt = np.zeros((s_pad, pages), np.int32)
+    bt[0, 0] = 1
+    seq_lens = np.zeros(s_pad, np.int32)
+    seq_lens[0] = len(prompt)
+    li = np.zeros(s_pad, np.int32)
+    li[0] = len(prompt) - 1
+    meta = AttentionMetadata(
+        q_seq_ids=jnp.asarray(seq_ids),
+        q_positions=jnp.asarray(positions),
+        slot_mapping=jnp.asarray(slots),
+        block_tables=jnp.asarray(bt),
+        seq_lens=jnp.asarray(seq_lens),
+        logits_indices=jnp.asarray(li),
+    )
+    logits, _ = runner.model.forward(
+        runner.params, jnp.asarray(tokens), runner.kv_caches, meta
+    )
+    got = np.asarray(logits[0])
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_chunked_prefill_consistency(tiny_llama):
+    """Chunked prefill (tiny token budget) must give identical greedy
+    output to unchunked."""
+    prompt = list(range(1, 41))  # 40-token prompt
+    big = _make_engine(tiny_llama, max_num_batched_tokens=2048)
+    small = _make_engine(
+        tiny_llama, max_num_batched_tokens=16, max_num_seqs=8
+    )
+    out_big = _run_greedy(big, [prompt])[0]
+    out_small = _run_greedy(small, [prompt])[0]
+    assert out_big == out_small
+
+
+def test_batched_requests_match_individual(tiny_llama):
+    prompts = [
+        [1, 5, 9],
+        [7, 2, 88, 14, 3, 9, 55],
+        [100, 3],
+        [42, 42, 42, 42, 42, 42, 42, 42, 42, 42, 42],
+    ]
+    batched_engine = _make_engine(tiny_llama)
+    batched = _run_greedy(batched_engine, prompts, max_tokens=6)
+    for i, p in enumerate(prompts):
+        solo = _run_greedy(_make_engine(tiny_llama), [p], max_tokens=6)[0]
+        assert batched[i] == solo, f"prompt {i} diverged"
+
+
+def test_opt_greedy_matches_hf(tiny_opt):
+    prompt = [1, 9, 17, 33, 65]
+    expected = hf_greedy_generate(tiny_opt, prompt, 8)
+    engine = _make_engine(tiny_opt)
+    got = _run_greedy(engine, [prompt])[0]
+    assert got == expected
+
+
+def test_preemption_recovers(tiny_llama):
+    """Starve the page pool so preemption kicks in; outputs must still
+    match the unconstrained run."""
+    prompts = [list(range(1, 20)), list(range(20, 40)), list(range(3, 17))]
+    rich = _run_greedy(_make_engine(tiny_llama), prompts, max_tokens=6)
+    poor_engine = _make_engine(tiny_llama, num_kv_pages=8, page_size=16)
+    poor = _run_greedy(poor_engine, prompts, max_tokens=6)
+    assert rich == poor
+
+
+def test_sampling_seed_determinism(tiny_llama):
+    def run(seed):
+        engine = _make_engine(tiny_llama)
+        engine.add_request(
+            "s",
+            prompt_token_ids=[1, 2, 3, 4],
+            sampling_params=SamplingParams(
+                temperature=0.8,
+                top_p=0.9,
+                seed=seed,
+                max_tokens=8,
+                ignore_eos=True,
+            ),
+        )
+        while engine.has_unfinished_requests():
+            for out in engine.step():
+                if out.finished:
+                    return out.outputs[0].token_ids
+
+    a = run(1234)
+    b = run(1234)
+    c = run(999)
+    assert a == b
+    assert a != c or len(a) == 0  # overwhelmingly likely to differ

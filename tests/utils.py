@@ -1,0 +1,185 @@
+"""Test helpers: synthesize tiny HF-format model snapshots on disk.
+
+No network egress exists in CI, so every test builds its own miniature
+checkpoint (config.json + model.safetensors with HF tensor names) and the
+parity oracle is `transformers` running the same weights on torch CPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+def _save_safetensors(path: str, tensors: dict[str, np.ndarray]) -> None:
+    from safetensors.numpy import save_file
+
+    save_file(tensors, path)
+
+
+def make_tiny_llama(
+    tmpdir: str,
+    *,
+    vocab_size: int = 128,
+    hidden: int = 64,
+    intermediate: int = 128,
+    layers: int = 2,
+    heads: int = 4,
+    kv_heads: int = 2,
+    max_pos: int = 512,
+    tie_embeddings: bool = False,
+    seed: int = 0,
+) -> str:
+    head_dim = hidden // heads
+    cfg = {
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": "llama",
+        "hidden_size": hidden,
+        "intermediate_size": intermediate,
+        "num_hidden_layers": layers,
+        "num_attention_heads": heads,
+        "num_key_value_heads": kv_heads,
+        "head_dim": head_dim,
+        "vocab_size": vocab_size,
+        "max_position_embeddings": max_pos,
+        "rms_norm_eps": 1e-6,
+        "rope_theta": 10000.0,
+        "torch_dtype": "float32",
+        "tie_word_embeddings": tie_embeddings,
+        "hidden_act": "silu",
+        "bos_token_id": 1,
+        "eos_token_id": 2,
+    }
+    rng = np.random.default_rng(seed)
+
+    def w(*shape, scale=0.05):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    tensors = {
+        "model.embed_tokens.weight": w(vocab_size, hidden),
+        "model.norm.weight": np.ones(hidden, np.float32),
+    }
+    if not tie_embeddings:
+        tensors["lm_head.weight"] = w(vocab_size, hidden)
+    for i in range(layers):
+        p = f"model.layers.{i}."
+        tensors |= {
+            p + "self_attn.q_proj.weight": w(heads * head_dim, hidden),
+            p + "self_attn.k_proj.weight": w(kv_heads * head_dim, hidden),
+            p + "self_attn.v_proj.weight": w(kv_heads * head_dim, hidden),
+            p + "self_attn.o_proj.weight": w(hidden, heads * head_dim),
+            p + "mlp.gate_proj.weight": w(intermediate, hidden),
+            p + "mlp.up_proj.weight": w(intermediate, hidden),
+            p + "mlp.down_proj.weight": w(hidden, intermediate),
+            p + "input_layernorm.weight": np.ones(hidden, np.float32),
+            p + "post_attention_layernorm.weight": np.ones(
+                hidden, np.float32
+            ),
+        }
+    os.makedirs(tmpdir, exist_ok=True)
+    with open(os.path.join(tmpdir, "config.json"), "w") as f:
+        json.dump(cfg, f)
+    _save_safetensors(os.path.join(tmpdir, "model.safetensors"), tensors)
+    return tmpdir
+
+
+def make_tiny_opt(
+    tmpdir: str,
+    *,
+    vocab_size: int = 128,
+    hidden: int = 64,
+    ffn: int = 128,
+    layers: int = 2,
+    heads: int = 4,
+    max_pos: int = 512,
+    seed: int = 0,
+) -> str:
+    cfg = {
+        "architectures": ["OPTForCausalLM"],
+        "model_type": "opt",
+        "hidden_size": hidden,
+        "ffn_dim": ffn,
+        "num_hidden_layers": layers,
+        "num_attention_heads": heads,
+        "vocab_size": vocab_size,
+        "max_position_embeddings": max_pos,
+        "word_embed_proj_dim": hidden,
+        "do_layer_norm_before": True,
+        "torch_dtype": "float32",
+        "activation_function": "relu",
+        "bos_token_id": 1,
+        "eos_token_id": 2,
+        "pad_token_id": 0,
+    }
+    rng = np.random.default_rng(seed)
+
+    def w(*shape, scale=0.05):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    tensors = {
+        "model.decoder.embed_tokens.weight": w(vocab_size, hidden),
+        "model.decoder.embed_positions.weight": w(max_pos + 2, hidden),
+        "model.decoder.final_layer_norm.weight": np.ones(hidden, np.float32),
+        "model.decoder.final_layer_norm.bias": np.zeros(hidden, np.float32),
+    }
+    for i in range(layers):
+        p = f"model.decoder.layers.{i}."
+        tensors |= {
+            p + "self_attn.q_proj.weight": w(hidden, hidden),
+            p + "self_attn.q_proj.bias": np.zeros(hidden, np.float32),
+            p + "self_attn.k_proj.weight": w(hidden, hidden),
+            p + "self_attn.k_proj.bias": np.zeros(hidden, np.float32),
+            p + "self_attn.v_proj.weight": w(hidden, hidden),
+            p + "self_attn.v_proj.bias": np.zeros(hidden, np.float32),
+            p + "self_attn.out_proj.weight": w(hidden, hidden),
+            p + "self_attn.out_proj.bias": np.zeros(hidden, np.float32),
+            p + "self_attn_layer_norm.weight": np.ones(hidden, np.float32),
+            p + "self_attn_layer_norm.bias": np.zeros(hidden, np.float32),
+            p + "final_layer_norm.weight": np.ones(hidden, np.float32),
+            p + "final_layer_norm.bias": np.zeros(hidden, np.float32),
+            p + "fc1.weight": w(ffn, hidden),
+            p + "fc1.bias": np.zeros(ffn, np.float32),
+            p + "fc2.weight": w(hidden, ffn),
+            p + "fc2.bias": np.zeros(hidden, np.float32),
+        }
+    os.makedirs(tmpdir, exist_ok=True)
+    with open(os.path.join(tmpdir, "config.json"), "w") as f:
+        json.dump(cfg, f)
+    _save_safetensors(os.path.join(tmpdir, "model.safetensors"), tensors)
+    return tmpdir
+
+
+def hf_greedy_generate(model_dir: str, prompt_ids: list[int], max_new: int):
+    """Oracle: greedy decode with transformers on torch CPU."""
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(
+        model_dir, torch_dtype=torch.float32
+    )
+    model.eval()
+    ids = torch.tensor([prompt_ids])
+    with torch.no_grad():
+        out = model.generate(
+            ids,
+            max_new_tokens=max_new,
+            do_sample=False,
+            num_beams=1,
+            pad_token_id=0,
+        )
+    return out[0, len(prompt_ids) :].tolist()
+
+
+def hf_logits(model_dir: str, prompt_ids: list[int]):
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(
+        model_dir, torch_dtype=torch.float32
+    )
+    model.eval()
+    with torch.no_grad():
+        out = model(torch.tensor([prompt_ids]))
+    return out.logits[0].numpy()

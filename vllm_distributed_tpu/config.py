@@ -49,6 +49,8 @@ class ModelConfig:
     trust_remote_code: bool = False
     hf_config: Any = None  # transformers PretrainedConfig, loaded lazily
     quantization: str | None = None
+    skip_tokenizer_init: bool = False
+    load_format: str = "auto"  # "auto" (safetensors) | "dummy"
 
     def __post_init__(self) -> None:
         if self.tokenizer is None:
@@ -249,6 +251,8 @@ class EngineArgs:
     max_model_len: int | None = None
     trust_remote_code: bool = False
     quantization: str | None = None
+    skip_tokenizer_init: bool = False
+    load_format: str = "auto"
 
     page_size: int = 16
     num_kv_pages: int | None = None
@@ -288,6 +292,13 @@ class EngineArgs:
         parser.add_argument("--max-model-len", type=int, default=None)
         parser.add_argument("--trust-remote-code", action="store_true")
         parser.add_argument("--quantization", "-q", type=str, default=None)
+        parser.add_argument("--skip-tokenizer-init", action="store_true")
+        parser.add_argument(
+            "--load-format",
+            type=str,
+            default="auto",
+            choices=["auto", "dummy"],
+        )
         parser.add_argument("--page-size", "--block-size", type=int, default=16)
         parser.add_argument("--num-kv-pages", type=int, default=None)
         parser.add_argument(
@@ -341,6 +352,8 @@ class EngineArgs:
             max_model_len=self.max_model_len,
             trust_remote_code=self.trust_remote_code,
             quantization=self.quantization,
+            skip_tokenizer_init=self.skip_tokenizer_init,
+            load_format=self.load_format,
         )
         max_batched = self.max_num_batched_tokens
         if max_batched is None:

@@ -1,0 +1,199 @@
+"""Synchronous engine: scheduler + executor + detokenization loop.
+
+The TPU-native rebuild of the vLLM engine core the reference consumes via
+`build_async_engine_client_from_engine_args` (launch.py:33, 407; SURVEY.md
+§2.3).  One `step()` = schedule → executor.execute_model (one fused device
+program per worker) → update request state → detokenize/stream.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from vllm_distributed_tpu.config import EngineArgs, EngineConfig
+from vllm_distributed_tpu.engine.request import (
+    FINISH_REASON,
+    Request,
+    RequestStatus,
+)
+from vllm_distributed_tpu.engine.scheduler import Scheduler
+from vllm_distributed_tpu.executor.abstract import Executor
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.outputs import CompletionOutput, RequestOutput
+from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.tokenizer import (
+    IncrementalDetokenizer,
+    get_tokenizer,
+)
+
+logger = init_logger(__name__)
+
+
+class LLMEngine:
+    def __init__(
+        self,
+        config: EngineConfig,
+        executor_class: type[Executor] | None = None,
+    ) -> None:
+        self.config = config
+        executor_class = executor_class or Executor.get_class(config)
+        self.executor = executor_class(config)
+
+        num_pages = self.executor.determine_num_pages()
+        self.executor.initialize_cache(num_pages)
+        self.scheduler = Scheduler(
+            config.scheduler_config, config.cache_config, num_pages
+        )
+
+        self.tokenizer = None
+        if not config.model_config.skip_tokenizer_init:
+            self.tokenizer = get_tokenizer(
+                config.model_config.tokenizer,
+                config.model_config.trust_remote_code,
+            )
+        self.detokenizers: dict[str, IncrementalDetokenizer] = {}
+        self._failed = False
+        self.executor.register_failure_callback(self._on_failure)
+
+    @classmethod
+    def from_engine_args(cls, engine_args: EngineArgs) -> "LLMEngine":
+        return cls(engine_args.create_engine_config())
+
+    def _on_failure(self) -> None:
+        self._failed = True
+        logger.error("executor reported failure; engine is dead")
+
+    # ---- intake ----
+    def add_request(
+        self,
+        request_id: str,
+        prompt: str | None = None,
+        sampling_params: SamplingParams | None = None,
+        prompt_token_ids: list[int] | None = None,
+        arrival_time: float | None = None,
+    ) -> None:
+        sampling_params = sampling_params or SamplingParams()
+        if prompt_token_ids is None:
+            if prompt is None:
+                raise ValueError("need prompt or prompt_token_ids")
+            if self.tokenizer is None:
+                raise ValueError("tokenizer not initialized")
+            prompt_token_ids = self.tokenizer.encode(prompt)
+        eos = None
+        if not sampling_params.ignore_eos:
+            if self.tokenizer is not None:
+                eos = self.tokenizer.eos_token_id
+            else:
+                eos = getattr(
+                    self.config.model_config.hf_config, "eos_token_id", None
+                )
+                if isinstance(eos, list):
+                    eos = eos[0] if eos else None
+        req = Request(
+            request_id=request_id,
+            prompt_token_ids=prompt_token_ids,
+            sampling_params=sampling_params,
+            prompt=prompt,
+            eos_token_id=eos,
+        )
+        self.scheduler.add_request(req)
+        if (
+            sampling_params.detokenize
+            and self.tokenizer is not None
+        ):
+            self.detokenizers[request_id] = IncrementalDetokenizer(
+                self.tokenizer,
+                prompt_token_ids,
+                stop=sampling_params.stop,
+                include_stop_str_in_output=(
+                    sampling_params.include_stop_str_in_output
+                ),
+            )
+
+    def abort_request(self, request_id: str) -> None:
+        self.scheduler.abort_request(request_id)
+        self.detokenizers.pop(request_id, None)
+
+    def has_unfinished_requests(self) -> bool:
+        return self.scheduler.has_unfinished_requests()
+
+    # ---- the loop ----
+    def step(self) -> list[RequestOutput]:
+        if self._failed:
+            raise RuntimeError("Engine executor failed.")
+        scheduler_output = self.scheduler.schedule()
+        if scheduler_output.is_empty:
+            return []
+        runner_output = self.executor.execute_model(scheduler_output)
+        finished = self.scheduler.update_from_output(
+            scheduler_output, runner_output.sampled_token_ids
+        )
+        now = time.time()
+
+        outputs: list[RequestOutput] = []
+        for req_id in scheduler_output.num_scheduled_tokens:
+            req = self.scheduler.requests.get(req_id)
+            if req is None:  # finished this step; look in finished list
+                req = next(
+                    (r for r in finished if r.request_id == req_id), None
+                )
+                if req is None:
+                    continue
+            new_tokens = runner_output.sampled_token_ids.get(req_id, [])
+            if new_tokens and req.metrics.first_token_time is None:
+                req.metrics.first_token_time = now
+            if req_id in runner_output.logprobs and req.logprobs is not None:
+                lps = runner_output.logprobs[req_id]
+                req.logprobs.extend(lps)
+                for tok, lp in zip(new_tokens, lps):
+                    req.cumulative_logprob += lp.get(tok, 0.0)
+
+            detok = self.detokenizers.get(req_id)
+            if detok is not None and new_tokens:
+                detok.append(new_tokens)
+                if detok.stopped_on is not None and not req.status.is_finished:
+                    self.scheduler.finish_request(
+                        req, RequestStatus.FINISHED_STOPPED
+                    )
+                    req.stop_reason = detok.stopped_on
+                    finished.append(req)
+
+            if req.status.is_finished:
+                req.metrics.finished_time = now
+            outputs.append(self._make_output(req, detok))
+
+        for req in finished:
+            self.detokenizers.pop(req.request_id, None)
+        return outputs
+
+    def _make_output(
+        self, req: Request, detok: IncrementalDetokenizer | None
+    ) -> RequestOutput:
+        finish_reason = FINISH_REASON.get(req.status)
+        completion = CompletionOutput(
+            index=0,
+            text=detok.output_text if detok is not None else "",
+            token_ids=list(req.output_token_ids),
+            cumulative_logprob=(
+                req.cumulative_logprob if req.logprobs is not None else None
+            ),
+            logprobs=req.logprobs,
+            finish_reason=finish_reason,
+            stop_reason=req.stop_reason,
+        )
+        return RequestOutput(
+            request_id=req.request_id,
+            prompt=req.prompt,
+            prompt_token_ids=req.prompt_token_ids,
+            outputs=[completion],
+            finished=req.status.is_finished,
+            metrics=req.metrics,
+        )
+
+    def shutdown(self) -> None:
+        self.executor.shutdown()
+
+    # Introspection used by the API layer.
+    def get_model_config(self):
+        return self.config.model_config

@@ -38,7 +38,11 @@ logger = init_logger(__name__)
 @dataclass
 class NewRequestData:
     req_id: str
+    # On preemption-resume this includes previously generated tokens (the
+    # worker re-prefills them); num_prompt_tokens marks the true
+    # prompt/output boundary so penalties stay correct across preemption.
     prompt_token_ids: list[int]
+    num_prompt_tokens: int
     page_ids: list[int]
     num_computed_tokens: int
     num_new_tokens: int
@@ -113,6 +117,16 @@ class Scheduler:
             )
         if req.num_prompt_tokens == 0:
             raise ValueError(f"request {req.request_id} has an empty prompt")
+        if (
+            not self.config.enable_chunked_prefill
+            and req.num_prompt_tokens > self.config.max_num_batched_tokens
+        ):
+            raise ValueError(
+                f"prompt of request {req.request_id} has "
+                f"{req.num_prompt_tokens} tokens but chunked prefill is "
+                f"disabled and the step budget is "
+                f"{self.config.max_num_batched_tokens}"
+            )
         self.requests[req.request_id] = req
         self.waiting.append(req)
 
@@ -221,6 +235,7 @@ class Scheduler:
                     prompt_token_ids=req.all_token_ids
                     if resumed
                     else req.prompt_token_ids,
+                    num_prompt_tokens=req.num_prompt_tokens,
                     page_ids=list(req.page_ids),
                     num_computed_tokens=req.num_computed_tokens,
                     num_new_tokens=num_new,
@@ -305,6 +320,7 @@ class Scheduler:
                 self.allocator.free(req)
                 self._finished_since_last.append(req_id)
                 finished.append(req)
+                del self.requests[req_id]
         return finished
 
     def finish_request(self, req: Request, status: RequestStatus) -> None:
@@ -315,3 +331,4 @@ class Scheduler:
         if req in self.waiting:
             self.waiting.remove(req)
         self.allocator.free(req)
+        self.requests.pop(req.request_id, None)

@@ -1,0 +1,58 @@
+"""Offline batch inference API: ``LLM("model").generate(prompts)``.
+
+The Python-native front door (the capability the reference gets from
+vLLM's `LLM` class / `run_batch` CLI, SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from vllm_distributed_tpu.config import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.outputs import RequestOutput
+from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.utils import Counter
+
+
+class LLM:
+    def __init__(self, model: str, **kwargs) -> None:
+        engine_args = EngineArgs(model=model, **kwargs)
+        self.engine = LLMEngine.from_engine_args(engine_args)
+        self._counter = Counter()
+
+    def generate(
+        self,
+        prompts: str | Sequence[str] | None = None,
+        sampling_params: SamplingParams | Sequence[SamplingParams] | None = None,
+        prompt_token_ids: Sequence[list[int]] | None = None,
+    ) -> list[RequestOutput]:
+        if isinstance(prompts, str):
+            prompts = [prompts]
+        n = len(prompts) if prompts is not None else len(prompt_token_ids)
+        if sampling_params is None:
+            sampling_params = SamplingParams()
+        if isinstance(sampling_params, SamplingParams):
+            sampling_params = [sampling_params] * n
+
+        req_ids = []
+        for i in range(n):
+            req_id = f"llm-{next(self._counter)}"
+            req_ids.append(req_id)
+            self.engine.add_request(
+                req_id,
+                prompt=prompts[i] if prompts is not None else None,
+                prompt_token_ids=(
+                    list(prompt_token_ids[i])
+                    if prompt_token_ids is not None
+                    else None
+                ),
+                sampling_params=sampling_params[i],
+            )
+
+        results: dict[str, RequestOutput] = {}
+        while self.engine.has_unfinished_requests():
+            for out in self.engine.step():
+                if out.finished:
+                    results[out.request_id] = out
+        return [results[r] for r in req_ids]

@@ -1,0 +1,4 @@
+from vllm_distributed_tpu.executor.abstract import Executor
+from vllm_distributed_tpu.executor.uniproc import UniProcExecutor
+
+__all__ = ["Executor", "UniProcExecutor"]

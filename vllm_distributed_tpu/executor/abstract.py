@@ -1,0 +1,121 @@
+"""Executor interface — the engine↔worker seam.
+
+Mirrors the vLLM v1 Executor contract the reference plugs CustomExecutor
+into (launch.py:45, 60-388; SURVEY.md §2.3): `_init_executor`,
+`collective_rpc`, `execute_model`, `check_health`,
+`register_failure_callback`, `max_concurrent_batches`.  The engine only
+ever talks to this interface, so swapping uniproc ↔ multiproc ↔
+multihost is a config change (`distributed_executor_backend`), exactly
+the injection point the reference exploits (launch.py:400-405).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Any, Callable
+
+from vllm_distributed_tpu.config import EngineConfig
+from vllm_distributed_tpu.engine.scheduler import SchedulerOutput
+from vllm_distributed_tpu.outputs import ModelRunnerOutput
+
+FailureCallback = Callable[[], None]
+
+
+class Executor:
+    """Subclasses implement _init_executor + collective_rpc."""
+
+    uses_ray = False
+
+    def __init__(self, config: EngineConfig) -> None:
+        self.config = config
+        self.parallel_config = config.parallel_config
+        self.scheduler_config = config.scheduler_config
+        self.is_failed = False
+        self.failure_callback: FailureCallback | None = None
+        self._init_executor()
+
+    # ---- to implement ----
+    def _init_executor(self) -> None:
+        raise NotImplementedError
+
+    def collective_rpc(
+        self,
+        method: str,
+        args: tuple = (),
+        kwargs: dict | None = None,
+        *,
+        unique_reply_rank: int | None = None,
+        non_block: bool = False,
+        timeout: float | None = None,
+    ) -> Any:
+        """Invoke `method` on every worker; return the designated rank's
+        reply (or a list of all replies when unique_reply_rank is None)."""
+        raise NotImplementedError
+
+    # ---- engine-facing surface ----
+    @classmethod
+    def get_class(cls, config: EngineConfig) -> type["Executor"]:
+        backend = config.parallel_config.distributed_executor_backend
+        if isinstance(backend, type) and issubclass(backend, Executor):
+            return backend
+        if backend in (None, "uniproc", "auto"):
+            from vllm_distributed_tpu.executor.uniproc import UniProcExecutor
+
+            return UniProcExecutor
+        if backend == "multihost":
+            from vllm_distributed_tpu.executor.multihost import (
+                MultiHostExecutor,
+            )
+
+            return MultiHostExecutor
+        raise ValueError(f"unknown executor backend {backend!r}")
+
+    @property
+    def output_rank(self) -> int:
+        """Reply comes from the first TP rank of the last PP stage
+        (reference: launch.py:304-314)."""
+        world = self.parallel_config.world_size
+        tp = self.parallel_config.tensor_parallel_size
+        return world - tp if world > tp else 0
+
+    @property
+    def max_concurrent_batches(self) -> int:
+        return self.parallel_config.pipeline_parallel_size
+
+    def execute_model(
+        self, scheduler_output: SchedulerOutput, non_block: bool = False
+    ) -> ModelRunnerOutput | concurrent.futures.Future:
+        return self.collective_rpc(
+            "execute_model",
+            (scheduler_output,),
+            unique_reply_rank=self.output_rank,
+            non_block=non_block,
+        )
+
+    def determine_num_pages(self) -> int:
+        replies = self.collective_rpc("determine_num_pages")
+        return min(replies)
+
+    def initialize_cache(self, num_pages: int) -> None:
+        self.collective_rpc("initialize_cache", (num_pages,))
+
+    def register_failure_callback(self, callback: FailureCallback) -> None:
+        """Engine asks to be told about worker loss (launch.py:316-320)."""
+        if self.is_failed:
+            callback()
+        else:
+            self.failure_callback = callback
+
+    def _notify_failure(self) -> None:
+        self.is_failed = True
+        cb, self.failure_callback = self.failure_callback, None
+        if cb is not None:
+            cb()
+
+    def check_health(self) -> None:
+        if self.is_failed:
+            raise RuntimeError("Executor failed.")
+        self.collective_rpc("check_health", timeout=10.0)
+
+    def shutdown(self) -> None:
+        pass

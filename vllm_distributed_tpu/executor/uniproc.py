@@ -1,0 +1,41 @@
+"""Single-process executor: one in-process Worker owning all local chips.
+
+The degenerate topology (parity config 1-3: single host).  TP across the
+host's chips needs no RPC at all — the mesh lives in this process and XLA
+drives all chips from one Python thread, which is precisely why the
+TPU-native design collapses the reference's process-per-GPU fan-out
+(SURVEY.md §2.5 "no TPU analog of one process per GPU").
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Any
+
+from vllm_distributed_tpu.executor.abstract import Executor
+from vllm_distributed_tpu.utils import run_method
+from vllm_distributed_tpu.worker.worker import Worker
+
+
+class UniProcExecutor(Executor):
+    def _init_executor(self) -> None:
+        self.worker = Worker(self.config, rank=0, is_driver_worker=True)
+        self.collective_rpc("init_device")
+        self.collective_rpc("load_model")
+
+    def collective_rpc(
+        self,
+        method: str,
+        args: tuple = (),
+        kwargs: dict | None = None,
+        *,
+        unique_reply_rank: int | None = None,
+        non_block: bool = False,
+        timeout: float | None = None,
+    ) -> Any:
+        result = run_method(self.worker, method, args, kwargs or {})
+        if non_block:
+            fut: concurrent.futures.Future = concurrent.futures.Future()
+            fut.set_result(result)
+            return fut
+        return result if unique_reply_rank is not None else [result]

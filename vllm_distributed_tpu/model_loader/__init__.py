@@ -1,0 +1,3 @@
+from vllm_distributed_tpu.model_loader.loader import get_model, load_hf_weights
+
+__all__ = ["get_model", "load_hf_weights"]

@@ -1,0 +1,151 @@
+"""Model construction + HF safetensors → sharded jax arrays.
+
+The TPU analog of the reference's `collective_rpc("load_model")` step
+(launch.py:292, SURVEY.md §5.4): weights come from a local HF snapshot
+(safetensors shards), are read tensor-by-tensor on host, mapped through
+the model's ``map_hf_name`` table, and placed onto the device mesh with
+the model's ``partition_specs`` — each host materializes only its own
+shard bytes when a mesh is given (host-parallel load).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.models import get_model_class
+
+logger = init_logger(__name__)
+
+
+def resolve_model_dir(model: str) -> str:
+    """Local dir, or an HF-hub snapshot already present in the cache."""
+    if os.path.isdir(model):
+        return model
+    cache = os.environ.get(
+        "HF_HUB_CACHE",
+        os.path.join(
+            os.environ.get(
+                "HF_HOME", os.path.expanduser("~/.cache/huggingface")
+            ),
+            "hub",
+        ),
+    )
+    repo_dir = os.path.join(cache, "models--" + model.replace("/", "--"))
+    snapshots = sorted(glob.glob(os.path.join(repo_dir, "snapshots", "*")))
+    if snapshots:
+        return snapshots[-1]
+    raise FileNotFoundError(
+        f"model {model!r} is neither a local directory nor a cached HF "
+        f"snapshot (no network egress; pre-download weights)"
+    )
+
+
+def _set_path(tree: dict, path: tuple, value) -> None:
+    node = tree
+    for key in path[:-1]:
+        if isinstance(key, int):
+            node = node[key]
+        else:
+            node = node.setdefault(key, {})
+    node[path[-1]] = value
+
+
+def _sharding_for(path: tuple, specs: dict | None, mesh: Mesh | None):
+    if mesh is None:
+        return None
+    spec = P()
+    if specs is not None:
+        node: Any = specs
+        try:
+            for key in path:
+                node = node[key]
+            spec = node
+        except (KeyError, IndexError, TypeError):
+            spec = P()
+    return NamedSharding(mesh, spec)
+
+
+def load_hf_weights(
+    model,
+    model_dir: str,
+    *,
+    mesh: Mesh | None = None,
+    dtype: Any = None,
+) -> dict:
+    """Stream every tensor of every *.safetensors shard into the param
+    tree.  Layer-norm/bias params keep float32 precision headroom is not
+    needed — everything is cast to the model dtype."""
+    from safetensors import safe_open
+
+    dtype = jnp.dtype(dtype or model.dtype)
+    files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no *.safetensors under {model_dir}")
+    specs = model.partition_specs() if hasattr(model, "partition_specs") else None
+
+    params: dict = {"layers": [{} for _ in range(model.num_layers)]}
+    start = time.monotonic()
+    n = 0
+    cpu = jax.devices("cpu")[0]
+    for file in files:
+        with safe_open(file, framework="flax") as f:
+            for name in f.keys():
+                mapped = model.map_hf_name(name)
+                if mapped is None:
+                    continue
+                path, transform = mapped
+                with jax.default_device(cpu):
+                    tensor = f.get_tensor(name)
+                    if transform == "T":
+                        tensor = tensor.T
+                    tensor = tensor.astype(dtype)
+                sharding = _sharding_for(path, specs, mesh)
+                if sharding is not None:
+                    tensor = jax.device_put(tensor, sharding)
+                _set_path(params, path, tensor)
+                n += 1
+    logger.info(
+        "loaded %d tensors from %d shard(s) in %.1fs",
+        n,
+        len(files),
+        time.monotonic() - start,
+    )
+    return params
+
+
+def get_model(
+    model_config,
+    *,
+    load_format: str = "auto",
+    mesh: Mesh | None = None,
+    rng: jax.Array | None = None,
+) -> tuple[Any, dict]:
+    """Build (model, params).  load_format: "auto" reads safetensors,
+    "dummy" random-initializes (tests, perf smoke)."""
+    cls = get_model_class(model_config.architecture)
+    model = cls(model_config)
+    if load_format == "dummy":
+        rng = rng if rng is not None else jax.random.PRNGKey(model_config.seed)
+        params = model.init_params(rng)
+        if mesh is not None:
+            specs = model.partition_specs()
+            # tree.map flattens `specs` up to the structure of `params`, so
+            # each PartitionSpec (a tuple subclass) arrives whole as `s`.
+            params = jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                params,
+                specs,
+            )
+        return model, params
+    model_dir = resolve_model_dir(model_config.model)
+    params = load_hf_weights(model, model_dir, mesh=mesh)
+    return model, params

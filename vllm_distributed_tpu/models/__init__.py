@@ -1,0 +1,3 @@
+from vllm_distributed_tpu.models.registry import get_model_class
+
+__all__ = ["get_model_class"]

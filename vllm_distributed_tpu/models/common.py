@@ -1,0 +1,95 @@
+"""Shared functional building blocks for the model zoo.
+
+Models here are *functions over pytrees*, not stateful modules: params are
+nested dicts of jax arrays, the forward pass is pure, and everything
+composes with jit/pjit/NamedSharding (SURVEY.md §7 design stance).  Linear
+weights are stored input-major (``[in, out]``) so application is a plain
+``x @ w`` that XLA tiles onto the MXU without transposes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * weight.astype(jnp.float32)).astype(dtype)
+
+
+def layer_norm(
+    x: jax.Array, weight: jax.Array, bias: jax.Array, eps: float
+) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + eps)
+    return (x * weight.astype(jnp.float32) + bias.astype(jnp.float32)).astype(
+        dtype
+    )
+
+
+def linear(x: jax.Array, w: jax.Array, b: jax.Array | None = None) -> jax.Array:
+    out = x @ w.astype(x.dtype)
+    if b is not None:
+        out = out + b.astype(out.dtype)
+    return out
+
+
+def rope_frequencies(
+    head_dim: int,
+    theta: float,
+    *,
+    rope_scaling: dict | None = None,
+) -> jax.Array:
+    """Inverse frequencies [head_dim // 2], with llama3/linear scaling."""
+    inv_freq = 1.0 / (
+        theta
+        ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    if not rope_scaling:
+        return inv_freq
+    rtype = rope_scaling.get("rope_type", rope_scaling.get("type", ""))
+    if rtype == "linear":
+        return inv_freq / float(rope_scaling["factor"])
+    if rtype == "llama3":
+        factor = float(rope_scaling["factor"])
+        lo = float(rope_scaling.get("low_freq_factor", 1.0))
+        hi = float(rope_scaling.get("high_freq_factor", 4.0))
+        orig = float(rope_scaling.get("original_max_position_embeddings", 8192))
+        wavelen = 2.0 * jnp.pi / inv_freq
+        ratio = orig / wavelen
+        smooth = jnp.clip((ratio - lo) / (hi - lo), 0.0, 1.0)
+        scaled = jnp.where(
+            wavelen > orig / lo,  # low-frequency band: full scaling
+            inv_freq / factor,
+            jnp.where(
+                wavelen < orig / hi,  # high-frequency band: no scaling
+                inv_freq,
+                (1.0 - smooth) * inv_freq / factor + smooth * inv_freq,
+            ),
+        )
+        return scaled
+    # Unknown scaling types fall back to unscaled (logged by the loader).
+    return inv_freq
+
+
+def apply_rope(
+    x: jax.Array,  # [T, H, D]
+    positions: jax.Array,  # [T]
+    inv_freq: jax.Array,  # [D // 2]
+) -> jax.Array:
+    """HF-llama convention: rotate_half over the (front, back) halves."""
+    angles = positions[:, None].astype(jnp.float32) * inv_freq[None, :]
+    cos = jnp.cos(angles)[:, None, :]  # [T, 1, D/2]
+    sin = jnp.sin(angles)[:, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)

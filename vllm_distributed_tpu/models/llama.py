@@ -1,0 +1,237 @@
+"""Llama-family decoder (Llama 1/2/3, Mistral, Qwen2, Qwen3-dense).
+
+The flagship model family of the parity configs (BASELINE.md: Llama-2-7B /
+13B / 70B).  One implementation covers the variants via config switches:
+GQA (num_key_value_heads), attention biases (Qwen2), per-head QK RMS-norm
+(Qwen3), rope scaling (Llama-3), tied embeddings.
+
+Functional style: ``init_params`` builds the pytree, ``forward`` is pure
+and jit-safe.  Tensor parallelism is expressed purely as NamedSharding
+partition specs over the mesh's "tp" axis (``partition_specs``); XLA/GSPMD
+inserts the all-reduces after the row-parallel projections — the
+TPU-native replacement for the reference's NCCL all-reduce inside vLLM
+workers (SURVEY.md §2.2, §2.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from vllm_distributed_tpu.models.common import (
+    apply_rope,
+    linear,
+    rms_norm,
+    rope_frequencies,
+)
+from vllm_distributed_tpu.ops.attention import (
+    AttentionMetadata,
+    paged_attention_reference,
+    write_kv_pages,
+)
+
+
+class LlamaForCausalLM:
+    architectures = (
+        "LlamaForCausalLM",
+        "MistralForCausalLM",
+        "Qwen2ForCausalLM",
+        "Qwen3ForCausalLM",
+    )
+
+    def __init__(self, model_config: Any) -> None:
+        hf = model_config.hf_config
+        self.model_type = hf.model_type
+        self.num_layers = model_config.get_num_layers()
+        self.hidden_size = model_config.get_hidden_size()
+        self.num_heads = model_config.get_num_attention_heads()
+        self.num_kv_heads = model_config.get_num_kv_heads()
+        self.head_dim = model_config.get_head_dim()
+        self.intermediate_size = hf.intermediate_size
+        self.vocab_size = hf.vocab_size
+        self.rope_theta = float(getattr(hf, "rope_theta", 10000.0))
+        self.rope_scaling = getattr(hf, "rope_scaling", None)
+        self.rms_eps = float(getattr(hf, "rms_norm_eps", 1e-6))
+        # Qwen2 carries q/k/v biases; Llama/Mistral/Qwen3 do not.
+        self.attn_bias = bool(
+            getattr(hf, "attention_bias", self.model_type == "qwen2")
+        )
+        self.qk_norm = self.model_type == "qwen3"
+        self.tie_embeddings = bool(getattr(hf, "tie_word_embeddings", False))
+        self.dtype = jnp.dtype(model_config.dtype)
+        self.scale = self.head_dim**-0.5
+
+    # ---- params ----
+    def init_params(self, rng: jax.Array) -> dict:
+        """Random init (tests / --load-format dummy)."""
+        h, nh, nkv, d, im, v = (
+            self.hidden_size,
+            self.num_heads,
+            self.num_kv_heads,
+            self.head_dim,
+            self.intermediate_size,
+            self.vocab_size,
+        )
+
+        def nrm(key, shape):
+            return (jax.random.normal(key, shape, jnp.float32) * 0.02).astype(
+                self.dtype
+            )
+
+        keys = iter(jax.random.split(rng, 7 * self.num_layers + 3))
+        layers = []
+        for _ in range(self.num_layers):
+            layer = {
+                "input_ln": jnp.ones((h,), self.dtype),
+                "post_attn_ln": jnp.ones((h,), self.dtype),
+                "wq": nrm(next(keys), (h, nh * d)),
+                "wk": nrm(next(keys), (h, nkv * d)),
+                "wv": nrm(next(keys), (h, nkv * d)),
+                "wo": nrm(next(keys), (nh * d, h)),
+                "gate": nrm(next(keys), (h, im)),
+                "up": nrm(next(keys), (h, im)),
+                "down": nrm(next(keys), (im, h)),
+            }
+            if self.attn_bias:
+                layer["bq"] = jnp.zeros((nh * d,), self.dtype)
+                layer["bk"] = jnp.zeros((nkv * d,), self.dtype)
+                layer["bv"] = jnp.zeros((nkv * d,), self.dtype)
+            if self.qk_norm:
+                layer["q_norm"] = jnp.ones((d,), self.dtype)
+                layer["k_norm"] = jnp.ones((d,), self.dtype)
+            layers.append(layer)
+        params = {
+            "embed": nrm(next(keys), (v, h)),
+            "layers": layers,
+            "norm": jnp.ones((h,), self.dtype),
+        }
+        if not self.tie_embeddings:
+            params["lm_head"] = nrm(next(keys), (h, v))
+        return params
+
+    def map_hf_name(self, name: str):
+        """HF safetensors name -> (param path, 'T' to transpose) or None.
+
+        HF reference layout: model.layers.{i}.self_attn.{q,k,v,o}_proj etc.
+        """
+        if name == "model.embed_tokens.weight":
+            return ("embed",), None
+        if name == "model.norm.weight":
+            return ("norm",), None
+        if name == "lm_head.weight":
+            if self.tie_embeddings:
+                return None
+            return ("lm_head",), "T"
+        if not name.startswith("model.layers."):
+            return None
+        parts = name.split(".")
+        i = int(parts[2])
+        rest = ".".join(parts[3:])
+        table = {
+            "self_attn.q_proj.weight": ("wq", "T"),
+            "self_attn.k_proj.weight": ("wk", "T"),
+            "self_attn.v_proj.weight": ("wv", "T"),
+            "self_attn.o_proj.weight": ("wo", "T"),
+            "self_attn.q_proj.bias": ("bq", None),
+            "self_attn.k_proj.bias": ("bk", None),
+            "self_attn.v_proj.bias": ("bv", None),
+            "self_attn.q_norm.weight": ("q_norm", None),
+            "self_attn.k_norm.weight": ("k_norm", None),
+            "mlp.gate_proj.weight": ("gate", "T"),
+            "mlp.up_proj.weight": ("up", "T"),
+            "mlp.down_proj.weight": ("down", "T"),
+            "input_layernorm.weight": ("input_ln", None),
+            "post_attention_layernorm.weight": ("post_attn_ln", None),
+        }
+        hit = table.get(rest)
+        if hit is None:
+            return None
+        return ("layers", i, hit[0]), hit[1]
+
+    def partition_specs(self) -> dict:
+        """PartitionSpecs mirroring the param tree, for the mesh "tp" axis.
+
+        Column-parallel (out-dim sharded): wq/wk/wv/gate/up + lm_head;
+        row-parallel (in-dim sharded): wo/down — GSPMD inserts the psum.
+        """
+        layer = {
+            "input_ln": P(),
+            "post_attn_ln": P(),
+            "wq": P(None, "tp"),
+            "wk": P(None, "tp"),
+            "wv": P(None, "tp"),
+            "wo": P("tp", None),
+            "gate": P(None, "tp"),
+            "up": P(None, "tp"),
+            "down": P("tp", None),
+        }
+        if self.attn_bias:
+            layer.update({"bq": P("tp"), "bk": P("tp"), "bv": P("tp")})
+        if self.qk_norm:
+            layer.update({"q_norm": P(), "k_norm": P()})
+        specs = {
+            "embed": P(None, "tp"),
+            "layers": [dict(layer) for _ in range(self.num_layers)],
+            "norm": P(),
+        }
+        if not self.tie_embeddings:
+            specs["lm_head"] = P(None, "tp")
+        return specs
+
+    def kv_cache_spec(self) -> P:
+        """KV pages [P, page, Hkv, D]: shard kv heads over tp."""
+        return P(None, None, "tp", None)
+
+    # ---- forward ----
+    def forward(
+        self,
+        params: dict,
+        token_ids: jax.Array,  # [T]
+        kv_caches: list,  # per layer (k_pages, v_pages)
+        meta: AttentionMetadata,
+        attn_fn: Callable = paged_attention_reference,
+    ) -> tuple[jax.Array, list]:
+        """Returns (logits [S, V] at meta.logits_indices, updated kv)."""
+        x = params["embed"][token_ids].astype(self.dtype)
+        inv_freq = rope_frequencies(
+            self.head_dim, self.rope_theta, rope_scaling=self.rope_scaling
+        )
+        new_kv = []
+        t = token_ids.shape[0]
+        for layer, (k_pages, v_pages) in zip(params["layers"], kv_caches):
+            h = rms_norm(x, layer["input_ln"], self.rms_eps)
+            q = linear(h, layer["wq"], layer.get("bq"))
+            k = linear(h, layer["wk"], layer.get("bk"))
+            v = linear(h, layer["wv"], layer.get("bv"))
+            q = q.reshape(t, self.num_heads, self.head_dim)
+            k = k.reshape(t, self.num_kv_heads, self.head_dim)
+            v = v.reshape(t, self.num_kv_heads, self.head_dim)
+            if self.qk_norm:
+                q = rms_norm(q, layer["q_norm"], self.rms_eps)
+                k = rms_norm(k, layer["k_norm"], self.rms_eps)
+            q = apply_rope(q, meta.q_positions, inv_freq)
+            k = apply_rope(k, meta.q_positions, inv_freq)
+            k_pages, v_pages = write_kv_pages(
+                k_pages, v_pages, k, v, meta.slot_mapping
+            )
+            new_kv.append((k_pages, v_pages))
+            attn = attn_fn(q, k_pages, v_pages, meta, scale=self.scale)
+            x = x + linear(attn.reshape(t, -1), layer["wo"])
+
+            h = rms_norm(x, layer["post_attn_ln"], self.rms_eps)
+            gated = jax.nn.silu(linear(h, layer["gate"])) * linear(
+                h, layer["up"]
+            )
+            x = x + linear(gated, layer["down"])
+
+        x = rms_norm(x, params["norm"], self.rms_eps)
+        sel = x[meta.logits_indices]  # [S, H]
+        lm_head = params.get("lm_head")
+        if lm_head is None:
+            logits = sel @ params["embed"].T.astype(sel.dtype)
+        else:
+            logits = sel @ lm_head.astype(sel.dtype)
+        return logits.astype(jnp.float32), new_kv

@@ -1,0 +1,46 @@
+"""Architecture-name → model-class registry (the model-zoo dispatch the
+reference delegates to the vllm package's registry, SURVEY.md §2.3)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+_REGISTRY: dict[str, Any] = {}
+
+
+def register_model(cls) -> Any:
+    for arch in cls.architectures:
+        _REGISTRY[arch] = cls
+    return cls
+
+
+def _populate() -> None:
+    if _REGISTRY:
+        return
+    from vllm_distributed_tpu.models.llama import LlamaForCausalLM
+    from vllm_distributed_tpu.models.opt import OPTForCausalLM
+
+    register_model(LlamaForCausalLM)
+    register_model(OPTForCausalLM)
+    try:
+        from vllm_distributed_tpu.models.mixtral import MixtralForCausalLM
+
+        register_model(MixtralForCausalLM)
+    except ImportError:
+        pass
+
+
+def get_model_class(architecture: str):
+    _populate()
+    try:
+        return _REGISTRY[architecture]
+    except KeyError:
+        raise ValueError(
+            f"unsupported architecture {architecture!r}; known: "
+            f"{sorted(_REGISTRY)}"
+        ) from None
+
+
+def list_architectures() -> list[str]:
+    _populate()
+    return sorted(_REGISTRY)

@@ -1,0 +1,1 @@
+"""TPU-native compute ops: attention (reference + Pallas), sampling, rotary."""

@@ -1,0 +1,126 @@
+"""Paged attention over a block-paged KV cache — pure-JAX reference path.
+
+This is the TPU-native equivalent of the CUDA PagedAttention kernels the
+reference inherits from the vLLM image (SURVEY.md §2.2).  The layout
+contract shared by the allocator (engine/block_manager.py), the model
+runner's KV scatter, and the kernels:
+
+- KV pool: ``k_pages``/``v_pages`` of shape ``[num_pages, page_size,
+  num_kv_heads, head_dim]``; token ``t`` of a request lives at flat slot
+  ``page_ids[t // page_size] * page_size + t % page_size``.
+- A step's work is a flat token batch ``[T]`` spanning mixed prefill
+  chunks and decodes; ``q_seq_ids``/``q_positions`` say which sequence and
+  absolute position each query token has.
+
+Everything is static-shape and jit-friendly: padding tokens carry
+``q_seq_ids`` pointing at padded sequence rows whose ``seq_lens`` is 0, so
+their attention rows are garbage that is never read.  The fast path is the
+Pallas kernel in ops/pallas/; this reference is the correctness oracle
+(tested against each other, SURVEY.md §4.2) and the CPU fallback.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class AttentionMetadata:
+    """Per-step attention inputs shared by every layer.
+
+    Shapes (all padded to bucketed static sizes):
+      q_seq_ids:      [T] int32  — row of each query token in the seq batch
+      q_positions:    [T] int32  — absolute position of each query token
+      slot_mapping:   [T] int32  — flat KV slot each token's K/V is written to
+                                   (padding tokens point into reserved page 0)
+      block_tables:   [S, max_pages] int32 — page ids per sequence (0-padded)
+      seq_lens:       [S] int32  — total context length per sequence
+                                   (computed + scheduled this step; 0 = pad row)
+      logits_indices: [S] int32  — flat token index whose hidden state is
+                                   sampled for each sequence
+    """
+
+    q_seq_ids: jax.Array
+    q_positions: jax.Array
+    slot_mapping: jax.Array
+    block_tables: jax.Array
+    seq_lens: jax.Array
+    logits_indices: jax.Array
+
+
+def write_kv_pages(
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    slot_mapping: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter this step's K/V ([T, Hkv, D]) into the paged pool.
+
+    Works on the flat [num_pages * page_size, Hkv, D] view; with the cache
+    donated to the jitted step, XLA performs this in place in HBM.
+    """
+    num_pages, page_size, hkv, d = k_pages.shape
+    flat_k = k_pages.reshape(num_pages * page_size, hkv, d)
+    flat_v = v_pages.reshape(num_pages * page_size, hkv, d)
+    flat_k = flat_k.at[slot_mapping].set(k.astype(flat_k.dtype))
+    flat_v = flat_v.at[slot_mapping].set(v.astype(flat_v.dtype))
+    return (
+        flat_k.reshape(num_pages, page_size, hkv, d),
+        flat_v.reshape(num_pages, page_size, hkv, d),
+    )
+
+
+@partial(jax.jit, static_argnames=("scale", "soft_cap"))
+def paged_attention_reference(
+    q: jax.Array,  # [T, Hq, D]
+    k_pages: jax.Array,  # [P, page_size, Hkv, D]
+    v_pages: jax.Array,  # [P, page_size, Hkv, D]
+    metadata: AttentionMetadata,
+    *,
+    scale: float,
+    soft_cap: float | None = None,
+) -> jax.Array:
+    """Causal attention of flat query tokens against their sequences' paged
+    KV history.  O(T × max_ctx) with full gathers — the oracle, not the
+    fast path."""
+    t, hq, d = q.shape
+    _, page_size, hkv, _ = k_pages.shape
+    s, max_pages = metadata.block_tables.shape
+    groups = hq // hkv
+    max_ctx = max_pages * page_size
+
+    # Gather each sequence's KV: [S, max_ctx, Hkv, D].
+    k_all = k_pages[metadata.block_tables].reshape(s, max_ctx, hkv, d)
+    v_all = v_pages[metadata.block_tables].reshape(s, max_ctx, hkv, d)
+
+    # Per query token, its sequence's KV: [T, max_ctx, Hkv, D].
+    k_tok = k_all[metadata.q_seq_ids]
+    v_tok = v_all[metadata.q_seq_ids]
+
+    qg = q.reshape(t, hkv, groups, d).astype(jnp.float32)
+    scores = jnp.einsum(
+        "thgd,tchd->thgc", qg, k_tok.astype(jnp.float32)
+    ) * scale  # [T, Hkv, G, C]
+    if soft_cap is not None:
+        scores = jnp.tanh(scores / soft_cap) * soft_cap
+
+    ctx_pos = jnp.arange(max_ctx, dtype=jnp.int32)
+    valid = ctx_pos[None, :] <= metadata.q_positions[:, None]  # causal
+    valid &= ctx_pos[None, :] < metadata.seq_lens[metadata.q_seq_ids][:, None]
+    scores = jnp.where(valid[:, None, None, :], scores, DEFAULT_MASK_VALUE)
+
+    scores = scores - jnp.max(scores, axis=-1, keepdims=True)
+    probs = jnp.exp(scores)
+    denom = jnp.sum(probs, axis=-1, keepdims=True)
+    probs = probs / jnp.maximum(denom, 1e-30)
+
+    out = jnp.einsum("thgc,tchd->thgd", probs, v_tok.astype(jnp.float32))
+    return out.reshape(t, hq, d).astype(q.dtype)

@@ -1,0 +1,130 @@
+"""Vectorized, jit-compiled token sampling.
+
+The TPU-native equivalent of the FlashInfer/CUDA sampling path the
+reference inherits (SURVEY.md §2.2: "Pallas/XLA top-k/top-p sampling").
+Every per-request knob in SamplingParams lowers to a row of a dense array,
+so one compiled program samples the whole step batch — no per-request
+Python in the hot loop.
+
+Static specialization flags (`do_penalties`, `do_top_k_p`, `return_logprobs`)
+keep the common greedy/temperature-only path free of the [S, V] sort.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class SamplingMetadata:
+    """Per-sequence sampling state, padded to the step's [S] bucket.
+
+    temperature == 0 selects greedy for that row.  `top_k` uses vocab_size
+    to mean "disabled"; `output_tokens`/`prompt_tokens` are only populated
+    (non-empty second dim) when penalties are active — they are [S, L]
+    token-id arrays padded with -1, used to build count matrices in-jit.
+    """
+
+    temperature: jax.Array  # [S] f32
+    top_k: jax.Array  # [S] i32
+    top_p: jax.Array  # [S] f32
+    min_p: jax.Array  # [S] f32
+    repetition_penalty: jax.Array  # [S] f32
+    presence_penalty: jax.Array  # [S] f32
+    frequency_penalty: jax.Array  # [S] f32
+    keys: jax.Array  # [S, 2] uint32 per-row PRNG keys
+    prompt_tokens: jax.Array  # [S, Lp] i32, -1 padded
+    output_tokens: jax.Array  # [S, Lo] i32, -1 padded
+
+
+def _token_counts(tokens: jax.Array, vocab_size: int) -> jax.Array:
+    """[S, L] padded token ids (-1 pad) -> [S, V] counts via scatter-add."""
+    s = tokens.shape[0]
+    # Route padding to an extra trash column, then drop it.
+    idx = jnp.where(tokens < 0, vocab_size, tokens)
+    counts = jnp.zeros((s, vocab_size + 1), dtype=jnp.float32)
+    rows = jnp.broadcast_to(jnp.arange(s)[:, None], tokens.shape)
+    counts = counts.at[rows, idx].add(1.0)
+    return counts[:, :vocab_size]
+
+
+def _apply_penalties(logits: jax.Array, meta: SamplingMetadata) -> jax.Array:
+    vocab = logits.shape[-1]
+    prompt_counts = _token_counts(meta.prompt_tokens, vocab)
+    output_counts = _token_counts(meta.output_tokens, vocab)
+    # Repetition penalty applies to every token seen (prompt + output).
+    seen = (prompt_counts + output_counts) > 0
+    rp = meta.repetition_penalty[:, None]
+    logits = jnp.where(
+        seen, jnp.where(logits > 0, logits / rp, logits * rp), logits
+    )
+    # Presence/frequency apply to generated tokens only (OpenAI semantics).
+    logits = logits - meta.frequency_penalty[:, None] * output_counts
+    logits = logits - meta.presence_penalty[:, None] * (output_counts > 0)
+    return logits
+
+
+def _apply_top_k_p(logits: jax.Array, meta: SamplingMetadata) -> jax.Array:
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    sort_idx = jnp.argsort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    ranks = jnp.arange(logits.shape[-1], dtype=jnp.int32)[None, :]
+    keep = ranks < meta.top_k[:, None]
+    # Keep tokens until cumulative prob crosses top_p (first always kept).
+    keep &= (cum - probs) < meta.top_p[:, None]
+    keep &= probs >= meta.min_p[:, None] * probs[:, :1]
+    # Scatter the sorted-order mask back to vocab order.
+    rows = jnp.broadcast_to(
+        jnp.arange(logits.shape[0])[:, None], sort_idx.shape
+    )
+    keep_orig = jnp.zeros_like(keep).at[rows, sort_idx].set(keep)
+    return jnp.where(keep_orig, logits, _NEG_INF)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("do_penalties", "do_top_k_p", "return_logprobs"),
+)
+def sample(
+    logits: jax.Array,  # [S, V] f32
+    meta: SamplingMetadata,
+    *,
+    do_penalties: bool = False,
+    do_top_k_p: bool = False,
+    return_logprobs: bool = False,
+) -> tuple[jax.Array, jax.Array | None]:
+    """Returns (token_ids [S], logprobs [S, V] or None).
+
+    Logprobs are of the penalized pre-truncation distribution at
+    temperature 1 — the distribution the model "meant" — matching what the
+    OpenAI API reports.
+    """
+    logits = logits.astype(jnp.float32)
+    if do_penalties:
+        logits = _apply_penalties(logits, meta)
+
+    logprobs = jax.nn.log_softmax(logits, axis=-1) if return_logprobs else None
+
+    greedy = jnp.argmax(logits, axis=-1)
+
+    temp = jnp.maximum(meta.temperature, 1e-6)[:, None]
+    scaled = logits / temp
+    if do_top_k_p:
+        scaled = _apply_top_k_p(scaled, meta)
+
+    def _one(key_pair, row):
+        key = jax.random.fold_in(jax.random.PRNGKey(key_pair[0]), key_pair[1])
+        return jax.random.categorical(key, row)
+
+    sampled = jax.vmap(_one)(meta.keys.astype(jnp.uint32), scaled)
+
+    tokens = jnp.where(meta.temperature > 0, sampled, greedy)
+    return tokens.astype(jnp.int32), logprobs

@@ -1,0 +1,97 @@
+"""Tokenizer access + incremental detokenization.
+
+The engine-side capability the reference delegates to vLLM's tokenizer
+group (SURVEY.md §2.3: EngineClient surface).  ``IncrementalDetokenizer``
+implements streaming-safe decoding: multi-byte/multi-token glyphs are held
+back until complete, and stop strings are matched over the accumulated
+text (stop-string truncation happens here, not in the scheduler).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def get_tokenizer(
+    tokenizer_name: str, trust_remote_code: bool = False
+) -> Any:
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(
+        tokenizer_name, trust_remote_code=trust_remote_code, use_fast=True
+    )
+
+
+class IncrementalDetokenizer:
+    """Per-request streaming detokenizer.
+
+    Decodes with a sliding window of already-emitted tokens (the standard
+    prefix-offset scheme) so byte-level BPE pieces that straddle token
+    boundaries render correctly, and replacement chars at the tail are
+    withheld until resolved.
+    """
+
+    def __init__(
+        self,
+        tokenizer: Any,
+        prompt_token_ids: list[int],
+        *,
+        stop: list[str] | None = None,
+        include_stop_str_in_output: bool = False,
+        skip_special_tokens: bool = True,
+    ) -> None:
+        self.tokenizer = tokenizer
+        self.token_ids: list[int] = list(prompt_token_ids)
+        self.prompt_len = len(prompt_token_ids)
+        self.stop = stop or []
+        self.include_stop = include_stop_str_in_output
+        self.skip_special = skip_special_tokens
+        # Offsets into self.token_ids for the incremental window.
+        self.prefix_offset = max(self.prompt_len - 6, 0)
+        self.read_offset = self.prompt_len
+        self.output_text = ""
+        self.stopped_on: str | None = None
+        # Stop-string scan cursor: text before this offset was already
+        # checked (keeps per-token matching O(new text), not O(total)).
+        self._stop_scanned = 0
+        self._max_stop_len = max((len(s) for s in self.stop), default=0)
+
+    def append(self, token_ids: list[int]) -> str:
+        """Feed newly sampled tokens; returns the newly finalized text.
+        Sets ``stopped_on`` when a stop string is hit (output_text is then
+        already truncated per include_stop_str_in_output)."""
+        new_text = ""
+        for tok in token_ids:
+            self.token_ids.append(tok)
+            prefix = self.tokenizer.decode(
+                self.token_ids[self.prefix_offset : self.read_offset],
+                skip_special_tokens=self.skip_special,
+            )
+            full = self.tokenizer.decode(
+                self.token_ids[self.prefix_offset :],
+                skip_special_tokens=self.skip_special,
+            )
+            if len(full) > len(prefix) and not full.endswith("�"):
+                delta = full[len(prefix) :]
+                self.prefix_offset = self.read_offset
+                self.read_offset = len(self.token_ids)
+                self.output_text += delta
+                new_text += delta
+                hit = self._check_stop()
+                if hit is not None:
+                    self.stopped_on = hit
+                    return new_text
+        return new_text
+
+    def _check_stop(self) -> str | None:
+        if not self.stop:
+            return None
+        start = max(self._stop_scanned - (self._max_stop_len - 1), 0)
+        for s in self.stop:
+            idx = self.output_text.find(s, start)
+            if idx != -1:
+                end = idx + (len(s) if self.include_stop else 0)
+                self.output_text = self.output_text[:end]
+                return s
+        self._stop_scanned = len(self.output_text)
+        return None

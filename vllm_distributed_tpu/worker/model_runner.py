@@ -1,0 +1,404 @@
+"""Model runner: SchedulerOutput → one fused, jitted device step.
+
+Replaces the reference's GPU model runner (driven via
+`collective_rpc("execute_model")`, launch.py:322-343) with a TPU-first
+design (SURVEY.md §7): the whole step — embedding, every layer, paged KV
+scatter, attention, and sampling — is ONE compiled XLA program with the KV
+cache donated, so steady state is a single dispatch per scheduler step and
+no per-layer host round trips.
+
+Static-shape discipline (XLA compiles per shape): token count, sequence
+count, pages-per-seq, and penalty-history lengths are padded to
+power-of-two buckets, so the number of distinct compiled programs stays
+logarithmic in batch size (SURVEY.md §7 hard part #2).
+
+Workers mirror request state (token ids, page tables, cursors) so each
+step's input is only the scheduler's delta — the control-plane economy the
+reference gets by shipping SchedulerOutput, not tensors (SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from vllm_distributed_tpu.config import EngineConfig
+from vllm_distributed_tpu.engine.scheduler import SchedulerOutput
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.model_loader import get_model
+from vllm_distributed_tpu.ops.attention import (
+    AttentionMetadata,
+    paged_attention_reference,
+)
+from vllm_distributed_tpu.ops.sampling import SamplingMetadata, sample
+from vllm_distributed_tpu.outputs import ModelRunnerOutput
+from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.utils import cdiv, next_power_of_2
+
+logger = init_logger(__name__)
+
+_MIN_TOKEN_BUCKET = 16
+_MIN_SEQ_BUCKET = 8
+_MIN_PAGES_BUCKET = 8
+
+
+@dataclass
+class CachedReqState:
+    req_id: str
+    token_ids: list[int]  # prompt + everything sampled so far
+    sampling_params: SamplingParams
+    page_ids: list[int]
+    num_computed: int
+    prefill_target: int  # sample only once computed tokens reach this
+    num_prompt: int  # true prompt/output boundary (stable across preemption)
+
+
+def _needs_top_k_p(sp: SamplingParams) -> bool:
+    return sp.top_k > 0 or sp.top_p < 1.0 or sp.min_p > 0.0
+
+
+def _needs_penalties(sp: SamplingParams) -> bool:
+    return (
+        sp.repetition_penalty != 1.0
+        or sp.presence_penalty != 0.0
+        or sp.frequency_penalty != 0.0
+    )
+
+
+class ModelRunner:
+    def __init__(
+        self,
+        config: EngineConfig,
+        mesh: Any = None,
+        attn_backend: str = "auto",
+    ) -> None:
+        self.config = config
+        self.mesh = mesh
+        self.page_size = config.cache_config.page_size
+        self.global_seed = config.model_config.seed
+        self.model = None
+        self.params = None
+        self.kv_caches: list | None = None
+        self.requests: dict[str, CachedReqState] = {}
+        self.attn_backend = attn_backend
+        self._attn_fn = None
+        self._rep_spec = None  # replicated sharding for step inputs
+
+    # ---- lifecycle (the collective_rpc verbs, launch.py:290-292) ----
+    def load_model(self, load_format: str = "auto") -> None:
+        self.model, self.params = get_model(
+            self.config.model_config, load_format=load_format, mesh=self.mesh
+        )
+        self._attn_fn = self._pick_attn_fn()
+        if self.mesh is not None:
+            self._rep_spec = NamedSharding(self.mesh, P())
+
+    def _pick_attn_fn(self):
+        backend = self.attn_backend
+        if backend == "auto":
+            backend = (
+                "pallas" if jax.default_backend() == "tpu" else "reference"
+            )
+        if backend == "pallas":
+            try:
+                from vllm_distributed_tpu.ops.pallas.paged_attention import (
+                    paged_attention,
+                )
+
+                return paged_attention
+            except ImportError:
+                logger.warning("pallas backend unavailable; using reference")
+        return paged_attention_reference
+
+    def kv_cache_bytes_per_page(self) -> int:
+        m = self.model
+        dtype_size = jnp.dtype(m.dtype).itemsize
+        return (
+            m.num_layers
+            * 2
+            * self.page_size
+            * m.num_kv_heads
+            * m.head_dim
+            * dtype_size
+        )
+
+    def profile_num_pages(self) -> int:
+        """Derive the KV pool size from free HBM (the analog of
+        gpu_memory_utilization profiling in the inherited engine)."""
+        cc = self.config.cache_config
+        if cc.num_pages is not None:
+            return cc.num_pages
+        dev = jax.local_devices()[0]
+        stats = getattr(dev, "memory_stats", lambda: None)()
+        if not stats or "bytes_limit" not in stats:
+            return 512  # CPU / no stats: small default for tests
+        limit = int(stats["bytes_limit"] * cc.hbm_utilization)
+        in_use = int(stats.get("bytes_in_use", 0))
+        free = max(limit - in_use, 0)
+        shards = 1
+        if self.mesh is not None and "tp" in self.mesh.shape:
+            shards = self.mesh.shape["tp"]
+        per_device_page = self.kv_cache_bytes_per_page() // shards
+        num_pages = max(free // max(per_device_page, 1), 16)
+        logger.info(
+            "KV pool: %d pages × %d tokens (%.2f GiB of %.2f GiB free HBM)",
+            num_pages,
+            self.page_size,
+            num_pages * per_device_page / 2**30,
+            free / 2**30,
+        )
+        return int(num_pages)
+
+    def init_kv_cache(self, num_pages: int) -> None:
+        m = self.model
+        self.num_pages = num_pages
+        shape = (num_pages, self.page_size, m.num_kv_heads, m.head_dim)
+        sharding = None
+        if self.mesh is not None:
+            sharding = NamedSharding(self.mesh, m.kv_cache_spec())
+
+        def alloc():
+            z = jnp.zeros(shape, m.dtype)
+            return jax.device_put(z, sharding) if sharding is not None else z
+
+        self.kv_caches = [(alloc(), alloc()) for _ in range(m.num_layers)]
+
+    # ---- per-step state mirroring ----
+    def _apply_scheduler_deltas(self, so: SchedulerOutput) -> None:
+        for req_id in so.finished_req_ids:
+            self.requests.pop(req_id, None)
+        for req_id in so.preempted_req_ids:
+            self.requests.pop(req_id, None)
+        for new in so.new_requests:
+            self.requests[new.req_id] = CachedReqState(
+                req_id=new.req_id,
+                token_ids=list(new.prompt_token_ids),
+                sampling_params=new.sampling_params,
+                page_ids=list(new.page_ids),
+                num_computed=new.num_computed_tokens,
+                prefill_target=len(new.prompt_token_ids),
+                num_prompt=new.num_prompt_tokens,
+            )
+        for cached in so.cached_requests:
+            state = self.requests[cached.req_id]
+            state.page_ids.extend(cached.new_page_ids)
+            state.num_computed = cached.num_computed_tokens
+            if cached.resumed_token_ids:
+                state.token_ids.extend(cached.resumed_token_ids)
+
+    # ---- the step ----
+    def execute_model(self, so: SchedulerOutput) -> ModelRunnerOutput:
+        self._apply_scheduler_deltas(so)
+        if so.is_empty:
+            return ModelRunnerOutput()
+
+        order = [c.req_id for c in so.cached_requests] + [
+            n.req_id for n in so.new_requests
+        ]
+        states = [self.requests[r] for r in order]
+        num_new = [so.num_scheduled_tokens[r] for r in order]
+
+        t_real = sum(num_new)
+        s_real = len(order)
+        t_pad = max(next_power_of_2(t_real), _MIN_TOKEN_BUCKET)
+        s_pad = max(next_power_of_2(s_real), _MIN_SEQ_BUCKET)
+        max_pages = max(
+            max((len(st.page_ids) for st in states), default=1), 1
+        )
+        pages_pad = max(next_power_of_2(max_pages), _MIN_PAGES_BUCKET)
+
+        tokens = np.zeros(t_pad, np.int32)
+        positions = np.zeros(t_pad, np.int32)
+        seq_ids = np.full(t_pad, s_pad - 1, np.int32)
+        slots = np.zeros(t_pad, np.int32)
+        block_tables = np.zeros((s_pad, pages_pad), np.int32)
+        seq_lens = np.zeros(s_pad, np.int32)
+        logits_idx = np.zeros(s_pad, np.int32)
+        needs_sample = [False] * s_real
+
+        cursor = 0
+        for s, (state, n) in enumerate(zip(states, num_new)):
+            lo, hi = state.num_computed, state.num_computed + n
+            ids = state.token_ids[lo:hi]
+            tokens[cursor : cursor + n] = ids
+            pos = np.arange(lo, hi, dtype=np.int32)
+            positions[cursor : cursor + n] = pos
+            seq_ids[cursor : cursor + n] = s
+            page_arr = np.asarray(state.page_ids, np.int32)
+            slots[cursor : cursor + n] = (
+                page_arr[pos // self.page_size] * self.page_size
+                + pos % self.page_size
+            )
+            block_tables[s, : len(state.page_ids)] = page_arr
+            seq_lens[s] = hi
+            logits_idx[s] = cursor + n - 1
+            needs_sample[s] = hi >= state.prefill_target
+            cursor += n
+
+        meta = AttentionMetadata(
+            q_seq_ids=jnp.asarray(seq_ids),
+            q_positions=jnp.asarray(positions),
+            slot_mapping=jnp.asarray(slots),
+            block_tables=jnp.asarray(block_tables),
+            seq_lens=jnp.asarray(seq_lens),
+            logits_indices=jnp.asarray(logits_idx),
+        )
+
+        smeta, flags = self._build_sampling_metadata(states, s_pad)
+
+        if self._rep_spec is not None:
+            meta = jax.tree.map(
+                lambda x: jax.device_put(x, self._rep_spec), meta
+            )
+            smeta = jax.tree.map(
+                lambda x: jax.device_put(x, self._rep_spec), smeta
+            )
+
+        sampled, logprobs, self.kv_caches = self._jit_step(
+            self.params,
+            self.kv_caches,
+            jnp.asarray(tokens),
+            meta,
+            smeta,
+            **flags,
+        )
+
+        sampled = np.asarray(jax.device_get(sampled))
+        if logprobs is not None:
+            logprobs = np.asarray(jax.device_get(logprobs))
+
+        out = ModelRunnerOutput()
+        for s, (state, n) in enumerate(zip(states, num_new)):
+            state.num_computed += n
+            if not needs_sample[s]:
+                out.num_prompt_tokens_processed[state.req_id] = n
+                continue
+            tok = int(sampled[s])
+            state.token_ids.append(tok)
+            out.sampled_token_ids[state.req_id] = [tok]
+            nlp = state.sampling_params.logprobs
+            if nlp is not None and logprobs is not None:
+                row = logprobs[s]
+                top = np.argpartition(row, -max(nlp, 1))[-max(nlp, 1) :]
+                d = {int(i): float(row[i]) for i in top}
+                d[tok] = float(row[tok])
+                out.logprobs[state.req_id] = [d]
+        return out
+
+    def _build_sampling_metadata(
+        self, states: list[CachedReqState], s_pad: int
+    ) -> tuple[SamplingMetadata, dict]:
+        vocab = self.model.vocab_size
+        temp = np.zeros(s_pad, np.float32)
+        top_k = np.full(s_pad, vocab, np.int32)
+        top_p = np.ones(s_pad, np.float32)
+        min_p = np.zeros(s_pad, np.float32)
+        rep = np.ones(s_pad, np.float32)
+        pres = np.zeros(s_pad, np.float32)
+        freq = np.zeros(s_pad, np.float32)
+        keys = np.zeros((s_pad, 2), np.uint32)
+        do_pen = False
+        do_tkp = False
+        want_lp = False
+        for s, st in enumerate(states):
+            sp = st.sampling_params
+            temp[s] = sp.temperature
+            if sp.top_k > 0:
+                top_k[s] = sp.top_k
+            top_p[s] = sp.top_p
+            min_p[s] = sp.min_p
+            rep[s] = sp.repetition_penalty
+            pres[s] = sp.presence_penalty
+            freq[s] = sp.frequency_penalty
+            seed = sp.seed if sp.seed is not None else self.global_seed
+            keys[s, 0] = np.uint32(
+                (seed ^ zlib.crc32(st.req_id.encode())) & 0xFFFFFFFF
+            )
+            keys[s, 1] = np.uint32(len(st.token_ids))
+            do_pen |= _needs_penalties(sp)
+            do_tkp |= _needs_top_k_p(sp)
+            want_lp |= sp.logprobs is not None
+
+        if do_pen:
+            lp = max(
+                next_power_of_2(max(st.num_prompt for st in states)),
+                _MIN_TOKEN_BUCKET,
+            )
+            lo = max(
+                next_power_of_2(
+                    max(len(st.token_ids) - st.num_prompt for st in states)
+                    + 1
+                ),
+                _MIN_TOKEN_BUCKET,
+            )
+            prompt_toks = np.full((s_pad, lp), -1, np.int32)
+            output_toks = np.full((s_pad, lo), -1, np.int32)
+            for s, st in enumerate(states):
+                p = st.token_ids[: st.num_prompt][:lp]
+                o = st.token_ids[st.num_prompt :][:lo]
+                prompt_toks[s, : len(p)] = p
+                output_toks[s, : len(o)] = o
+        else:
+            prompt_toks = np.full((s_pad, 1), -1, np.int32)
+            output_toks = np.full((s_pad, 1), -1, np.int32)
+
+        smeta = SamplingMetadata(
+            temperature=jnp.asarray(temp),
+            top_k=jnp.asarray(top_k),
+            top_p=jnp.asarray(top_p),
+            min_p=jnp.asarray(min_p),
+            repetition_penalty=jnp.asarray(rep),
+            presence_penalty=jnp.asarray(pres),
+            frequency_penalty=jnp.asarray(freq),
+            keys=jnp.asarray(keys),
+            prompt_tokens=jnp.asarray(prompt_toks),
+            output_tokens=jnp.asarray(output_toks),
+        )
+        flags = dict(
+            do_penalties=do_pen,
+            do_top_k_p=do_tkp,
+            return_logprobs=want_lp,
+        )
+        return smeta, flags
+
+    @partial(
+        jax.jit,
+        static_argnames=(
+            "self",
+            "do_penalties",
+            "do_top_k_p",
+            "return_logprobs",
+        ),
+        donate_argnums=(2,),
+    )
+    def _jit_step(
+        self,
+        params,
+        kv_caches,
+        token_ids,
+        meta: AttentionMetadata,
+        smeta: SamplingMetadata,
+        *,
+        do_penalties: bool,
+        do_top_k_p: bool,
+        return_logprobs: bool,
+    ):
+        logits, kv_caches = self.model.forward(
+            params, token_ids, kv_caches, meta, attn_fn=self._attn_fn
+        )
+        tokens, logprobs = sample(
+            logits,
+            smeta,
+            do_penalties=do_penalties,
+            do_top_k_p=do_top_k_p,
+            return_logprobs=return_logprobs,
+        )
+        return tokens, logprobs, kv_caches

@@ -1,0 +1,96 @@
+"""Worker: owns the chips of one host and runs the model step.
+
+The TPU analog of the vLLM worker the reference drives through
+WorkerWrapperBase with string-dispatched lifecycle methods —
+init_worker/init_device/load_model/execute_model/check_health
+(launch.py:290-292, 329-343, 387; SURVEY.md §2.3).  One process per TPU
+host owning all local chips (SURVEY.md §7 design stance), vs. the
+reference's process-per-GPU.
+
+All methods here are reachable by string name via ``run_method`` — that
+is the executor's collective_rpc contract.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from vllm_distributed_tpu.config import EngineConfig
+from vllm_distributed_tpu.engine.scheduler import SchedulerOutput
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.outputs import ModelRunnerOutput
+from vllm_distributed_tpu.worker.model_runner import ModelRunner
+
+logger = init_logger(__name__)
+
+
+class Worker:
+    def __init__(
+        self,
+        config: EngineConfig,
+        rank: int = 0,
+        local_rank: int = 0,
+        distributed_init_method: str | None = None,
+        is_driver_worker: bool = True,
+    ) -> None:
+        self.config = config
+        self.rank = rank
+        self.local_rank = local_rank
+        self.distributed_init_method = distributed_init_method
+        self.is_driver_worker = is_driver_worker
+        self.mesh = None
+        self.runner: ModelRunner | None = None
+
+    # ---- lifecycle RPCs ----
+    def init_device(self) -> None:
+        """Join the distributed world (multi-host: jax.distributed over DCN,
+        the analog of the torch/NCCL rendezvous at launch.py:94) and build
+        the device mesh."""
+        pc = self.config.parallel_config
+        if pc.num_hosts > 1 and self.distributed_init_method:
+            jax.distributed.initialize(
+                coordinator_address=self.distributed_init_method,
+                num_processes=pc.num_hosts,
+                process_id=self.rank,
+            )
+        if pc.world_size > 1:
+            from vllm_distributed_tpu.distributed.mesh import build_mesh
+
+            self.mesh = build_mesh(pc)
+        logger.info(
+            "worker rank=%d devices=%d backend=%s",
+            self.rank,
+            jax.local_device_count(),
+            jax.default_backend(),
+        )
+
+    def load_model(self, load_format: str | None = None) -> None:
+        self.runner = ModelRunner(self.config, mesh=self.mesh)
+        self.runner.load_model(
+            load_format=load_format or self.config.model_config.load_format
+        )
+
+    def determine_num_pages(self) -> int:
+        return self.runner.profile_num_pages()
+
+    def initialize_cache(self, num_pages: int) -> None:
+        self.runner.init_kv_cache(num_pages)
+
+    def execute_model(self, scheduler_output: SchedulerOutput) -> ModelRunnerOutput | None:
+        out = self.runner.execute_model(scheduler_output)
+        return out if self.is_driver_worker else None
+
+    def check_health(self) -> bool:
+        return True
+
+    def profile(self, action: str, profile_dir: str | None = None) -> None:
+        if action == "start":
+            jax.profiler.start_trace(
+                profile_dir
+                or self.config.observability_config.profile_dir
+                or "/tmp/vdt_profile"
+            )
+        else:
+            jax.profiler.stop_trace()
