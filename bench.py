@@ -1,0 +1,103 @@
+"""Throughput bench — prints ONE JSON line for the driver.
+
+Measures steady-state decode throughput (tokens/sec/chip) of the engine's
+fused step on a Llama-1B-shaped model with dummy bf16 weights, batch 32,
+on whatever backend is live (the real TPU chip under the driver).  The
+reference publishes no numbers (BASELINE.md: "published": {}), so
+vs_baseline is reported as 1.0 by convention.
+
+Env knobs: VDT_BENCH_MODEL=1b|7b|tiny, VDT_BENCH_BATCH, VDT_BENCH_STEPS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import jax
+
+    from vllm_distributed_tpu.config import EngineArgs
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+    from vllm_distributed_tpu.testing import (
+        LLAMA_1B,
+        LLAMA_7B,
+        write_llama_config,
+    )
+
+    which = os.environ.get("VDT_BENCH_MODEL", "1b")
+    shapes = {"1b": LLAMA_1B, "7b": LLAMA_7B}.get(which)
+    if shapes is None:
+        shapes = dict(
+            vocab_size=1024, hidden=256, intermediate=512, layers=4,
+            heads=8, kv_heads=4, dtype="float32",
+        )
+    if jax.default_backend() == "cpu" and which in ("1b", "7b"):
+        # CPU smoke fallback: the big shapes would take minutes to compile.
+        shapes = dict(
+            vocab_size=1024, hidden=256, intermediate=512, layers=4,
+            heads=8, kv_heads=4, dtype="float32",
+        )
+    batch = int(os.environ.get("VDT_BENCH_BATCH", "32"))
+    decode_steps = int(os.environ.get("VDT_BENCH_STEPS", "64"))
+    prompt_len = 32
+
+    model_dir = write_llama_config(**shapes)
+    engine = LLMEngine.from_engine_args(
+        EngineArgs(
+            model=model_dir,
+            skip_tokenizer_init=True,
+            load_format="dummy",
+            max_num_seqs=batch,
+            max_num_batched_tokens=max(2048, batch * prompt_len),
+            max_model_len=prompt_len + decode_steps + 8,
+        )
+    )
+    sp = SamplingParams(
+        temperature=0.0, max_tokens=decode_steps, ignore_eos=True
+    )
+    for i in range(batch):
+        prompt = [(7 * i + j) % 1000 + 1 for j in range(prompt_len)]
+        engine.add_request(f"b{i}", prompt_token_ids=prompt, sampling_params=sp)
+
+    # Prefill + warmup decode steps (compile happens here).
+    engine.step()
+    for _ in range(3):
+        engine.step()
+
+    t0 = time.perf_counter()
+    steps = 0
+    tokens = 0
+    while engine.has_unfinished_requests():
+        outs = engine.step()
+        steps += 1
+        tokens += sum(
+            1 for o in outs if o.outputs and o.outputs[0].token_ids
+        )
+    elapsed = time.perf_counter() - t0
+    # Tokens generated during the timed window: batch per decode step.
+    timed_tokens = steps * batch  # upper bound; all finish together here
+    tps = timed_tokens / elapsed
+    n_chips = jax.local_device_count()
+    result = {
+        "metric": f"decode_tokens_per_sec_per_chip_llama_{which}",
+        "value": round(tps / n_chips, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": 1.0,
+        "detail": {
+            "backend": jax.default_backend(),
+            "batch": batch,
+            "decode_steps": steps,
+            "elapsed_s": round(elapsed, 3),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,291 @@
+"""RPC peer/transport unit tests (SURVEY.md §4 item 1: param, apply,
+oneway, error propagation, finalize/distributed GC, sideband buffers,
+disconnect detection) — in-process with paired transports."""
+
+import asyncio
+import gc
+import multiprocessing
+import pickle
+
+import pytest
+
+from vllm_distributed_tpu.distributed.rpc import RpcPeer, RPCResultError
+from vllm_distributed_tpu.distributed.rpc_transport import (
+    ConnectionRpcTransport,
+    StreamRpcTransport,
+    prepare_peer_readloop,
+)
+
+
+def make_peer_pair():
+    """Two RpcPeers wired directly (serialize → handle_message)."""
+    peers = {}
+
+    def make_send(name):
+        async def send(msg, buffers):
+            # Simulate the wire: the envelope must be picklable.
+            data = pickle.loads(pickle.dumps({"m": msg}))["m"]
+            await peers[name].handle_message(data, buffers)
+
+        return send
+
+    a = RpcPeer(make_send("b"), "a")
+    b = RpcPeer(make_send("a"), "b")
+    peers["a"], peers["b"] = a, b
+    return a, b
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_param_roundtrip():
+    async def go():
+        a, b = make_peer_pair()
+        b.params["greeting"] = "hello"
+        assert await a.get_param("greeting") == "hello"
+        # camelCase alias (reference surface, launch.py:190)
+        assert await a.getParam("greeting") == "hello"
+
+    run(go())
+
+
+def test_param_missing_raises_remote_error():
+    async def go():
+        a, b = make_peer_pair()
+        with pytest.raises(RPCResultError) as ei:
+            await a.get_param("nope")
+        assert "KeyError" in ei.value.name
+
+    run(go())
+
+
+def test_apply_function_and_kwargs():
+    async def go():
+        a, b = make_peer_pair()
+
+        def add(x, y, scale=1):
+            return (x + y) * scale
+
+        b.params["add"] = add
+        proxy = await a.get_param("add")
+        assert await proxy(2, 3) == 5
+        assert await proxy(2, 3, scale=10) == 50
+
+    run(go())
+
+
+def test_apply_async_function():
+    async def go():
+        a, b = make_peer_pair()
+
+        async def work(x):
+            await asyncio.sleep(0)
+            return x * 2
+
+        b.params["work"] = work
+        proxy = await a.get_param("work")
+        assert await proxy(21) == 42
+
+    run(go())
+
+
+def test_object_method_dispatch():
+    async def go():
+        a, b = make_peer_pair()
+
+        class Service:
+            __rpc_proxy__ = True
+
+            def __init__(self):
+                self.calls = []
+
+            def ping(self, tag):
+                self.calls.append(tag)
+                return f"pong-{tag}"
+
+        svc = Service()
+        b.params["svc"] = svc
+        proxy = await a.get_param("svc")
+        assert await proxy.ping("x") == "pong-x"
+        assert svc.calls == ["x"]
+
+    run(go())
+
+
+def test_remote_error_carries_stack():
+    async def go():
+        a, b = make_peer_pair()
+
+        def boom():
+            raise ValueError("kaput")
+
+        b.params["boom"] = boom
+        proxy = await a.get_param("boom")
+        with pytest.raises(RPCResultError) as ei:
+            await proxy()
+        assert ei.value.name == "ValueError"
+        assert "kaput" in ei.value.message
+        assert "boom" in ei.value.remote_stack  # remote frames visible
+
+    run(go())
+
+
+def test_callback_proxying_both_directions():
+    """A callable passed as an argument becomes a proxy callable on the
+    remote side (the create_worker/run_worker pattern, launch.py:238)."""
+
+    async def go():
+        a, b = make_peer_pair()
+        got = []
+
+        async def factory(callback):
+            result = callback("from-b")  # proxy → returns awaitable
+            got.append(await result)
+            return "done"
+
+        b.params["factory"] = factory
+        proxy = await a.get_param("factory")
+
+        def my_cb(msg):
+            return f"a-saw-{msg}"
+
+        assert await proxy(my_cb) == "done"
+        assert got == ["a-saw-from-b"]
+
+    run(go())
+
+
+def test_value_passthrough_of_picklable_objects():
+    async def go():
+        a, b = make_peer_pair()
+
+        def echo(x):
+            return x
+
+        b.params["echo"] = echo
+        proxy = await a.get_param("echo")
+        payload = {"nested": [1, 2.5, "s", None, {"k": (1, 2)}]}
+        out = await proxy(payload)
+        assert out["nested"][0] == 1
+        assert out["nested"][4]["k"] == [1, 2] or out["nested"][4]["k"] == (1, 2)
+
+    run(go())
+
+
+def test_sideband_buffers_fifo():
+    async def go():
+        a, b = make_peer_pair()
+
+        def concat(x, y):
+            return x + y
+
+        b.params["concat"] = concat
+        proxy = await a.get_param("concat")
+        # Two buffers in one message must not be swapped (reference LIFO
+        # bug, rpc_reader.py:33-38).
+        out = await proxy(b"first-", b"second")
+        assert out == b"first-second"
+
+    run(go())
+
+
+def test_finalize_releases_remote_object():
+    async def go():
+        a, b = make_peer_pair()
+
+        def handler():
+            return "hi"
+
+        b.params["h"] = handler
+        proxy = await a.get_param("h")
+        assert len(b._local_proxied) == 1
+        del proxy
+        gc.collect()
+        await asyncio.sleep(0.05)  # let the finalize task run
+        assert len(b._local_proxied) == 0
+
+    run(go())
+
+
+def test_kill_fails_pending_and_future_calls():
+    async def go():
+        a, b = make_peer_pair()
+
+        def fn():
+            return 1
+
+        b.params["fn"] = fn
+        proxy = await a.get_param("fn")
+        a.kill("test disconnect")
+        with pytest.raises(RPCResultError):
+            await proxy()
+
+    run(go())
+
+
+def test_tcp_stream_transport_end_to_end():
+    async def go():
+        server_peer_box = {}
+
+        async def on_client(reader, writer):
+            transport = StreamRpcTransport(reader, writer)
+            peer, readloop = prepare_peer_readloop(transport, "server")
+            peer.params["mul"] = lambda x, y: x * y
+            server_peer_box["peer"] = peer
+            await readloop()
+
+        server = await asyncio.start_server(on_client, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        transport = StreamRpcTransport(reader, writer)
+        peer, readloop = prepare_peer_readloop(transport, "client")
+        loop_task = asyncio.ensure_future(readloop())
+
+        mul = await peer.get_param("mul")
+        assert await mul(6, 7) == 42
+
+        # Disconnect detection: closing the client socket EOFs the server
+        # readloop, which kills the server peer and closes its writer,
+        # which in turn EOFs and kills the client peer.
+        writer.close()
+        await asyncio.sleep(0.1)
+        assert server_peer_box["peer"].killed
+        assert peer.killed
+        server.close()
+        loop_task.cancel()
+
+    run(go())
+
+
+def _child_proc(conn):
+    async def main():
+        transport = ConnectionRpcTransport(conn)
+        peer, readloop = prepare_peer_readloop(transport, "child")
+        peer.params["double"] = lambda x: x * 2
+        try:
+            await readloop()
+        except (EOFError, OSError):
+            pass
+
+    asyncio.new_event_loop().run_until_complete(main())
+
+
+def test_pipe_transport_cross_process():
+    async def go():
+        parent_conn, child_conn = multiprocessing.Pipe()
+        proc = multiprocessing.Process(
+            target=_child_proc, args=(child_conn,), daemon=True
+        )
+        proc.start()
+        transport = ConnectionRpcTransport(parent_conn)
+        peer, readloop = prepare_peer_readloop(transport, "parent")
+        loop_task = asyncio.ensure_future(readloop())
+        double = await peer.get_param("double")
+        assert await double(21) == 42
+        proc.terminate()
+        proc.join(timeout=5)
+        loop_task.cancel()
+
+    run(go())

@@ -1,0 +1,135 @@
+"""RPC transports + wire format.
+
+Framing matches the reference's (rpc_reader.py:73-82, 117-125, 155-164):
+``4-byte big-endian length | 1 type byte | payload`` where type 0 is a
+pickled message dict and type 1 is a raw sideband buffer.  Buffers
+precede the message that references them and are attached FIFO
+(rpc_reader.py's LIFO pop is a known quirk we do not reproduce —
+SURVEY.md "known reference quirks").
+
+Transports:
+- ``StreamRpcTransport``  — asyncio TCP, cloudpickle payloads: the
+  cross-host path (reference RpcPickleStreamTransport,
+  rpc_reader.py:146-181).
+- ``ConnectionRpcTransport`` — multiprocessing.Pipe with a reader thread:
+  the driver↔local-worker path (reference RpcConnectionTransport,
+  rpc_reader.py:184-206).
+
+``prepare_peer_readloop`` glues a transport to an RpcPeer with a
+mutex-serialized writer (rpc_reader.py:229-239) and returns
+(peer, readloop); the read loop ending (EOF/error) kills the peer — that
+is the disconnect-detection contract (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any
+
+import cloudpickle
+
+from vllm_distributed_tpu.distributed.rpc import RpcPeer
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+_MSG = 0
+_BUF = 1
+_HEADER = struct.Struct(">IB")
+
+
+class RpcTransport:
+    async def read(self) -> tuple[int, bytes]:
+        raise NotImplementedError
+
+    async def write(self, kind: int, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class StreamRpcTransport(RpcTransport):
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+    ) -> None:
+        self.reader = reader
+        self.writer = writer
+
+    async def read(self) -> tuple[int, bytes]:
+        header = await self.reader.readexactly(_HEADER.size)
+        length, kind = _HEADER.unpack(header)
+        payload = await self.reader.readexactly(length)
+        return kind, payload
+
+    async def write(self, kind: int, payload: bytes) -> None:
+        self.writer.write(_HEADER.pack(len(payload), kind) + payload)
+        await self.writer.drain()
+
+    def close(self) -> None:
+        try:
+            self.writer.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class ConnectionRpcTransport(RpcTransport):
+    """multiprocessing.Connection; reads run on the default thread-pool
+    executor so the event loop never blocks (reference runs a dedicated
+    read thread, rpc_reader.py:209-223)."""
+
+    def __init__(self, connection: Any) -> None:
+        self.connection = connection
+
+    async def read(self) -> tuple[int, bytes]:
+        loop = asyncio.get_running_loop()
+        data = await loop.run_in_executor(None, self.connection.recv_bytes)
+        kind = data[0]
+        return kind, data[1:]
+
+    async def write(self, kind: int, payload: bytes) -> None:
+        self.connection.send_bytes(bytes([kind]) + payload)
+
+    def close(self) -> None:
+        try:
+            self.connection.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def prepare_peer_readloop(
+    transport: RpcTransport,
+    peer_name: str = "peer",
+    pickler: Any = cloudpickle,
+):
+    """Returns (peer, readloop).  Run ``await readloop()`` until
+    disconnect; it kills the peer on exit."""
+    write_lock = asyncio.Lock()
+
+    async def send(msg: dict, buffers: list[bytes]) -> None:
+        async with write_lock:
+            for buf in buffers:
+                await transport.write(_BUF, buf)
+            await transport.write(_MSG, pickler.dumps(msg))
+
+    peer = RpcPeer(send, peer_name)
+
+    async def readloop() -> None:
+        pending_buffers: list[bytes] = []
+        try:
+            while True:
+                kind, payload = await transport.read()
+                if kind == _BUF:
+                    pending_buffers.append(payload)
+                    continue
+                msg = pickler.loads(payload)
+                buffers, pending_buffers = pending_buffers, []
+                await peer.handle_message(msg, buffers)
+        finally:
+            peer.kill(f"{peer_name}: connection closed")
+            transport.close()
+
+    return peer, readloop

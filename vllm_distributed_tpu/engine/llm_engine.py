@@ -109,6 +109,7 @@ class LLMEngine:
                 include_stop_str_in_output=(
                     sampling_params.include_stop_str_in_output
                 ),
+                min_tokens=sampling_params.min_tokens,
             )
 
     def abort_request(self, request_id: str) -> None:

@@ -55,10 +55,6 @@ class CachedRequestData:
     new_page_ids: list[int]
     num_computed_tokens: int
     num_new_tokens: int
-    # Tokens the worker hasn't seen yet (sampled on the driver side between
-    # steps, e.g. after preemption resume); usually empty because workers
-    # append the tokens they sample themselves.
-    resumed_token_ids: list[int] | None = None
 
 
 @dataclass

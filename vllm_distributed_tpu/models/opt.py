@@ -82,10 +82,13 @@ class OPTForCausalLM:
             "embed_pos": nrm(
                 next(keys), (self.max_positions + _POS_OFFSET, h)
             ),
-            "final_ln_w": jnp.ones((h,), self.dtype),
-            "final_ln_b": jnp.zeros((h,), self.dtype),
             "layers": layers,
         }
+        # HF OPT only has a decoder-level final LN when pre-LN (opt-350m
+        # ships none and applies none).
+        if self.do_layer_norm_before:
+            params["final_ln_w"] = jnp.ones((h,), self.dtype)
+            params["final_ln_b"] = jnp.zeros((h,), self.dtype)
         if self.word_embed_dim != h:
             params["project_in"] = nrm(next(keys), (self.word_embed_dim, h))
             params["project_out"] = nrm(next(keys), (h, self.word_embed_dim))
@@ -152,10 +155,11 @@ class OPTForCausalLM:
         specs = {
             "embed": P(None, None),
             "embed_pos": P(),
-            "final_ln_w": P(),
-            "final_ln_b": P(),
             "layers": [dict(layer) for _ in range(self.num_layers)],
         }
+        if self.do_layer_norm_before:
+            specs["final_ln_w"] = P()
+            specs["final_ln_b"] = P()
         if self.word_embed_dim != self.hidden_size:
             specs["project_in"] = P()
             specs["project_out"] = P()
@@ -222,7 +226,10 @@ class OPTForCausalLM:
                     x, layer["final_ln_w"], layer["final_ln_b"], self.eps
                 )
 
-        x = layer_norm(x, params["final_ln_w"], params["final_ln_b"], self.eps)
+        if "final_ln_w" in params:
+            x = layer_norm(
+                x, params["final_ln_w"], params["final_ln_b"], self.eps
+            )
         if "project_out" in params:
             x = linear(x, params["project_out"])
         sel = x[meta.logits_indices]

@@ -1,0 +1,64 @@
+"""Synthetic model snapshots for tests, dryruns, and benches.
+
+Zero-egress environments can't download checkpoints, so anything that
+needs a model builds one: a config.json on disk (weights come from
+--load-format dummy) shaped like the real family member it stands in for.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+
+def write_llama_config(
+    dirname: str | None = None,
+    *,
+    vocab_size: int = 128,
+    hidden: int = 64,
+    intermediate: int = 128,
+    layers: int = 2,
+    heads: int = 8,
+    kv_heads: int = 4,
+    max_pos: int = 2048,
+    dtype: str = "float32",
+    tie_embeddings: bool = False,
+) -> str:
+    """Write a Llama-architecture config.json; returns the directory."""
+    if dirname is None:
+        dirname = tempfile.mkdtemp(prefix="vdt_tiny_llama_")
+    cfg = {
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": "llama",
+        "hidden_size": hidden,
+        "intermediate_size": intermediate,
+        "num_hidden_layers": layers,
+        "num_attention_heads": heads,
+        "num_key_value_heads": kv_heads,
+        "head_dim": hidden // heads,
+        "vocab_size": vocab_size,
+        "max_position_embeddings": max_pos,
+        "rms_norm_eps": 1e-6,
+        "rope_theta": 10000.0,
+        "torch_dtype": dtype,
+        "tie_word_embeddings": tie_embeddings,
+        "hidden_act": "silu",
+        "bos_token_id": 1,
+        "eos_token_id": 2,
+    }
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "config.json"), "w") as f:
+        json.dump(cfg, f)
+    return dirname
+
+
+# Shapes of real family members, for dummy-weight perf runs.
+LLAMA_1B = dict(
+    vocab_size=32000, hidden=2048, intermediate=8192, layers=16,
+    heads=32, kv_heads=8, max_pos=4096, dtype="bfloat16",
+)
+LLAMA_7B = dict(
+    vocab_size=32000, hidden=4096, intermediate=11008, layers=32,
+    heads=32, kv_heads=32, max_pos=4096, dtype="bfloat16",
+)

@@ -39,6 +39,7 @@ class IncrementalDetokenizer:
         stop: list[str] | None = None,
         include_stop_str_in_output: bool = False,
         skip_special_tokens: bool = True,
+        min_tokens: int = 0,
     ) -> None:
         self.tokenizer = tokenizer
         self.token_ids: list[int] = list(prompt_token_ids)
@@ -51,6 +52,10 @@ class IncrementalDetokenizer:
         self.read_offset = self.prompt_len
         self.output_text = ""
         self.stopped_on: str | None = None
+        # Stop strings are suppressed until min_tokens have been generated
+        # (matching the token-level min_tokens gate in Request.check_stop).
+        self.min_tokens = min_tokens
+        self._tokens_seen = 0
         # Stop-string scan cursor: text before this offset was already
         # checked (keeps per-token matching O(new text), not O(total)).
         self._stop_scanned = 0
@@ -63,6 +68,7 @@ class IncrementalDetokenizer:
         new_text = ""
         for tok in token_ids:
             self.token_ids.append(tok)
+            self._tokens_seen += 1
             prefix = self.tokenizer.decode(
                 self.token_ids[self.prefix_offset : self.read_offset],
                 skip_special_tokens=self.skip_special,
@@ -84,7 +90,7 @@ class IncrementalDetokenizer:
         return new_text
 
     def _check_stop(self) -> str | None:
-        if not self.stop:
+        if not self.stop or self._tokens_seen < self.min_tokens:
             return None
         start = max(self._stop_scanned - (self._max_stop_len - 1), 0)
         for s in self.stop:

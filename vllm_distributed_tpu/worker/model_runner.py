@@ -90,7 +90,10 @@ class ModelRunner:
         self.requests: dict[str, CachedReqState] = {}
         self.attn_backend = attn_backend
         self._attn_fn = None
-        self._rep_spec = None  # replicated sharding for step inputs
+        # Input sharding (set at load): step inputs shard their leading
+        # dim over the mesh's "dp" axis; with dp=1 they are replicated.
+        self._input_spec = None
+        self._dp = 1
 
     # ---- lifecycle (the collective_rpc verbs, launch.py:290-292) ----
     def load_model(self, load_format: str = "auto") -> None:
@@ -99,7 +102,14 @@ class ModelRunner:
         )
         self._attn_fn = self._pick_attn_fn()
         if self.mesh is not None:
-            self._rep_spec = NamedSharding(self.mesh, P())
+            self._dp = self.mesh.shape.get("dp", 1)
+            if self._dp & (self._dp - 1):
+                raise ValueError(
+                    f"dp axis size must be a power of 2, got {self._dp} "
+                    "(power-of-two shape buckets must stay divisible)"
+                )
+            axis = "dp" if self._dp > 1 else None
+            self._input_spec = NamedSharding(self.mesh, P(axis))
 
     def _pick_attn_fn(self):
         backend = self.attn_backend
@@ -191,8 +201,6 @@ class ModelRunner:
             state = self.requests[cached.req_id]
             state.page_ids.extend(cached.new_page_ids)
             state.num_computed = cached.num_computed_tokens
-            if cached.resumed_token_ids:
-                state.token_ids.extend(cached.resumed_token_ids)
 
     # ---- the step ----
     def execute_model(self, so: SchedulerOutput) -> ModelRunnerOutput:
@@ -208,8 +216,10 @@ class ModelRunner:
 
         t_real = sum(num_new)
         s_real = len(order)
-        t_pad = max(next_power_of_2(t_real), _MIN_TOKEN_BUCKET)
-        s_pad = max(next_power_of_2(s_real), _MIN_SEQ_BUCKET)
+        # dp is a power of two (validated at load), so power-of-two buckets
+        # at least dp wide stay divisible for the dp input sharding.
+        t_pad = max(next_power_of_2(t_real), _MIN_TOKEN_BUCKET, self._dp)
+        s_pad = max(next_power_of_2(s_real), _MIN_SEQ_BUCKET, self._dp)
         max_pages = max(
             max((len(st.page_ids) for st in states), default=1), 1
         )
@@ -253,19 +263,18 @@ class ModelRunner:
         )
 
         smeta, flags = self._build_sampling_metadata(states, s_pad)
+        token_ids = jnp.asarray(tokens)
 
-        if self._rep_spec is not None:
-            meta = jax.tree.map(
-                lambda x: jax.device_put(x, self._rep_spec), meta
-            )
-            smeta = jax.tree.map(
-                lambda x: jax.device_put(x, self._rep_spec), smeta
-            )
+        if self.mesh is not None:
+            spec = self._input_spec
+            token_ids = jax.device_put(token_ids, spec)
+            meta = jax.tree.map(lambda x: jax.device_put(x, spec), meta)
+            smeta = jax.tree.map(lambda x: jax.device_put(x, spec), smeta)
 
         sampled, logprobs, self.kv_caches = self._jit_step(
             self.params,
             self.kv_caches,
-            jnp.asarray(tokens),
+            token_ids,
             meta,
             smeta,
             **flags,
@@ -287,6 +296,7 @@ class ModelRunner:
             nlp = state.sampling_params.logprobs
             if nlp is not None and logprobs is not None:
                 row = logprobs[s]
+                nlp = min(nlp, row.shape[-1] - 1)
                 top = np.argpartition(row, -max(nlp, 1))[-max(nlp, 1) :]
                 d = {int(i): float(row[i]) for i in top}
                 d[tok] = float(row[tok])
