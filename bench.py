@@ -72,13 +72,9 @@ def main() -> None:
 
     t0 = time.perf_counter()
     steps = 0
-    tokens = 0
     while engine.has_unfinished_requests():
-        outs = engine.step()
+        engine.step()
         steps += 1
-        tokens += sum(
-            1 for o in outs if o.outputs and o.outputs[0].token_ids
-        )
     elapsed = time.perf_counter() - t0
     # Tokens generated during the timed window: batch per decode step.
     timed_tokens = steps * batch  # upper bound; all finish together here

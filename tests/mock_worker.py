@@ -1,0 +1,55 @@
+"""Accelerator-free mock worker for control-plane tests (SURVEY.md §4
+item 4: exercise executor topology, lifecycle ordering, reply-rank
+selection, env replication, and failure propagation without chips)."""
+
+from __future__ import annotations
+
+import os
+
+from vllm_distributed_tpu.outputs import ModelRunnerOutput
+
+
+class MockWorker:
+    def __init__(
+        self,
+        config,
+        rank: int = 0,
+        local_rank: int = 0,
+        distributed_init_method: str | None = None,
+        is_driver_worker: bool = True,
+    ) -> None:
+        self.config = config
+        self.rank = rank
+        self.distributed_init_method = distributed_init_method
+        self.is_driver_worker = is_driver_worker
+        self.calls: list[str] = []
+
+    def init_device(self) -> None:
+        self.calls.append("init_device")
+
+    def load_model(self, load_format=None) -> None:
+        self.calls.append("load_model")
+
+    def determine_num_pages(self) -> int:
+        # Different per rank so min() aggregation is observable.
+        return 100 + self.rank
+
+    def initialize_cache(self, num_pages: int) -> None:
+        self.num_pages = num_pages
+
+    def execute_model(self, scheduler_output) -> ModelRunnerOutput | None:
+        if not self.is_driver_worker:
+            return None
+        out = ModelRunnerOutput()
+        for req_id in scheduler_output.num_scheduled_tokens:
+            out.sampled_token_ids[req_id] = [42]
+        return out
+
+    def check_health(self) -> bool:
+        return True
+
+    def get_rank_and_env(self, var: str) -> tuple[int, str | None]:
+        return self.rank, os.environ.get(var)
+
+    def get_lifecycle(self) -> list[str]:
+        return list(self.calls)

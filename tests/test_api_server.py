@@ -1,0 +1,256 @@
+"""OpenAI API server integration tests over a real AsyncLLM engine on CPU
+(SURVEY.md §4 item 3: serve a tiny model and hit the OpenAI API)."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from tests.utils import add_tiny_tokenizer, make_tiny_llama
+from vllm_distributed_tpu.config import EngineArgs
+from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+from vllm_distributed_tpu.entrypoints.openai.api_server import (
+    build_app,
+    init_app_state,
+)
+
+
+@pytest.fixture(scope="module")
+def served_app(tmp_path_factory):
+    """Shared engine/state; a FRESH app per call (TestServer freezes the
+    app it serves, so apps are single-use)."""
+    model_dir = make_tiny_llama(str(tmp_path_factory.mktemp("srv")))
+    add_tiny_tokenizer(model_dir)
+    engine = AsyncLLM.from_engine_args(
+        EngineArgs(
+            model=model_dir,
+            num_kv_pages=128,
+            max_model_len=256,
+            max_num_seqs=8,
+        )
+    )
+    state = init_app_state(
+        engine,
+        served_model_name="tiny-llama",
+        tool_call_parser="hermes",
+    )
+    yield lambda: build_app(state)
+    engine.shutdown()
+
+
+def _client_call(make_app, coro_fn):
+    async def go():
+        server = TestServer(make_app())
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_health_version_models(served_app):
+    async def go(client):
+        r = await client.get("/health")
+        assert r.status == 200
+        r = await client.get("/version")
+        assert "version" in await r.json()
+        r = await client.get("/v1/models")
+        data = await r.json()
+        assert data["data"][0]["id"] == "tiny-llama"
+        assert data["data"][0]["max_model_len"] == 256
+        r = await client.get("/metrics")
+        assert r.status == 200
+
+    _client_call(served_app, go)
+
+
+def test_tokenize_roundtrip(served_app):
+    async def go(client):
+        r = await client.post(
+            "/tokenize", json={"prompt": "hello world the cat"}
+        )
+        data = await r.json()
+        assert data["count"] == 4
+        r = await client.post(
+            "/detokenize", json={"tokens": data["tokens"]}
+        )
+        text = (await r.json())["prompt"]
+        assert "hello" in text and "cat" in text
+
+    _client_call(served_app, go)
+
+
+def test_completions_basic(served_app):
+    async def go(client):
+        r = await client.post(
+            "/v1/completions",
+            json={
+                "prompt": "hello world the cat sat",
+                "max_tokens": 6,
+                "temperature": 0,
+                "ignore_eos": True,
+            },
+        )
+        assert r.status == 200, await r.text()
+        data = await r.json()
+        assert data["object"] == "text_completion"
+        assert data["usage"]["completion_tokens"] == 6
+        assert data["choices"][0]["finish_reason"] == "length"
+        assert isinstance(data["choices"][0]["text"], str)
+        return data
+
+    _client_call(served_app, go)
+
+
+def test_completions_token_ids_and_n(served_app):
+    async def go(client):
+        r = await client.post(
+            "/v1/completions",
+            json={
+                "prompt": [3, 4, 5],
+                "n": 2,
+                "max_tokens": 4,
+                "temperature": 0,
+                "ignore_eos": True,
+            },
+        )
+        data = await r.json()
+        assert len(data["choices"]) == 2
+        # Greedy: both samples identical.
+        assert data["choices"][0]["text"] == data["choices"][1]["text"]
+
+    _client_call(served_app, go)
+
+
+def test_completions_streaming(served_app):
+    async def go(client):
+        r = await client.post(
+            "/v1/completions",
+            json={
+                "prompt": "hello world",
+                "max_tokens": 5,
+                "temperature": 0,
+                "ignore_eos": True,
+                "stream": True,
+            },
+        )
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        body = await r.text()
+        events = [
+            line[len("data: ") :]
+            for line in body.splitlines()
+            if line.startswith("data: ")
+        ]
+        assert events[-1] == "[DONE]"
+        finals = [json.loads(e) for e in events[:-1]]
+        assert any(
+            c["finish_reason"] == "length"
+            for e in finals
+            for c in e["choices"]
+        )
+
+    _client_call(served_app, go)
+
+
+def test_chat_completions_and_streaming(served_app):
+    async def go(client):
+        r = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [
+                    {"role": "system", "content": "the cat"},
+                    {"role": "user", "content": "hello world"},
+                ],
+                "max_tokens": 5,
+                "temperature": 0,
+                "ignore_eos": True,
+            },
+        )
+        assert r.status == 200, await r.text()
+        data = await r.json()
+        assert data["object"] == "chat.completion"
+        msg = data["choices"][0]["message"]
+        assert msg["role"] == "assistant"
+        non_stream_text = msg["content"]
+
+        r = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [
+                    {"role": "system", "content": "the cat"},
+                    {"role": "user", "content": "hello world"},
+                ],
+                "max_tokens": 5,
+                "temperature": 0,
+                "ignore_eos": True,
+                "stream": True,
+                "stream_options": {"include_usage": True},
+            },
+        )
+        body = await r.text()
+        events = [
+            line[len("data: ") :]
+            for line in body.splitlines()
+            if line.startswith("data: ")
+        ]
+        assert events[-1] == "[DONE]"
+        chunks = [json.loads(e) for e in events[:-1]]
+        streamed = "".join(
+            c["choices"][0]["delta"].get("content") or ""
+            for c in chunks
+            if c["choices"]
+        )
+        assert streamed == non_stream_text
+        usage_chunks = [c for c in chunks if c.get("usage")]
+        assert usage_chunks and usage_chunks[-1]["usage"]["completion_tokens"] == 5
+
+    _client_call(served_app, go)
+
+
+def test_prompt_too_long_rejected(served_app):
+    async def go(client):
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [3] * 300, "max_tokens": 4},
+        )
+        assert r.status == 400
+        assert "max_model_len" in (await r.json())["message"]
+
+    _client_call(served_app, go)
+
+
+def test_stop_string(served_app):
+    async def go(client):
+        # Find what greedy produces, then stop on its first word.
+        r = await client.post(
+            "/v1/completions",
+            json={
+                "prompt": "hello world the cat sat",
+                "max_tokens": 8,
+                "temperature": 0,
+                "ignore_eos": True,
+            },
+        )
+        full = (await r.json())["choices"][0]["text"]
+        first_word = full.split()[0] if full.split() else None
+        if first_word is None:
+            return
+        r = await client.post(
+            "/v1/completions",
+            json={
+                "prompt": "hello world the cat sat",
+                "max_tokens": 8,
+                "temperature": 0,
+                "ignore_eos": True,
+                "stop": [first_word],
+            },
+        )
+        data = await r.json()
+        assert data["choices"][0]["finish_reason"] == "stop"
+        assert first_word not in data["choices"][0]["text"]
+
+    _client_call(served_app, go)

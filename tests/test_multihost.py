@@ -1,0 +1,108 @@
+"""Multi-host executor over loopback with mock workers (SURVEY.md §4
+item 4: the reference's own topology is fully exercisable on one machine;
+cf. launch.py:549 connecting over loopback)."""
+
+import multiprocessing
+import os
+import time
+
+import pytest
+
+from tests.mock_worker import MockWorker  # noqa: F401 (import check)
+from vllm_distributed_tpu.config import EngineArgs
+from vllm_distributed_tpu.distributed.agent import remote_main
+from vllm_distributed_tpu.engine.scheduler import SchedulerOutput
+from vllm_distributed_tpu.executor.multihost import MultiHostExecutor
+from vllm_distributed_tpu.testing import write_llama_config
+from vllm_distributed_tpu.utils import get_open_port
+
+
+class MockedMultiHostExecutor(MultiHostExecutor):
+    worker_cls = "tests.mock_worker.MockWorker"
+
+
+def _spawn_agent(port):
+    proc = multiprocessing.Process(
+        target=remote_main, args=("127.0.0.1", port), daemon=True
+    )
+    proc.start()
+    return proc
+
+
+@pytest.fixture
+def deployment(tmp_path, monkeypatch):
+    """A 2-host mocked deployment: executor (host 0) + one agent proc."""
+    port = get_open_port()
+    monkeypatch.setenv("VDT_SERVER_PORT", str(port))
+    monkeypatch.setenv("VDT_EXECUTE_MODEL_TIMEOUT_SECONDS", "20")
+    monkeypatch.setenv("VDT_COMPILE_CACHE_DIR", str(tmp_path / "cc"))
+    agent = _spawn_agent(port)
+    model_dir = write_llama_config(str(tmp_path / "m"))
+    config = EngineArgs(
+        model=model_dir,
+        skip_tokenizer_init=True,
+        load_format="dummy",
+        num_hosts=2,
+    ).create_engine_config()
+    executor = MockedMultiHostExecutor(config)
+    yield executor, agent
+    executor.shutdown()
+    if agent.is_alive():
+        agent.terminate()
+    agent.join(timeout=5)
+
+
+def test_boot_and_lifecycle_order(deployment):
+    executor, _ = deployment
+    # Local + remote both ran init_device then load_model, in order.
+    lifecycles = executor.collective_rpc("get_lifecycle")
+    assert len(lifecycles) == 2
+    for lc in lifecycles:
+        assert lc == ["init_device", "load_model"]
+
+
+def test_num_pages_min_aggregation(deployment):
+    executor, _ = deployment
+    # host0 reports 100, host1 reports 101 → min wins.
+    assert executor.determine_num_pages() == 100
+
+
+def test_env_replication(deployment, monkeypatch):
+    executor, _ = deployment
+    # VDT_EXECUTE_MODEL_TIMEOUT_SECONDS was set pre-boot and is in the
+    # registry → must exist on the remote host; ranks must be 0 and 1.
+    replies = executor.collective_rpc(
+        "get_rank_and_env", ("VDT_EXECUTE_MODEL_TIMEOUT_SECONDS",)
+    )
+    assert sorted(r[0] for r in replies) == [0, 1]
+    for rank, value in replies:
+        assert value == "20", f"rank {rank} missing replicated env"
+
+
+def test_execute_model_replies_from_host0_only(deployment):
+    executor, _ = deployment
+    so = SchedulerOutput(
+        step_id=0,
+        num_scheduled_tokens={"r1": 1},
+        total_num_scheduled_tokens=1,
+    )
+    out = executor.execute_model(so)
+    assert out.sampled_token_ids == {"r1": [42]}
+    # Fan-out to all, reply only from designated rank:
+    replies = executor.collective_rpc("execute_model", (so,))
+    assert replies[0] is not None and replies[1] is None
+
+
+def test_agent_loss_fails_executor(deployment):
+    executor, agent = deployment
+    failed = []
+    executor.register_failure_callback(lambda: failed.append(True))
+    agent.terminate()
+    agent.join(timeout=5)
+    deadline = time.time() + 10
+    while not executor.is_failed and time.time() < deadline:
+        time.sleep(0.1)
+    assert executor.is_failed
+    assert failed == [True]
+    with pytest.raises(RuntimeError, match="Executor failed"):
+        executor.collective_rpc("check_health")

@@ -259,6 +259,40 @@ def test_tcp_stream_transport_end_to_end():
     run(go())
 
 
+def test_callback_over_real_transport_no_deadlock():
+    """A remote handler that awaits an RPC back to the caller must not
+    deadlock the readloop (apply handling runs as a task)."""
+
+    async def go():
+        async def on_client(reader, writer):
+            t = StreamRpcTransport(reader, writer)
+            peer, readloop = prepare_peer_readloop(t, "server")
+
+            async def factory(callback):
+                return await callback("ping")
+
+            peer.params["factory"] = factory
+            await readloop()
+
+        server = await asyncio.start_server(on_client, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        peer, readloop = prepare_peer_readloop(
+            StreamRpcTransport(reader, writer), "client"
+        )
+        task = asyncio.ensure_future(readloop())
+        factory = await peer.get_param("factory")
+        result = await asyncio.wait_for(
+            factory(lambda msg: f"echo-{msg}"), timeout=5
+        )
+        assert result == "echo-ping"
+        writer.close()
+        server.close()
+        task.cancel()
+
+    run(go())
+
+
 def _child_proc(conn):
     async def main():
         transport = ConnectionRpcTransport(conn)

@@ -1,0 +1,64 @@
+"""Tool-call parser unit tests (parity: --tool-call-parser qwen3_coder in
+.env.server:11; plugin import hook, launch.py:417-418)."""
+
+import json
+
+from vllm_distributed_tpu.entrypoints.openai.tool_parsers import (
+    ToolParserManager,
+)
+
+
+def test_hermes_parser():
+    parser = ToolParserManager.get("hermes")
+    text = (
+        'thinking...\n<tool_call>\n{"name": "get_weather", '
+        '"arguments": {"city": "SF"}}\n</tool_call>'
+    )
+    content, calls = parser.extract(text)
+    assert content == "thinking..."
+    assert len(calls) == 1
+    assert calls[0]["function"]["name"] == "get_weather"
+    assert json.loads(calls[0]["function"]["arguments"]) == {"city": "SF"}
+
+
+def test_hermes_no_tool_call_passthrough():
+    parser = ToolParserManager.get("hermes")
+    content, calls = parser.extract("just words")
+    assert content == "just words"
+    assert calls == []
+
+
+def test_qwen3_coder_parser():
+    parser = ToolParserManager.get("qwen3_coder")
+    text = (
+        "I'll check.\n<tool_call>\n<function=read_file>\n"
+        "<parameter=path>/tmp/x.txt</parameter>\n"
+        "<parameter=limit>10</parameter>\n"
+        "</function>\n</tool_call>"
+    )
+    content, calls = parser.extract(text)
+    assert content == "I'll check."
+    assert calls[0]["function"]["name"] == "read_file"
+    args = json.loads(calls[0]["function"]["arguments"])
+    assert args == {"path": "/tmp/x.txt", "limit": 10}
+
+
+def test_unknown_parser_rejected():
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown tool parser"):
+        ToolParserManager.get("nope")
+
+
+def test_plugin_import(tmp_path):
+    plugin = tmp_path / "plug.py"
+    plugin.write_text(
+        "from vllm_distributed_tpu.entrypoints.openai.tool_parsers import "
+        "ToolParserManager, ToolParser\n"
+        "@ToolParserManager.register('custom_test')\n"
+        "class P(ToolParser):\n"
+        "    def extract(self, text):\n"
+        "        return text, []\n"
+    )
+    ToolParserManager.import_tool_parser(str(plugin))
+    assert ToolParserManager.get("custom_test") is not None

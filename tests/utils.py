@@ -151,6 +151,41 @@ def make_tiny_opt(
     return tmpdir
 
 
+def add_tiny_tokenizer(model_dir: str) -> str:
+    """Attach a 30-word word-level tokenizer (ids < 30, safe for every
+    tiny model here) loadable via AutoTokenizer, with a trivial chat
+    template so apply_chat_template works."""
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    words = [
+        "<unk>", "<s>", "</s>", "hello", "world", "the", "a", "cat",
+        "dog", "sat", "on", "mat", "run", "jump", "stop", "go", "yes",
+        "no", "maybe", "one", "two", "three", ".", ",", "!", "?", ":",
+        "assistant", "user", "system",
+    ]
+    vocab = {w: i for i, w in enumerate(words)}
+    tok = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = Whitespace()
+    tok.save(os.path.join(model_dir, "tokenizer.json"))
+    cfg = {
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "unk_token": "<unk>",
+        "bos_token": "<s>",
+        "eos_token": "</s>",
+        "model_max_length": 512,
+        "chat_template": (
+            "{% for message in messages %}{{ message['role'] }} : "
+            "{{ message['content'] }} {% endfor %}"
+            "{% if add_generation_prompt %}assistant :{% endif %}"
+        ),
+    }
+    with open(os.path.join(model_dir, "tokenizer_config.json"), "w") as f:
+        json.dump(cfg, f)
+    return model_dir
+
+
 def hf_greedy_generate(model_dir: str, prompt_ids: list[int], max_new: int):
     """Oracle: greedy decode with transformers on torch CPU."""
     import torch

@@ -1,0 +1,150 @@
+"""Remote-host agent ("client" mode): dial the server, offer this host's
+chips, host one worker for the life of the deployment.
+
+The per-host rebuild of the reference's remote-node agent
+(launch.py:543-632, SURVEY.md §2 C2), with the per-GPU process fan-out
+collapsed to one agent per TPU host (§2.5).  Behavior contract kept:
+
+- connect-retry every 10 s while unused (launch.py:583-586);
+- once a worker exists, any disconnect is fatal — exit(1) and let the
+  supervisor restart the host (launch.py:579-581);
+- the agent's ``print`` is exposed as an RPC param so the driver can log
+  remotely (launch.py:556 — genuinely useful, kept);
+- GC pacing every 10 s on the event loop to bound pause times
+  (launch.py:589-594; wired *before* the loop runs, unlike the
+  reference's dead-code path at :597-605).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import gc
+import os
+import sys
+from typing import Any
+
+from vllm_distributed_tpu import envs
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.utils import run_method
+
+logger = init_logger(__name__)
+
+RETRY_SECONDS = 10.0
+GC_INTERVAL_SECONDS = 10.0
+
+
+class WorkerHost:
+    """The object proxied back to the driver: one worker on this host,
+    every lifecycle verb reachable via ``run`` (the executor's
+    collective_rpc contract; cf. WorkerWrapper.run_worker,
+    launch.py:523-541)."""
+
+    __rpc_proxy__ = True
+
+    def __init__(self, worker: Any) -> None:
+        self.worker = worker
+        # Device work blocks; keep RPC handling responsive and calls
+        # ordered with a single-thread pool.
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="vdt-worker"
+        )
+
+    async def run(self, method: str, args: tuple, kwargs: dict) -> Any:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._pool, run_method, self.worker, method, args, kwargs or {}
+        )
+
+
+def _resolve_worker_cls(worker_cls: str | None):
+    if worker_cls is None:
+        from vllm_distributed_tpu.worker.worker import Worker
+
+        return Worker
+    import importlib
+
+    mod, cls = worker_cls.rsplit(".", 1)
+    return getattr(importlib.import_module(mod), cls)
+
+
+async def _gc_pacer() -> None:
+    while True:
+        await asyncio.sleep(GC_INTERVAL_SECONDS)
+        gc.collect()
+
+
+async def agent_async_main(server_ip: str, port: int | None = None) -> None:
+    from vllm_distributed_tpu.distributed.rpc_transport import (
+        StreamRpcTransport,
+        prepare_peer_readloop,
+    )
+
+    port = port or envs.VDT_SERVER_PORT
+    state: dict[str, Any] = {"worker_host": None}
+    gc_task = asyncio.ensure_future(_gc_pacer())
+
+    def host_info() -> dict:
+        import jax
+
+        return {
+            "num_chips": jax.local_device_count(),
+            "platform": jax.default_backend(),
+        }
+
+    async def create_worker(
+        config, rank, num_hosts, distributed_init_method, env, worker_cls
+    ):
+        for key, value in (env or {}).items():
+            os.environ[key] = value
+        cls = _resolve_worker_cls(worker_cls)
+        worker = cls(
+            config,
+            rank=rank,
+            distributed_init_method=distributed_init_method,
+            is_driver_worker=False,
+        )
+        state["worker_host"] = WorkerHost(worker)
+        logger.info("worker created: host rank %d/%d", rank, num_hosts)
+        return state["worker_host"]
+
+    try:
+        while True:
+            try:
+                reader, writer = await asyncio.open_connection(
+                    server_ip, port
+                )
+            except OSError as e:
+                logger.info(
+                    "server %s:%d unreachable (%s); retry in %.0fs",
+                    server_ip,
+                    port,
+                    e,
+                    RETRY_SECONDS,
+                )
+                await asyncio.sleep(RETRY_SECONDS)
+                continue
+            transport = StreamRpcTransport(reader, writer)
+            peer, readloop = prepare_peer_readloop(transport, "server")
+            peer.params["host_info"] = host_info
+            peer.params["create_worker"] = create_worker
+            peer.params["print"] = print  # driver's remote console
+            logger.info("connected to %s:%d; serving", server_ip, port)
+            try:
+                await readloop()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("connection lost: %s", e)
+            if state["worker_host"] is not None:
+                # Fail-fast: this host was part of a live deployment.
+                logger.error(
+                    "disconnected while deployed — exiting for restart"
+                )
+                sys.exit(1)
+            await asyncio.sleep(RETRY_SECONDS)
+    finally:
+        gc_task.cancel()
+
+
+def remote_main(server_ip: str, port: int | None = None) -> None:
+    """Blocking entry: `vdt remote <server_ip>` (launch.py:668-675)."""
+    asyncio.run(agent_async_main(server_ip, port))

@@ -95,8 +95,16 @@ class RpcPeer:
         self._remote_proxies: "weakref.WeakValueDictionary[str, RpcProxy]" = (
             weakref.WeakValueDictionary()
         )
-        self._killed: asyncio.Future | None = None
+        self._killed: str | None = None
         self.kill_listeners: list[Callable[[str], None]] = []
+        # The event loop this peer lives on (set on first use from loop
+        # context); finalize callbacks may fire on arbitrary GC threads
+        # and must hop onto it via call_soon_threadsafe.
+        self._loop: asyncio.AbstractEventLoop | None = None
+        try:
+            self._loop = asyncio.get_running_loop()
+        except RuntimeError:
+            pass
 
     # ---- ids ----
     def _next_id(self) -> str:
@@ -216,11 +224,18 @@ class RpcPeer:
         self, msg: dict, buffers: list[bytes] | None = None
     ) -> None:
         msg = _restore_buffers(msg, buffers or [])
+        if self._loop is None:
+            self._loop = asyncio.get_running_loop()
         mtype = msg.get("type")
         if mtype == "param":
             await self._handle_param(msg)
         elif mtype == "apply":
-            await self._handle_apply(msg)
+            # Run as a task, NOT inline: a handler may itself await an RPC
+            # back to the caller (the create_worker callback pattern,
+            # launch.py:238), and the read loop must keep draining results
+            # while the handler is in flight.  Tasks start in message
+            # order, so single-threaded targets still see ordered calls.
+            asyncio.ensure_future(self._handle_apply(msg))
         elif mtype == "result":
             self._handle_result(msg)
         elif mtype == "finalize":
@@ -305,18 +320,18 @@ class RpcPeer:
 
 def _send_finalize(peer_ref, proxy_id: str) -> None:
     """weakref.finalize callback: tell the remote side its object is no
-    longer referenced here (distributed GC, reference rpc.py finalize)."""
+    longer referenced here (distributed GC, reference rpc.py finalize).
+    May fire on ANY thread, so it hops onto the peer's loop."""
     peer = peer_ref()
-    if peer is None or peer.killed:
+    if peer is None or peer.killed or peer._loop is None:
         return
+    msg = {"type": "finalize", "proxyId": proxy_id}
     try:
-        loop = asyncio.get_event_loop()
-        if loop.is_running():
-            loop.create_task(
-                peer._send({"type": "finalize", "proxyId": proxy_id})
-            )
+        peer._loop.call_soon_threadsafe(
+            lambda: asyncio.ensure_future(peer._send(msg))
+        )
     except RuntimeError:
-        pass  # no loop — process is exiting
+        pass  # loop closed — process is exiting
 
 
 def _serialize_error(e: Exception) -> dict:

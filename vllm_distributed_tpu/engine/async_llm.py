@@ -1,0 +1,147 @@
+"""Async engine client: the surface the HTTP layer consumes.
+
+The rebuild of the EngineClient protocol + AsyncLLM the reference drives
+through build_async_engine_client_from_engine_args (launch.py:30-33,
+395-407; SURVEY.md §2.3).  The engine's blocking step loop runs on a
+dedicated thread (device work must not block the server's event loop);
+results stream to per-request asyncio queues via call_soon_threadsafe.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import AsyncIterator
+
+from vllm_distributed_tpu.config import EngineArgs, EngineConfig
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.outputs import RequestOutput
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+logger = init_logger(__name__)
+
+
+class EngineDeadError(RuntimeError):
+    pass
+
+
+class AsyncLLM:
+    def __init__(self, config: EngineConfig) -> None:
+        self.engine = LLMEngine(config)
+        self.config = config
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._queues: dict[str, asyncio.Queue] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._dead: BaseException | None = None
+        self._shutdown = False
+        self._thread = threading.Thread(
+            target=self._run_engine_loop, daemon=True, name="vdt-engine"
+        )
+        self._thread.start()
+
+    @classmethod
+    def from_engine_args(cls, engine_args: EngineArgs) -> "AsyncLLM":
+        return cls(engine_args.create_engine_config())
+
+    # ---- the background loop ----
+    def _run_engine_loop(self) -> None:
+        try:
+            while not self._shutdown:
+                if not self.engine.has_unfinished_requests():
+                    self._wake.wait(timeout=0.2)
+                    self._wake.clear()
+                    continue
+                with self._lock:
+                    outputs = self.engine.step()
+                if outputs and self._loop is not None:
+                    self._loop.call_soon_threadsafe(
+                        self._dispatch_outputs, outputs
+                    )
+        except BaseException as e:  # noqa: BLE001
+            logger.exception("engine loop died")
+            self._dead = e
+            if self._loop is not None:
+                self._loop.call_soon_threadsafe(self._fail_all_queues, e)
+
+    def _dispatch_outputs(self, outputs: list[RequestOutput]) -> None:
+        for out in outputs:
+            q = self._queues.get(out.request_id)
+            if q is not None:
+                q.put_nowait(out)
+
+    def _fail_all_queues(self, e: BaseException) -> None:
+        for q in self._queues.values():
+            q.put_nowait(e)
+
+    # ---- EngineClient surface ----
+    @property
+    def is_running(self) -> bool:
+        return self._dead is None and self._thread.is_alive()
+
+    @property
+    def errored(self) -> bool:
+        return self._dead is not None
+
+    async def check_health(self) -> None:
+        if self._dead is not None:
+            raise EngineDeadError(str(self._dead))
+
+    async def generate(
+        self,
+        request_id: str,
+        prompt: str | None = None,
+        prompt_token_ids: list[int] | None = None,
+        sampling_params: SamplingParams | None = None,
+    ) -> AsyncIterator[RequestOutput]:
+        """Feed a request and yield cumulative RequestOutputs until
+        finished.  Cancellation (client disconnect) aborts the request."""
+        if self._dead is not None:
+            raise EngineDeadError(str(self._dead))
+        self._loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[request_id] = q
+        try:
+            # add_request tokenizes on this thread (cheap) but schedules on
+            # the engine thread via the shared scheduler; the scheduler is
+            # only mutated between steps, guarded by the engine lock.
+            with self._lock:
+                self.engine.add_request(
+                    request_id,
+                    prompt=prompt,
+                    prompt_token_ids=prompt_token_ids,
+                    sampling_params=sampling_params,
+                )
+            self._wake.set()
+            while True:
+                item = await q.get()
+                if isinstance(item, BaseException):
+                    raise EngineDeadError(str(item))
+                yield item
+                if item.finished:
+                    return
+        finally:
+            self._queues.pop(request_id, None)
+            with self._lock:
+                self.engine.abort_request(request_id)
+
+    async def abort(self, request_id: str) -> None:
+        with self._lock:
+            self.engine.abort_request(request_id)
+        self._queues.pop(request_id, None)
+
+    # Introspection for the API layer.
+    def get_model_config(self):
+        return self.config.model_config
+
+    @property
+    def tokenizer(self):
+        return self.engine.tokenizer
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        self._wake.set()
+        self._thread.join(timeout=5)
+        self.engine.shutdown()

@@ -1,0 +1,351 @@
+"""CLI — `vdt` / `python -m vllm_distributed_tpu`.
+
+The rebuild of the reference's launcher surface (launch.py:668-679 +
+the vLLM CLI families it mounts, launch.py:21-25, 465-507; SURVEY.md §2
+C7): ``serve`` boots the engine + OpenAI server, ``remote <server_ip>``
+turns this host into a worker agent, plus ``bench``, ``collect-env``,
+``run-batch``, and client-side ``chat``/``complete``.  ``${VAR}`` tokens
+in argv are env-expanded (FlexibleArgumentParser parity).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+from vllm_distributed_tpu.config import EngineArgs
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.version import __version__
+
+logger = init_logger(__name__)
+
+
+def _expand_env(argv: list[str]) -> list[str]:
+    return [os.path.expandvars(a) for a in argv]
+
+
+def _add_server_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--host", type=str, default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--ssl-certfile", type=str, default=None)
+    parser.add_argument("--ssl-keyfile", type=str, default=None)
+    parser.add_argument("--served-model-name", type=str, default=None)
+    parser.add_argument("--chat-template", type=str, default=None)
+    parser.add_argument("--tool-call-parser", type=str, default=None)
+    parser.add_argument("--tool-parser-plugin", type=str, default=None)
+    parser.add_argument(
+        "--enable-auto-tool-choice", action="store_true", default=False
+    )
+    parser.add_argument("--disable-log-requests", action="store_true")
+
+
+def make_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="vdt",
+        description="TPU-native distributed LLM serving",
+    )
+    parser.add_argument("--version", action="version", version=__version__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    serve = sub.add_parser("serve", help="start the OpenAI API server")
+    serve.add_argument("model_tag", type=str, nargs="?", default=None)
+    _add_server_args(serve)
+    EngineArgs.add_cli_args(serve)
+
+    remote = sub.add_parser(
+        "remote", help="offer this host's chips to a server"
+    )
+    remote.add_argument("server_ip", type=str)
+    remote.add_argument("--server-port", type=int, default=None)
+
+    bench = sub.add_parser("bench", help="offline latency/throughput bench")
+    bench.add_argument(
+        "mode", choices=["latency", "throughput"], default="throughput",
+        nargs="?",
+    )
+    bench.add_argument("--input-len", type=int, default=32)
+    bench.add_argument("--output-len", type=int, default=64)
+    bench.add_argument("--num-prompts", type=int, default=32)
+    EngineArgs.add_cli_args(bench)
+
+    sub.add_parser("collect-env", help="print environment diagnostics")
+
+    run_batch = sub.add_parser(
+        "run-batch", help="run a JSONL batch file offline"
+    )
+    run_batch.add_argument("-i", "--input-file", required=True)
+    run_batch.add_argument("-o", "--output-file", required=True)
+    EngineArgs.add_cli_args(run_batch)
+
+    for name in ("chat", "complete"):
+        client = sub.add_parser(name, help=f"{name} against a server")
+        client.add_argument("--url", default="http://localhost:8000")
+        client.add_argument("--model", default=None)
+        client.add_argument("prompt", nargs="?", default=None)
+
+    return parser
+
+
+# ---- serve ----
+async def _serve_async(args: argparse.Namespace) -> None:
+    from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+    from vllm_distributed_tpu.entrypoints.openai.api_server import (
+        build_app,
+        init_app_state,
+        serve_http,
+    )
+    from vllm_distributed_tpu.entrypoints.openai.tool_parsers import (
+        ToolParserManager,
+    )
+
+    if args.model_tag:
+        args.model = args.model_tag
+    if args.tool_parser_plugin:
+        ToolParserManager.import_tool_parser(args.tool_parser_plugin)
+    engine_args = EngineArgs.from_cli_args(args)
+    if engine_args.num_hosts > 1:
+        engine_args.distributed_executor_backend = "multihost"
+    loop = asyncio.get_running_loop()
+    engine = await loop.run_in_executor(
+        None, lambda: AsyncLLM.from_engine_args(engine_args)
+    )
+    chat_template = None
+    if args.chat_template:
+        if os.path.exists(args.chat_template):
+            with open(args.chat_template) as f:
+                chat_template = f.read()
+        else:
+            chat_template = args.chat_template
+    state = init_app_state(
+        engine,
+        served_model_name=args.served_model_name,
+        tool_call_parser=args.tool_call_parser,
+        enable_auto_tool_choice=args.enable_auto_tool_choice,
+        chat_template=chat_template,
+    )
+    app = build_app(state)
+    runner = await serve_http(
+        app,
+        host=args.host,
+        port=args.port,
+        ssl_certfile=args.ssl_certfile,
+        ssl_keyfile=args.ssl_keyfile,
+    )
+    try:
+        await asyncio.Event().wait()  # serve until killed
+    finally:
+        await runner.cleanup()
+        engine.shutdown()
+
+
+def cmd_serve(args: argparse.Namespace) -> None:
+    asyncio.run(_serve_async(args))
+
+
+# ---- remote ----
+def cmd_remote(args: argparse.Namespace) -> None:
+    from vllm_distributed_tpu.distributed.agent import remote_main
+
+    remote_main(args.server_ip, args.server_port)
+
+
+# ---- bench ----
+def cmd_bench(args: argparse.Namespace) -> None:
+    import time
+
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+
+    engine_args = EngineArgs.from_cli_args(args)
+    engine = LLMEngine.from_engine_args(engine_args)
+    sp = SamplingParams(
+        temperature=0.0, max_tokens=args.output_len, ignore_eos=True
+    )
+    vocab = engine.config.model_config.get_vocab_size()
+    prompts = [
+        [(13 * i + j) % (vocab - 10) + 1 for j in range(args.input_len)]
+        for i in range(args.num_prompts)
+    ]
+    if args.mode == "latency":
+        # One request at a time; report per-request latency.
+        lat = []
+        for i, p in enumerate(prompts[: min(8, len(prompts))]):
+            t0 = time.perf_counter()
+            engine.add_request(f"b{i}", prompt_token_ids=p, sampling_params=sp)
+            while engine.has_unfinished_requests():
+                engine.step()
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        print(
+            json.dumps(
+                {
+                    "mode": "latency",
+                    "p50_s": round(lat[len(lat) // 2], 4),
+                    "mean_s": round(sum(lat) / len(lat), 4),
+                    "output_len": args.output_len,
+                }
+            )
+        )
+    else:
+        for i, p in enumerate(prompts):
+            engine.add_request(f"b{i}", prompt_token_ids=p, sampling_params=sp)
+        t0 = time.perf_counter()
+        done = 0
+        while engine.has_unfinished_requests():
+            done += sum(1 for o in engine.step() if o.finished)
+        elapsed = time.perf_counter() - t0
+        total_tokens = args.num_prompts * (args.input_len + args.output_len)
+        print(
+            json.dumps(
+                {
+                    "mode": "throughput",
+                    "requests_per_s": round(args.num_prompts / elapsed, 3),
+                    "total_tokens_per_s": round(total_tokens / elapsed, 1),
+                    "output_tokens_per_s": round(
+                        args.num_prompts * args.output_len / elapsed, 1
+                    ),
+                    "elapsed_s": round(elapsed, 2),
+                }
+            )
+        )
+
+
+# ---- collect-env ----
+def cmd_collect_env(args: argparse.Namespace) -> None:
+    import platform
+
+    info = {
+        "vdt": __version__,
+        "python": sys.version.split()[0],
+        "platform": platform.platform(),
+    }
+    for mod in ("jax", "jaxlib", "flax", "numpy", "transformers", "aiohttp"):
+        try:
+            info[mod] = __import__(mod).__version__
+        except Exception:  # noqa: BLE001
+            info[mod] = "unavailable"
+    try:
+        import jax
+
+        info["backend"] = jax.default_backend()
+        info["devices"] = [str(d) for d in jax.devices()]
+    except Exception as e:  # noqa: BLE001
+        info["backend"] = f"error: {e}"
+    from vllm_distributed_tpu import envs
+
+    info["env"] = {
+        k: str(v())
+        for k, v in envs.environment_variables.items()
+        if k in os.environ
+    }
+    print(json.dumps(info, indent=2))
+
+
+# ---- run-batch ----
+def cmd_run_batch(args: argparse.Namespace) -> None:
+    """Each input line: {"custom_id": ..., "body": {"prompt" | "messages",
+    sampling fields}} — the OpenAI batch-file shape (launch.py:25)."""
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.entrypoints.openai.protocol import (
+        CompletionRequest,
+    )
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+
+    engine_args = EngineArgs.from_cli_args(args)
+    engine = LLMEngine.from_engine_args(engine_args)
+    max_len = engine.config.model_config.max_model_len
+
+    requests = []
+    with open(args.input_file) as f:
+        for line in f:
+            if line.strip():
+                requests.append(json.loads(line))
+    for i, item in enumerate(requests):
+        body = item.get("body", item)
+        req = CompletionRequest(**{
+            k: v for k, v in body.items()
+            if k in CompletionRequest.model_fields
+        })
+        prompt = body.get("prompt", "")
+        sp = req.to_sampling_params(max_len // 2, is_chat=False)
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            engine.add_request(
+                str(item.get("custom_id", i)),
+                prompt_token_ids=prompt,
+                sampling_params=sp,
+            )
+        else:
+            engine.add_request(
+                str(item.get("custom_id", i)),
+                prompt=str(prompt),
+                sampling_params=sp,
+            )
+    results = {}
+    while engine.has_unfinished_requests():
+        for out in engine.step():
+            if out.finished:
+                results[out.request_id] = {
+                    "custom_id": out.request_id,
+                    "response": {
+                        "text": out.outputs[0].text,
+                        "token_ids": out.outputs[0].token_ids,
+                        "finish_reason": out.outputs[0].finish_reason,
+                    },
+                }
+    with open(args.output_file, "w") as f:
+        for item in requests:
+            rid = str(item.get("custom_id", requests.index(item)))
+            f.write(json.dumps(results.get(rid, {"custom_id": rid})) + "\n")
+    logger.info("wrote %d results to %s", len(results), args.output_file)
+
+
+# ---- client commands ----
+def cmd_client(args: argparse.Namespace, chat: bool) -> None:
+    import urllib.request
+
+    if chat:
+        body = {
+            "model": args.model or "",
+            "messages": [{"role": "user", "content": args.prompt or "hi"}],
+        }
+        path = "/v1/chat/completions"
+    else:
+        body = {"model": args.model or "", "prompt": args.prompt or "hi"}
+        path = "/v1/completions"
+    req = urllib.request.Request(
+        args.url + path,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        out = json.loads(resp.read())
+    if chat:
+        print(out["choices"][0]["message"]["content"])
+    else:
+        print(out["choices"][0]["text"])
+
+
+def main(argv: list[str] | None = None) -> None:
+    argv = _expand_env(argv if argv is not None else sys.argv[1:])
+    args = make_parser().parse_args(argv)
+    if args.command == "serve":
+        cmd_serve(args)
+    elif args.command == "remote":
+        cmd_remote(args)
+    elif args.command == "bench":
+        cmd_bench(args)
+    elif args.command == "collect-env":
+        cmd_collect_env(args)
+    elif args.command == "run-batch":
+        cmd_run_batch(args)
+    elif args.command == "chat":
+        cmd_client(args, chat=True)
+    elif args.command == "complete":
+        cmd_client(args, chat=False)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,564 @@
+"""OpenAI-compatible HTTP server (aiohttp).
+
+The rebuild of the serving layer the reference assembles from vLLM's
+entrypoints (setup_server/build_app/init_app_state/serve_http,
+launch.py:413-457; SURVEY.md §2 C7): chat completions, completions,
+models, tokenize/detokenize, health, version, Prometheus /metrics, SSE
+streaming, keep-alive timeout (VDT_HTTP_TIMEOUT_KEEP_ALIVE ≈
+VLLM_HTTP_TIMEOUT_KEEP_ALIVE, launch.py:445), and the tool-parser hook
+(--tool-call-parser, .env.server:11).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from aiohttp import web
+
+from vllm_distributed_tpu import envs
+from vllm_distributed_tpu.engine.async_llm import AsyncLLM, EngineDeadError
+from vllm_distributed_tpu.entrypoints.openai.protocol import (
+    ChatChoice,
+    ChatCompletionRequest,
+    ChatCompletionResponse,
+    ChatCompletionStreamResponse,
+    ChatDelta,
+    ChatMessage,
+    ChatResponseMessage,
+    ChatStreamChoice,
+    CompletionChoice,
+    CompletionRequest,
+    CompletionResponse,
+    DetokenizeRequest,
+    DetokenizeResponse,
+    ErrorResponse,
+    ModelCard,
+    ModelList,
+    TokenizeRequest,
+    TokenizeResponse,
+    ToolCall,
+    UsageInfo,
+)
+from vllm_distributed_tpu.entrypoints.openai.tool_parsers import (
+    ToolParserManager,
+)
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.outputs import RequestOutput
+from vllm_distributed_tpu.utils import Counter
+from vllm_distributed_tpu.version import __version__
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class ServerState:
+    engine: AsyncLLM
+    model_name: str
+    max_model_len: int
+    tool_call_parser: str | None = None
+    enable_auto_tool_choice: bool = False
+    chat_template: str | None = None
+    request_counter: Counter = field(default_factory=Counter)
+    metrics: Any = None
+
+
+# ---- helpers ----
+def _error(message: str, status: int = 400) -> web.Response:
+    return web.json_response(
+        ErrorResponse(message=message, code=status).model_dump(),
+        status=status,
+    )
+
+
+def _apply_chat_template(state: ServerState, req: ChatCompletionRequest) -> str:
+    tokenizer = state.engine.tokenizer
+    conversation = [
+        m.model_dump(exclude_none=True) for m in req.messages
+    ]
+    template = req.chat_template or state.chat_template
+    kwargs = req.chat_template_kwargs or {}
+    if tokenizer is not None and (
+        template or getattr(tokenizer, "chat_template", None)
+    ):
+        return tokenizer.apply_chat_template(
+            conversation,
+            tokenize=False,
+            add_generation_prompt=req.add_generation_prompt,
+            chat_template=template,
+            tools=req.tools,
+            **kwargs,
+        )
+    # No template available: a plain readable fallback.
+    lines = [
+        f"{m.get('role')}: {m.get('content') or ''}" for m in conversation
+    ]
+    lines.append("assistant:")
+    return "\n".join(lines)
+
+
+def _logprobs_dict(out, chat: bool) -> dict | None:
+    comp = out.outputs[0]
+    if comp.logprobs is None:
+        return None
+    if chat:
+        content = []
+        for tok, lp in zip(comp.token_ids, comp.logprobs):
+            entry = {
+                "token": str(tok),
+                "logprob": lp.get(tok, 0.0),
+                "top_logprobs": [
+                    {"token": str(t), "logprob": v}
+                    for t, v in sorted(lp.items(), key=lambda kv: -kv[1])
+                ],
+            }
+            content.append(entry)
+        return {"content": content}
+    return {
+        "tokens": [str(t) for t in comp.token_ids],
+        "token_logprobs": [
+            lp.get(t, 0.0) for t, lp in zip(comp.token_ids, comp.logprobs)
+        ],
+        "top_logprobs": [
+            {str(t): v for t, v in lp.items()} for lp in comp.logprobs
+        ],
+    }
+
+
+async def _collect(gen) -> RequestOutput:
+    last = None
+    async for out in gen:
+        last = out
+    return last
+
+
+# ---- route handlers ----
+async def health(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    try:
+        await state.engine.check_health()
+    except EngineDeadError as e:
+        return web.json_response({"status": "dead", "error": str(e)}, status=503)
+    return web.Response(status=200)
+
+
+async def version(request: web.Request) -> web.Response:
+    return web.json_response({"version": __version__})
+
+
+async def list_models(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    card = ModelCard(id=state.model_name, max_model_len=state.max_model_len)
+    return web.json_response(ModelList(data=[card]).model_dump())
+
+
+async def tokenize(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    req = TokenizeRequest(**await request.json())
+    tokenizer = state.engine.tokenizer
+    if tokenizer is None:
+        return _error("tokenizer unavailable", 400)
+    ids = tokenizer.encode(
+        req.prompt, add_special_tokens=req.add_special_tokens
+    )
+    return web.json_response(
+        TokenizeResponse(
+            tokens=ids, count=len(ids), max_model_len=state.max_model_len
+        ).model_dump()
+    )
+
+
+async def detokenize(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    req = DetokenizeRequest(**await request.json())
+    tokenizer = state.engine.tokenizer
+    if tokenizer is None:
+        return _error("tokenizer unavailable", 400)
+    return web.json_response(
+        DetokenizeResponse(prompt=tokenizer.decode(req.tokens)).model_dump()
+    )
+
+
+async def chat_completions(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    try:
+        req = ChatCompletionRequest(**await request.json())
+    except Exception as e:  # noqa: BLE001
+        return _error(f"invalid request: {e}")
+    request_id = f"chatcmpl-{next(state.request_counter)}"
+
+    prompt = _apply_chat_template(state, req)
+    tokenizer = state.engine.tokenizer
+    prompt_ids = tokenizer.encode(prompt) if tokenizer else None
+    if prompt_ids is not None and len(prompt_ids) >= state.max_model_len:
+        return _error(
+            f"prompt has {len(prompt_ids)} tokens, exceeding "
+            f"max_model_len {state.max_model_len}"
+        )
+    default_max = state.max_model_len - (
+        len(prompt_ids) if prompt_ids else 0
+    ) - 1
+    try:
+        params = req.to_sampling_params(default_max, is_chat=True)
+    except ValueError as e:
+        return _error(str(e))
+
+    if req.stream:
+        return await _stream_chat(request, state, req, request_id, prompt_ids, prompt, params)
+
+    try:
+        outs = await asyncio.gather(
+            *(
+                _collect(
+                    state.engine.generate(
+                        f"{request_id}-{i}",
+                        prompt=None if prompt_ids else prompt,
+                        prompt_token_ids=prompt_ids,
+                        sampling_params=params.clone(),
+                    )
+                )
+                for i in range(req.n)
+            )
+        )
+    except (EngineDeadError, ValueError) as e:
+        return _error(str(e), 500 if isinstance(e, EngineDeadError) else 400)
+
+    choices = []
+    usage = UsageInfo()
+    for i, out in enumerate(outs):
+        comp = out.outputs[0]
+        content, tool_calls = comp.text, []
+        if state.tool_call_parser and (req.tools or state.enable_auto_tool_choice):
+            parser = ToolParserManager.get(state.tool_call_parser)
+            content, tool_calls = parser.extract(comp.text)
+        finish = comp.finish_reason
+        if tool_calls:
+            finish = "tool_calls"
+        choices.append(
+            ChatChoice(
+                index=i,
+                message=ChatResponseMessage(
+                    content=content,
+                    tool_calls=[ToolCall(**tc) for tc in tool_calls] or None,
+                ),
+                logprobs=_logprobs_dict(out, chat=True),
+                finish_reason=finish,
+            )
+        )
+        usage.prompt_tokens += len(out.prompt_token_ids)
+        usage.completion_tokens += len(comp.token_ids)
+    usage.total_tokens = usage.prompt_tokens + usage.completion_tokens
+    resp = ChatCompletionResponse(
+        id=request_id, model=state.model_name, choices=choices, usage=usage
+    )
+    return web.json_response(resp.model_dump(exclude_none=True))
+
+
+async def _stream_chat(
+    request, state, req, request_id, prompt_ids, prompt, params
+) -> web.StreamResponse:
+    response = web.StreamResponse(
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+        }
+    )
+    await response.prepare(request)
+
+    async def send(obj) -> None:
+        payload = obj if isinstance(obj, str) else json.dumps(
+            obj.model_dump(exclude_none=True)
+        )
+        await response.write(f"data: {payload}\n\n".encode())
+
+    include_usage = bool(
+        (req.stream_options or {}).get("include_usage", False)
+    )
+    usage = UsageInfo()
+
+    async def stream_one(i: int) -> None:
+        first = True
+        sent = 0
+        finish = None
+        async for out in state.engine.generate(
+            f"{request_id}-{i}",
+            prompt=None if prompt_ids else prompt,
+            prompt_token_ids=prompt_ids,
+            sampling_params=params.clone(),
+        ):
+            comp = out.outputs[0]
+            delta_text = comp.text[sent:]
+            sent = len(comp.text)
+            finish = comp.finish_reason
+            if first or delta_text or comp.finished:
+                delta = ChatDelta(
+                    role="assistant" if first else None,
+                    content=delta_text or ("" if first else None),
+                )
+                first = False
+                await send(
+                    ChatCompletionStreamResponse(
+                        id=request_id,
+                        model=state.model_name,
+                        choices=[
+                            ChatStreamChoice(
+                                index=i,
+                                delta=delta,
+                                finish_reason=(
+                                    finish if comp.finished else None
+                                ),
+                            )
+                        ],
+                    )
+                )
+            if comp.finished:
+                usage.prompt_tokens += len(out.prompt_token_ids)
+                usage.completion_tokens += len(comp.token_ids)
+
+    try:
+        await asyncio.gather(*(stream_one(i) for i in range(req.n)))
+        if include_usage:
+            usage.total_tokens = usage.prompt_tokens + usage.completion_tokens
+            await send(
+                ChatCompletionStreamResponse(
+                    id=request_id,
+                    model=state.model_name,
+                    choices=[],
+                    usage=usage,
+                )
+            )
+        await send("[DONE]")
+    except (EngineDeadError, ValueError) as e:
+        await send(json.dumps({"error": str(e)}))
+    except (ConnectionResetError, asyncio.CancelledError):
+        logger.info("client disconnected from %s", request_id)
+    await response.write_eof()
+    return response
+
+
+async def completions(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    try:
+        req = CompletionRequest(**await request.json())
+    except Exception as e:  # noqa: BLE001
+        return _error(f"invalid request: {e}")
+    request_id = f"cmpl-{next(state.request_counter)}"
+    tokenizer = state.engine.tokenizer
+
+    # Normalize prompt forms: str | [str] | [int] | [[int]].
+    prompts: list[tuple[str | None, list[int] | None]] = []
+    p = req.prompt
+    if isinstance(p, str):
+        prompts = [(p, None)]
+    elif isinstance(p, list) and p and isinstance(p[0], int):
+        prompts = [(None, p)]
+    elif isinstance(p, list) and p and isinstance(p[0], str):
+        prompts = [(s, None) for s in p]
+    elif isinstance(p, list) and p and isinstance(p[0], list):
+        prompts = [(None, ids) for ids in p]
+    else:
+        return _error("invalid prompt")
+
+    resolved: list[tuple[str | None, list[int]]] = []
+    for text, ids in prompts:
+        if ids is None:
+            if tokenizer is None:
+                return _error("tokenizer unavailable for text prompts")
+            ids = tokenizer.encode(text)
+        resolved.append((text, ids))
+
+    longest = max(len(ids) for _, ids in resolved)
+    if longest >= state.max_model_len:
+        return _error(
+            f"prompt has {longest} tokens, exceeding max_model_len "
+            f"{state.max_model_len}"
+        )
+    default_max = state.max_model_len - longest - 1
+    try:
+        params = req.to_sampling_params(default_max, is_chat=False)
+    except ValueError as e:
+        return _error(str(e))
+
+    if req.stream:
+        return await _stream_completion(
+            request, state, req, request_id, resolved, params
+        )
+
+    gens = []
+    for pi, (text, ids) in enumerate(resolved):
+        for i in range(req.n):
+            gens.append(
+                _collect(
+                    state.engine.generate(
+                        f"{request_id}-{pi}-{i}",
+                        prompt=text,
+                        prompt_token_ids=ids,
+                        sampling_params=params.clone(),
+                    )
+                )
+            )
+    try:
+        outs = await asyncio.gather(*gens)
+    except (EngineDeadError, ValueError) as e:
+        return _error(str(e), 500 if isinstance(e, EngineDeadError) else 400)
+
+    choices = []
+    usage = UsageInfo()
+    for idx, out in enumerate(outs):
+        comp = out.outputs[0]
+        text = comp.text
+        if req.echo:
+            prefix = out.prompt or (
+                tokenizer.decode(out.prompt_token_ids) if tokenizer else ""
+            )
+            text = prefix + text
+        choices.append(
+            CompletionChoice(
+                index=idx,
+                text=text,
+                logprobs=_logprobs_dict(out, chat=False),
+                finish_reason=comp.finish_reason,
+            )
+        )
+        usage.prompt_tokens += len(out.prompt_token_ids)
+        usage.completion_tokens += len(comp.token_ids)
+    usage.total_tokens = usage.prompt_tokens + usage.completion_tokens
+    resp = CompletionResponse(
+        id=request_id, model=state.model_name, choices=choices, usage=usage
+    )
+    return web.json_response(resp.model_dump(exclude_none=True))
+
+
+async def _stream_completion(
+    request, state, req, request_id, resolved, params
+) -> web.StreamResponse:
+    response = web.StreamResponse(
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        }
+    )
+    await response.prepare(request)
+
+    async def send_json(payload: str) -> None:
+        await response.write(f"data: {payload}\n\n".encode())
+
+    async def stream_one(choice_idx: int, text, ids) -> None:
+        sent = 0
+        async for out in state.engine.generate(
+            f"{request_id}-{choice_idx}",
+            prompt=text,
+            prompt_token_ids=ids,
+            sampling_params=params.clone(),
+        ):
+            comp = out.outputs[0]
+            delta = comp.text[sent:]
+            sent = len(comp.text)
+            if delta or comp.finished:
+                chunk = CompletionResponse(
+                    id=request_id,
+                    model=state.model_name,
+                    choices=[
+                        CompletionChoice(
+                            index=choice_idx,
+                            text=delta,
+                            finish_reason=(
+                                comp.finish_reason if comp.finished else None
+                            ),
+                        )
+                    ],
+                )
+                await send_json(
+                    json.dumps(chunk.model_dump(exclude_none=True))
+                )
+
+    try:
+        tasks = []
+        idx = 0
+        for text, ids in resolved:
+            for _ in range(req.n):
+                tasks.append(stream_one(idx, text, ids))
+                idx += 1
+        await asyncio.gather(*tasks)
+        await send_json("[DONE]")
+    except (EngineDeadError, ValueError) as e:
+        await send_json(json.dumps({"error": str(e)}))
+    except (ConnectionResetError, asyncio.CancelledError):
+        logger.info("client disconnected from %s", request_id)
+    await response.write_eof()
+    return response
+
+
+async def metrics(request: web.Request) -> web.Response:
+    try:
+        from prometheus_client import REGISTRY, generate_latest
+
+        return web.Response(
+            body=generate_latest(REGISTRY),
+            content_type="text/plain",
+        )
+    except ImportError:
+        return _error("prometheus_client unavailable", 501)
+
+
+# ---- app assembly ----
+def build_app(state: ServerState) -> web.Application:
+    app = web.Application(client_max_size=64 * 2**20)
+    app["state"] = state
+    app.router.add_get("/health", health)
+    app.router.add_get("/ping", health)
+    app.router.add_get("/version", version)
+    app.router.add_get("/v1/models", list_models)
+    app.router.add_post("/tokenize", tokenize)
+    app.router.add_post("/detokenize", detokenize)
+    app.router.add_post("/v1/chat/completions", chat_completions)
+    app.router.add_post("/v1/completions", completions)
+    app.router.add_get("/metrics", metrics)
+    return app
+
+
+def init_app_state(
+    engine: AsyncLLM,
+    *,
+    served_model_name: str | None = None,
+    tool_call_parser: str | None = None,
+    enable_auto_tool_choice: bool = False,
+    chat_template: str | None = None,
+) -> ServerState:
+    model_config = engine.get_model_config()
+    return ServerState(
+        engine=engine,
+        model_name=served_model_name or model_config.model,
+        max_model_len=model_config.max_model_len,
+        tool_call_parser=tool_call_parser,
+        enable_auto_tool_choice=enable_auto_tool_choice,
+        chat_template=chat_template,
+    )
+
+
+async def serve_http(
+    app: web.Application,
+    host: str = "0.0.0.0",
+    port: int = 8000,
+    ssl_certfile: str | None = None,
+    ssl_keyfile: str | None = None,
+) -> web.AppRunner:
+    """Start serving; returns the runner (caller owns shutdown)."""
+    ssl_context = None
+    if ssl_certfile:
+        import ssl as ssl_mod
+
+        ssl_context = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_SERVER)
+        ssl_context.load_cert_chain(ssl_certfile, ssl_keyfile)
+    runner = web.AppRunner(
+        app, keepalive_timeout=envs.VDT_HTTP_TIMEOUT_KEEP_ALIVE
+    )
+    await runner.setup()
+    site = web.TCPSite(runner, host, port, ssl_context=ssl_context)
+    await site.start()
+    logger.info("API server listening on %s:%d", host, port)
+    return runner

@@ -1,0 +1,216 @@
+"""OpenAI-compatible API schema (pydantic).
+
+The request/response surface the reference serves via vLLM's OpenAI app
+(build_app/init_app_state, launch.py:32-34, 429-432; SURVEY.md §2.3):
+chat completions, completions, models, tokenize — with the sampling
+fields mapped onto SamplingParams.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Literal
+
+from pydantic import BaseModel, Field
+
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+class ModelCard(BaseModel):
+    id: str
+    object: str = "model"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    owned_by: str = "vllm-distributed-tpu"
+    max_model_len: int | None = None
+
+
+class ModelList(BaseModel):
+    object: str = "list"
+    data: list[ModelCard] = []
+
+
+class ErrorResponse(BaseModel):
+    object: str = "error"
+    message: str
+    type: str = "invalid_request_error"
+    code: int = 400
+
+
+class ChatMessage(BaseModel):
+    role: str
+    content: str | list[dict] | None = None
+    name: str | None = None
+    tool_calls: list[dict] | None = None
+    tool_call_id: str | None = None
+
+
+class _SamplingFields(BaseModel):
+    temperature: float | None = None
+    top_p: float | None = None
+    top_k: int | None = None
+    min_p: float | None = None
+    n: int = 1
+    max_tokens: int | None = None
+    min_tokens: int = 0
+    stop: str | list[str] | None = None
+    stop_token_ids: list[int] | None = None
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+    logprobs: bool | int | None = None
+    top_logprobs: int | None = None
+    seed: int | None = None
+    ignore_eos: bool = False
+    stream: bool = False
+    stream_options: dict | None = None
+    skip_special_tokens: bool = True
+    include_stop_str_in_output: bool = False
+
+    def to_sampling_params(
+        self, default_max_tokens: int, is_chat: bool
+    ) -> SamplingParams:
+        stop = self.stop
+        if isinstance(stop, str):
+            stop = [stop]
+        if is_chat:
+            nlp = (
+                self.top_logprobs
+                if self.logprobs
+                else None
+            )
+            if self.logprobs and nlp is None:
+                nlp = 1
+        else:
+            nlp = self.logprobs if isinstance(self.logprobs, int) else None
+        return SamplingParams(
+            n=self.n,
+            temperature=(
+                self.temperature if self.temperature is not None else 1.0
+            ),
+            top_p=self.top_p if self.top_p is not None else 1.0,
+            top_k=self.top_k if self.top_k is not None else -1,
+            min_p=self.min_p if self.min_p is not None else 0.0,
+            max_tokens=(
+                self.max_tokens
+                if self.max_tokens is not None
+                else default_max_tokens
+            ),
+            min_tokens=self.min_tokens,
+            stop=stop or [],
+            stop_token_ids=self.stop_token_ids or [],
+            presence_penalty=self.presence_penalty,
+            frequency_penalty=self.frequency_penalty,
+            repetition_penalty=self.repetition_penalty,
+            logprobs=nlp,
+            seed=self.seed,
+            ignore_eos=self.ignore_eos,
+            include_stop_str_in_output=self.include_stop_str_in_output,
+        )
+
+
+class ChatCompletionRequest(_SamplingFields):
+    model: str = ""
+    messages: list[ChatMessage]
+    tools: list[dict] | None = None
+    tool_choice: str | dict | None = None
+    chat_template: str | None = None
+    chat_template_kwargs: dict[str, Any] | None = None
+    add_generation_prompt: bool = True
+
+
+class CompletionRequest(_SamplingFields):
+    model: str = ""
+    prompt: str | list[str] | list[int] | list[list[int]] = ""
+    echo: bool = False
+
+
+class UsageInfo(BaseModel):
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+class ToolCall(BaseModel):
+    id: str
+    type: str = "function"
+    function: dict
+
+
+class ChatResponseMessage(BaseModel):
+    role: str = "assistant"
+    content: str | None = None
+    tool_calls: list[ToolCall] | None = None
+
+
+class ChatChoice(BaseModel):
+    index: int = 0
+    message: ChatResponseMessage
+    logprobs: dict | None = None
+    finish_reason: str | None = None
+
+
+class ChatCompletionResponse(BaseModel):
+    id: str
+    object: str = "chat.completion"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str
+    choices: list[ChatChoice]
+    usage: UsageInfo = UsageInfo()
+
+
+class ChatDelta(BaseModel):
+    role: str | None = None
+    content: str | None = None
+    tool_calls: list[dict] | None = None
+
+
+class ChatStreamChoice(BaseModel):
+    index: int = 0
+    delta: ChatDelta
+    finish_reason: str | None = None
+
+
+class ChatCompletionStreamResponse(BaseModel):
+    id: str
+    object: str = "chat.completion.chunk"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str
+    choices: list[ChatStreamChoice]
+    usage: UsageInfo | None = None
+
+
+class CompletionChoice(BaseModel):
+    index: int = 0
+    text: str
+    logprobs: dict | None = None
+    finish_reason: str | None = None
+
+
+class CompletionResponse(BaseModel):
+    id: str
+    object: str = "text_completion"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str
+    choices: list[CompletionChoice]
+    usage: UsageInfo = UsageInfo()
+
+
+class TokenizeRequest(BaseModel):
+    model: str = ""
+    prompt: str = ""
+    add_special_tokens: bool = True
+
+
+class TokenizeResponse(BaseModel):
+    tokens: list[int]
+    count: int
+    max_model_len: int
+
+
+class DetokenizeRequest(BaseModel):
+    model: str = ""
+    tokens: list[int]
+
+
+class DetokenizeResponse(BaseModel):
+    prompt: str

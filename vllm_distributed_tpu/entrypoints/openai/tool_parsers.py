@@ -1,0 +1,130 @@
+"""Pluggable tool-call parsers.
+
+The reference exposes vLLM's ToolParserManager with
+``--tool-parser-plugin`` / ``--tool-call-parser`` (launch.py:38, 417-418;
+.env.server:11 uses ``qwen3_coder``; SURVEY.md §2.3).  Same shape here: a
+registry keyed by name, an import hook for user plugin files, and
+built-in parsers for the common tag formats.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import uuid
+from typing import Any
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+class ToolParser:
+    """Extract tool calls from finished model output.  Returns
+    (content_without_tool_text, [tool_call dicts])."""
+
+    def extract(self, text: str) -> tuple[str | None, list[dict]]:
+        raise NotImplementedError
+
+
+class ToolParserManager:
+    _parsers: dict[str, type[ToolParser]] = {}
+
+    @classmethod
+    def register(cls, name: str):
+        def deco(parser_cls):
+            cls._parsers[name] = parser_cls
+            return parser_cls
+
+        return deco
+
+    @classmethod
+    def get(cls, name: str) -> ToolParser:
+        try:
+            return cls._parsers[name]()
+        except KeyError:
+            raise ValueError(
+                f"unknown tool parser {name!r}; known: {sorted(cls._parsers)}"
+            ) from None
+
+    @classmethod
+    def import_tool_parser(cls, plugin_path: str) -> None:
+        """Load a user plugin file that registers parsers (the
+        --tool-parser-plugin flow, launch.py:417-418)."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "vdt_tool_parser_plugin", plugin_path
+        )
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        logger.info("loaded tool parser plugin from %s", plugin_path)
+
+
+def _mk_call(name: str, arguments: Any) -> dict:
+    if not isinstance(arguments, str):
+        arguments = json.dumps(arguments)
+    return {
+        "id": f"call_{uuid.uuid4().hex[:24]}",
+        "type": "function",
+        "function": {"name": name, "arguments": arguments},
+    }
+
+
+@ToolParserManager.register("hermes")
+@ToolParserManager.register("qwen2")
+class HermesToolParser(ToolParser):
+    """``<tool_call>{"name": ..., "arguments": {...}}</tool_call>`` blocks
+    (Hermes/Qwen chat formats)."""
+
+    _RE = re.compile(r"<tool_call>\s*(\{.*?\})\s*</tool_call>", re.DOTALL)
+
+    def extract(self, text: str) -> tuple[str | None, list[dict]]:
+        calls = []
+        for m in self._RE.finditer(text):
+            try:
+                obj = json.loads(m.group(1))
+                calls.append(
+                    _mk_call(obj.get("name", ""), obj.get("arguments", {}))
+                )
+            except json.JSONDecodeError:
+                logger.warning("unparseable tool_call block ignored")
+        if not calls:
+            return text, []
+        content = self._RE.sub("", text).strip() or None
+        return content, calls
+
+
+@ToolParserManager.register("qwen3_coder")
+class Qwen3CoderToolParser(ToolParser):
+    """Qwen3-Coder XML-ish format:
+    <tool_call><function=NAME><parameter=KEY>VALUE</parameter>...
+    </function></tool_call> (the parser named in .env.server:11)."""
+
+    _BLOCK = re.compile(r"<tool_call>(.*?)</tool_call>", re.DOTALL)
+    _FN = re.compile(r"<function=([^>]+)>(.*?)</function>", re.DOTALL)
+    _PARAM = re.compile(r"<parameter=([^>]+)>(.*?)</parameter>", re.DOTALL)
+
+    def extract(self, text: str) -> tuple[str | None, list[dict]]:
+        calls = []
+        for block in self._BLOCK.finditer(text):
+            for fn in self._FN.finditer(block.group(1)):
+                name = fn.group(1).strip()
+                params = {
+                    p.group(1).strip(): _coerce(p.group(2).strip())
+                    for p in self._PARAM.finditer(fn.group(2))
+                }
+                calls.append(_mk_call(name, params))
+        if not calls:
+            return text, []
+        content = self._BLOCK.sub("", text).strip() or None
+        return content, calls
+
+
+def _coerce(value: str) -> Any:
+    """Best-effort typing of string parameter values (numbers, bools,
+    JSON literals pass through as their parsed type)."""
+    try:
+        return json.loads(value)
+    except (json.JSONDecodeError, ValueError):
+        return value

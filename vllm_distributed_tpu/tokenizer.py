@@ -90,7 +90,12 @@ class IncrementalDetokenizer:
         return new_text
 
     def _check_stop(self) -> str | None:
-        if not self.stop or self._tokens_seen < self.min_tokens:
+        if not self.stop:
+            return None
+        if self._tokens_seen < self.min_tokens:
+            # Stops occurring before min_tokens are IGNORED, not deferred:
+            # advance the scan cursor past the suppressed text.
+            self._stop_scanned = len(self.output_text)
             return None
         start = max(self._stop_scanned - (self._max_stop_len - 1), 0)
         for s in self.stop:
