@@ -121,6 +121,7 @@ def test_llama_prefill_logits_match_hf(tiny_llama):
         block_tables=jnp.asarray(bt),
         seq_lens=jnp.asarray(seq_lens),
         logits_indices=jnp.asarray(li),
+        chunk_starts=jnp.zeros(s_pad, jnp.int32),
     )
     logits, _ = runner.model.forward(
         runner.params, jnp.asarray(tokens), runner.kv_caches, meta

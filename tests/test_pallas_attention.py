@@ -1,0 +1,222 @@
+"""Pallas paged-attention kernel vs the pure-JAX reference oracle, in
+interpreter mode on CPU (SURVEY.md §4 item 2: kernel tests over head
+dims, page sizes, GQA ratios, masks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_distributed_tpu.ops.attention import (
+    AttentionMetadata,
+    paged_attention_reference,
+)
+from vllm_distributed_tpu.ops.pallas.paged_attention import paged_attention
+
+
+def build_case(
+    rng,
+    *,
+    seq_specs,  # list of (ctx_len, chunk_len): context incl. chunk
+    s_pad=8,
+    t_pad=None,
+    hq=4,
+    hkv=2,
+    d=64,
+    page_size=16,
+    num_pages=64,
+    dtype=jnp.float32,
+):
+    """Random paged KV state + flat query batch covering mixed
+    prefill/decode."""
+    t_real = sum(c for _, c in seq_specs)
+    t_pad = t_pad or max(16, 1 << (t_real - 1).bit_length())
+    max_pages_needed = max(
+        -(-ctx // page_size) for ctx, _ in seq_specs
+    )
+    pages_pad = max(8, 1 << (max_pages_needed - 1).bit_length())
+
+    k_pages = jnp.asarray(
+        rng.standard_normal((hkv, num_pages, page_size, d)), dtype
+    )
+    v_pages = jnp.asarray(
+        rng.standard_normal((hkv, num_pages, page_size, d)), dtype
+    )
+    q = jnp.asarray(rng.standard_normal((t_pad, hq, d)), dtype)
+
+    seq_ids = np.full(t_pad, s_pad, np.int32)
+    positions = np.zeros(t_pad, np.int32)
+    block_tables = np.zeros((s_pad, pages_pad), np.int32)
+    seq_lens = np.zeros(s_pad, np.int32)
+    chunk_starts = np.zeros(s_pad, np.int32)
+    logits_idx = np.zeros(s_pad, np.int32)
+
+    next_page = 1  # page 0 reserved
+    cursor = 0
+    for s, (ctx, chunk) in enumerate(seq_specs):
+        n_pages = -(-ctx // page_size)
+        pages = list(range(next_page, next_page + n_pages))
+        next_page += n_pages
+        block_tables[s, :n_pages] = pages
+        seq_lens[s] = ctx
+        chunk_starts[s] = ctx - chunk
+        positions[cursor : cursor + chunk] = np.arange(ctx - chunk, ctx)
+        seq_ids[cursor : cursor + chunk] = s
+        logits_idx[s] = cursor + chunk - 1
+        cursor += chunk
+
+    meta = AttentionMetadata(
+        q_seq_ids=jnp.asarray(seq_ids),
+        q_positions=jnp.asarray(positions),
+        slot_mapping=jnp.zeros(t_pad, jnp.int32),
+        block_tables=jnp.asarray(block_tables),
+        seq_lens=jnp.asarray(seq_lens),
+        logits_indices=jnp.asarray(logits_idx),
+        chunk_starts=jnp.asarray(chunk_starts),
+    )
+    max_q = max(c for _, c in seq_specs)
+    max_q = 1 << (max_q - 1).bit_length() if max_q > 1 else 1
+    return q, k_pages, v_pages, meta, max_q, cursor
+
+
+def _compare(case, scale=0.125, atol=2e-5):
+    q, k_pages, v_pages, meta, max_q, t_real = case
+    ref = paged_attention_reference(q, k_pages, v_pages, meta, scale=scale)
+    got = paged_attention(
+        q, k_pages, v_pages, meta, scale=scale, max_q=max_q, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got[:t_real]),
+        np.asarray(ref[:t_real]),
+        rtol=1e-4,
+        atol=atol,
+    )
+
+
+def test_pure_decode_batch():
+    rng = np.random.default_rng(0)
+    _compare(build_case(rng, seq_specs=[(17, 1), (33, 1), (160, 1)]))
+
+
+def test_pure_prefill():
+    rng = np.random.default_rng(1)
+    _compare(build_case(rng, seq_specs=[(24, 24), (7, 7)]))
+
+
+def test_chunked_prefill_continuation():
+    # Context 40 of which the last 8 are this step's chunk.
+    rng = np.random.default_rng(2)
+    _compare(build_case(rng, seq_specs=[(40, 8), (64, 16)]))
+
+
+def test_mixed_prefill_and_decode():
+    rng = np.random.default_rng(3)
+    _compare(
+        build_case(rng, seq_specs=[(50, 1), (20, 20), (33, 1), (48, 12)])
+    )
+
+
+def test_gqa_ratios():
+    rng = np.random.default_rng(4)
+    _compare(
+        build_case(rng, seq_specs=[(30, 1), (12, 12)], hq=8, hkv=2)
+    )
+
+
+def test_mha_group_1():
+    rng = np.random.default_rng(5)
+    _compare(build_case(rng, seq_specs=[(21, 1), (9, 9)], hq=4, hkv=4))
+
+
+def test_page_size_32_head_dim_128():
+    rng = np.random.default_rng(6)
+    _compare(
+        build_case(
+            rng,
+            seq_specs=[(70, 6), (100, 1)],
+            page_size=32,
+            d=128,
+            num_pages=32,
+        )
+    )
+
+
+def test_single_token_context():
+    rng = np.random.default_rng(7)
+    _compare(build_case(rng, seq_specs=[(1, 1)]))
+
+
+def test_long_context_multiblock():
+    # Forces multiple kv blocks (ctx 600 > 256-token block).
+    rng = np.random.default_rng(8)
+    _compare(
+        build_case(
+            rng, seq_specs=[(600, 1), (300, 4)], num_pages=80
+        )
+    )
+
+
+def test_bfloat16_cache():
+    rng = np.random.default_rng(9)
+    q, k, v, meta, max_q, t_real = build_case(
+        rng, seq_specs=[(40, 4), (21, 1)], dtype=jnp.bfloat16
+    )
+    ref = paged_attention_reference(q, k, v, meta, scale=0.125)
+    got = paged_attention(
+        q, k, v, meta, scale=0.125, max_q=max_q, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got[:t_real], np.float32),
+        np.asarray(ref[:t_real], np.float32),
+        rtol=5e-2,
+        atol=5e-2,
+    )
+
+
+def test_engine_e2e_with_pallas_backend(tmp_path):
+    """Whole engine on the interpret-mode kernel must equal the
+    reference backend token-for-token."""
+    from tests.utils import make_tiny_llama
+    from vllm_distributed_tpu.config import EngineArgs
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+
+    model_dir = make_tiny_llama(str(tmp_path / "m"))
+
+    def run(backend):
+        config = EngineArgs(
+            model=model_dir,
+            skip_tokenizer_init=True,
+            num_kv_pages=64,
+            max_model_len=128,
+            max_num_seqs=8,
+            max_num_batched_tokens=32,  # force chunking
+        ).create_engine_config()
+        engine = LLMEngine(config)
+        engine.executor.worker.runner._attn_fn = _backend(backend)
+        prompts = [list(range(1, 40)), [5, 6, 7], list(range(50, 70))]
+        for i, p in enumerate(prompts):
+            engine.add_request(
+                f"r{i}",
+                prompt_token_ids=p,
+                sampling_params=SamplingParams(
+                    temperature=0.0, max_tokens=5, ignore_eos=True
+                ),
+            )
+        done = {}
+        while engine.has_unfinished_requests():
+            for out in engine.step():
+                if out.finished:
+                    done[out.request_id] = out.outputs[0].token_ids
+        return [done[f"r{i}"] for i in range(len(prompts))]
+
+    def _backend(name):
+        if name == "pallas":
+            from vllm_distributed_tpu.ops.pallas.paged_attention import (
+                paged_attention_cpu,
+            )
+
+            return paged_attention_cpu
+        return paged_attention_reference
+
+    assert run("pallas") == run("reference")

@@ -182,8 +182,8 @@ class LlamaForCausalLM:
         return specs
 
     def kv_cache_spec(self) -> P:
-        """KV pages [P, page, Hkv, D]: shard kv heads over tp."""
-        return P(None, None, "tp", None)
+        """KV pages [Hkv, P, page, D]: shard kv heads over tp."""
+        return P("tp", None, None, None)
 
     # ---- forward ----
     def forward(

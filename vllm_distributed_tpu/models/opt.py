@@ -166,7 +166,7 @@ class OPTForCausalLM:
         return specs
 
     def kv_cache_spec(self) -> P:
-        return P(None, None, "tp", None)
+        return P("tp", None, None, None)
 
     def forward(
         self,

@@ -41,7 +41,7 @@ from vllm_distributed_tpu.ops.attention import (
 from vllm_distributed_tpu.ops.sampling import SamplingMetadata, sample
 from vllm_distributed_tpu.outputs import ModelRunnerOutput
 from vllm_distributed_tpu.sampling_params import SamplingParams
-from vllm_distributed_tpu.utils import cdiv, next_power_of_2
+from vllm_distributed_tpu.utils import cdiv, next_power_of_2, round_up
 
 logger = init_logger(__name__)
 
@@ -136,7 +136,7 @@ class ModelRunner:
             * 2
             * self.page_size
             * m.num_kv_heads
-            * m.head_dim
+            * round_up(m.head_dim, 128)  # pool lane padding
             * dtype_size
         )
 
@@ -170,7 +170,10 @@ class ModelRunner:
     def init_kv_cache(self, num_pages: int) -> None:
         m = self.model
         self.num_pages = num_pages
-        shape = (num_pages, self.page_size, m.num_kv_heads, m.head_dim)
+        # Head-major pool: [Hkv, P, page, D] (see ops/attention.py layout);
+        # head dim lane-padded to 128 for DMA-aligned Pallas page copies.
+        d_pad = round_up(m.head_dim, 128)
+        shape = (m.num_kv_heads, num_pages, self.page_size, d_pad)
         sharding = None
         if self.mesh is not None:
             sharding = NamedSharding(self.mesh, m.kv_cache_spec())
@@ -227,11 +230,14 @@ class ModelRunner:
 
         tokens = np.zeros(t_pad, np.int32)
         positions = np.zeros(t_pad, np.int32)
-        seq_ids = np.full(t_pad, s_pad - 1, np.int32)
+        # Padding tokens point one past the last seq row: identifiable as
+        # padding (kernels drop them); OOB gathers clip under jit.
+        seq_ids = np.full(t_pad, s_pad, np.int32)
         slots = np.zeros(t_pad, np.int32)
         block_tables = np.zeros((s_pad, pages_pad), np.int32)
         seq_lens = np.zeros(s_pad, np.int32)
         logits_idx = np.zeros(s_pad, np.int32)
+        chunk_starts = np.zeros(s_pad, np.int32)
         needs_sample = [False] * s_real
 
         cursor = 0
@@ -250,6 +256,7 @@ class ModelRunner:
             block_tables[s, : len(state.page_ids)] = page_arr
             seq_lens[s] = hi
             logits_idx[s] = cursor + n - 1
+            chunk_starts[s] = lo
             needs_sample[s] = hi >= state.prefill_target
             cursor += n
 
@@ -260,7 +267,9 @@ class ModelRunner:
             block_tables=jnp.asarray(block_tables),
             seq_lens=jnp.asarray(seq_lens),
             logits_indices=jnp.asarray(logits_idx),
+            chunk_starts=jnp.asarray(chunk_starts),
         )
+        max_q_pad = max(next_power_of_2(max(num_new)), 1)
 
         smeta, flags = self._build_sampling_metadata(states, s_pad)
         token_ids = jnp.asarray(tokens)
@@ -277,6 +286,7 @@ class ModelRunner:
             token_ids,
             meta,
             smeta,
+            max_q_pad=max_q_pad,
             **flags,
         )
 
@@ -383,6 +393,7 @@ class ModelRunner:
         jax.jit,
         static_argnames=(
             "self",
+            "max_q_pad",
             "do_penalties",
             "do_top_k_p",
             "return_logprobs",
@@ -397,12 +408,16 @@ class ModelRunner:
         meta: AttentionMetadata,
         smeta: SamplingMetadata,
         *,
+        max_q_pad: int,
         do_penalties: bool,
         do_top_k_p: bool,
         return_logprobs: bool,
     ):
+        attn_fn = self._attn_fn
+        if getattr(attn_fn, "needs_max_q", False):
+            attn_fn = partial(attn_fn, max_q=max_q_pad)
         logits, kv_caches = self.model.forward(
-            params, token_ids, kv_caches, meta, attn_fn=self._attn_fn
+            params, token_ids, kv_caches, meta, attn_fn=attn_fn
         )
         tokens, logprobs = sample(
             logits,
