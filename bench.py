@@ -1,24 +1,94 @@
 """Throughput bench — prints ONE JSON line for the driver.
 
-Measures steady-state decode throughput (tokens/sec/chip) of the engine's
-fused step on a Llama-1B-shaped model with dummy bf16 weights, batch 32,
-on whatever backend is live (the real TPU chip under the driver).  The
-reference publishes no numbers (BASELINE.md: "published": {}), so
-vs_baseline is reported as 1.0 by convention.
+Measures steady-state decode throughput (tokens/sec/chip) of the engine on
+a Llama-1B-shaped model with dummy bf16 weights on whatever backend is
+live (the real TPU chip under the driver).  The reference publishes no
+numbers (BASELINE.md: "published": {}), so vs_baseline is reported as 1.0
+by convention; the `detail` block carries the honest engineering numbers:
+per-dispatch latency percentiles, HBM-roofline fraction for the decode
+micro-step, TTFT, and a Pallas-vs-reference kernel check run on the live
+backend before any timing.
 
-Env knobs: VDT_BENCH_MODEL=1b|7b|tiny, VDT_BENCH_BATCH, VDT_BENCH_STEPS.
+Env knobs: VDT_BENCH_MODEL=1b|7b|tiny, VDT_BENCH_BATCH, VDT_BENCH_STEPS
+(decode steps fused per dispatch), VDT_BENCH_DISPATCHES (timed window).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import statistics
 import sys
 import time
 
 
+def _check_pallas_kernel() -> str:
+    """Compare the Pallas kernel against the pure-JAX oracle on the live
+    backend (VERDICT r1 weak #4: the kernel had only ever been
+    correctness-tested in interpreter mode on CPU)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if jax.default_backend() != "tpu":
+        return "skipped (cpu backend)"
+
+    from vllm_distributed_tpu.ops.attention import (
+        AttentionMetadata,
+        paged_attention_reference,
+    )
+    from vllm_distributed_tpu.ops.pallas.paged_attention import paged_attention
+
+    rng = np.random.default_rng(0)
+    hq, hkv, d, page, pages = 8, 4, 128, 16, 8
+    s_pad, t = 4, 8
+    q = jnp.asarray(rng.normal(size=(t, hq, d)), jnp.float32)
+    k_pages = jnp.asarray(
+        rng.normal(size=(pages, page, hkv, d)), jnp.float32
+    )
+    v_pages = jnp.asarray(
+        rng.normal(size=(pages, page, hkv, d)), jnp.float32
+    )
+    # 2 seqs: one decoding at ctx 37, one mid-prefill chunk of 7 at ctx 20.
+    seq_ids = np.full(t, s_pad, np.int32)
+    positions = np.zeros(t, np.int32)
+    seq_ids[0], positions[0] = 0, 36
+    seq_ids[1:8], positions[1:8] = 1, np.arange(13, 20)
+    meta = AttentionMetadata(
+        q_seq_ids=jnp.asarray(seq_ids),
+        q_positions=jnp.asarray(positions),
+        slot_mapping=jnp.zeros(t, jnp.int32),
+        block_tables=jnp.asarray(
+            np.arange(s_pad * pages, dtype=np.int32).reshape(s_pad, pages)
+            % pages
+        ),
+        seq_lens=jnp.asarray([37, 20, 0, 0], jnp.int32),
+        logits_indices=jnp.zeros(s_pad, jnp.int32),
+        chunk_starts=jnp.asarray([36, 13, 0, 0], jnp.int32),
+    )
+    got = np.asarray(
+        paged_attention(q, k_pages, v_pages, meta, scale=0.125, max_q=8)
+    )
+    want = np.asarray(
+        paged_attention_reference(q, k_pages, v_pages, meta, scale=0.125)
+    )
+    # TPU f32 dots truncate to bf16 on the MXU by default, and the two
+    # paths round differently (flash online-softmax vs direct), so the
+    # agreement bound is bf16-scale (eps ≈ 7.8e-3), not f32-scale.
+    err = float(np.max(np.abs(got[:8] - want[:8])))
+    if err > 2e-2:
+        raise AssertionError(f"pallas kernel mismatch on chip: max err {err}")
+    return f"pass (max err {err:.1e})"
+
+
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        # The env var alone can lose to an interpreter-startup jax import
+        # (sitecustomize); the config update before first backend use wins.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     import jax
 
     from vllm_distributed_tpu.config import EngineArgs
@@ -44,8 +114,14 @@ def main() -> None:
             heads=8, kv_heads=4, dtype="float32",
         )
     batch = int(os.environ.get("VDT_BENCH_BATCH", "32"))
-    decode_steps = int(os.environ.get("VDT_BENCH_STEPS", "64"))
+    k_steps = int(os.environ.get("VDT_BENCH_STEPS", "16"))
+    timed_dispatches = int(os.environ.get("VDT_BENCH_DISPATCHES", "6"))
+    warmup_dispatches = 2
     prompt_len = 32
+    # 1 token sampled at prefill + a whole number of fused-K dispatches.
+    max_tokens = 1 + k_steps * (warmup_dispatches + timed_dispatches)
+
+    kernel_check = _check_pallas_kernel()
 
     model_dir = write_llama_config(**shapes)
     engine = LLMEngine.from_engine_args(
@@ -55,31 +131,55 @@ def main() -> None:
             load_format="dummy",
             max_num_seqs=batch,
             max_num_batched_tokens=max(2048, batch * prompt_len),
-            max_model_len=prompt_len + decode_steps + 8,
+            max_model_len=prompt_len + max_tokens + 8,
+            num_decode_steps=k_steps,
         )
     )
     sp = SamplingParams(
-        temperature=0.0, max_tokens=decode_steps, ignore_eos=True
+        temperature=0.0, max_tokens=max_tokens, ignore_eos=True
     )
     for i in range(batch):
         prompt = [(7 * i + j) % 1000 + 1 for j in range(prompt_len)]
         engine.add_request(f"b{i}", prompt_token_ids=prompt, sampling_params=sp)
 
-    # Prefill + warmup decode steps (compile happens here).
-    engine.step()
-    for _ in range(3):
-        engine.step()
+    produced: dict[str, int] = {}
 
+    def run_step() -> int:
+        before = sum(produced.values())
+        for out in engine.step():
+            produced[out.request_id] = len(out.outputs[0].token_ids)
+        return sum(produced.values()) - before
+
+    # Prefill (compiles the prefill program) — time it for TTFT.
     t0 = time.perf_counter()
-    steps = 0
+    run_step()
+    ttft_cold_s = time.perf_counter() - t0
+
+    # Warmup decode dispatches (compiles the fused-K scan).
+    for _ in range(warmup_dispatches):
+        run_step()
+
+    step_ms: list[float] = []
+    timed_tokens = 0
+    t0 = time.perf_counter()
     while engine.has_unfinished_requests():
-        engine.step()
-        steps += 1
+        t1 = time.perf_counter()
+        timed_tokens += run_step()
+        step_ms.append((time.perf_counter() - t1) * 1e3)
     elapsed = time.perf_counter() - t0
-    # Tokens generated during the timed window: batch per decode step.
-    timed_tokens = steps * batch  # upper bound; all finish together here
+
     tps = timed_tokens / elapsed
     n_chips = jax.local_device_count()
+
+    # HBM roofline for one decode micro-step: every parameter byte must be
+    # read once per token batch (weights dominate; KV traffic at this
+    # context length is <1%).  v5e HBM ≈ 819 GB/s.
+    param_bytes = sum(
+        x.nbytes for x in jax.tree.leaves(engine.executor.worker.runner.params)
+    )
+    hbm_bw = 819e9
+    floor_ms = param_bytes / hbm_bw * 1e3
+    micro_ms = 1e3 / (tps / batch) if tps else float("inf")
     result = {
         "metric": f"decode_tokens_per_sec_per_chip_llama_{which}",
         "value": round(tps / n_chips, 2),
@@ -88,8 +188,17 @@ def main() -> None:
         "detail": {
             "backend": jax.default_backend(),
             "batch": batch,
-            "decode_steps": steps,
+            "decode_steps_fused": k_steps,
+            "timed_tokens": timed_tokens,
             "elapsed_s": round(elapsed, 3),
+            "dispatch_ms_p50": round(statistics.median(step_ms), 2),
+            "dispatch_ms_max": round(max(step_ms), 2),
+            "decode_microstep_ms": round(micro_ms, 3),
+            "hbm_roofline_microstep_ms": round(floor_ms, 3),
+            "roofline_frac": round(min(floor_ms / micro_ms, 1.0), 3),
+            "ttft_cold_s": round(ttft_cold_s, 2),
+            "param_bytes": param_bytes,
+            "pallas_kernel_check": kernel_check,
         },
     }
     print(json.dumps(result))

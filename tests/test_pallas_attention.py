@@ -37,10 +37,10 @@ def build_case(
     pages_pad = max(8, 1 << (max_pages_needed - 1).bit_length())
 
     k_pages = jnp.asarray(
-        rng.standard_normal((hkv, num_pages, page_size, d)), dtype
+        rng.standard_normal((num_pages, page_size, hkv, d)), dtype
     )
     v_pages = jnp.asarray(
-        rng.standard_normal((hkv, num_pages, page_size, d)), dtype
+        rng.standard_normal((num_pages, page_size, hkv, d)), dtype
     )
     q = jnp.asarray(rng.standard_normal((t_pad, hq, d)), dtype)
 

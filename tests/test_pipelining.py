@@ -1,0 +1,107 @@
+"""Fused multi-step decode + engine pipelining (VERDICT r1 items #2/#3).
+
+Proves (a) two dispatches are genuinely in flight at once, (b) the fused
+decode scan and the pipelined engine produce bit-identical tokens to the
+fully synchronous single-step engine, and (c) mixed finish times / stop
+conditions drain the pipeline correctly.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from vllm_distributed_tpu.config import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.testing import write_llama_config
+
+
+def _run(num_decode_steps: int, sampling_kwargs_per_req, track=None):
+    engine = LLMEngine.from_engine_args(
+        EngineArgs(
+            model=write_llama_config(),
+            skip_tokenizer_init=True,
+            load_format="dummy",
+            num_kv_pages=128,
+            max_model_len=256,
+            num_decode_steps=num_decode_steps,
+        )
+    )
+    for i, kw in enumerate(sampling_kwargs_per_req):
+        engine.add_request(
+            f"r{i}",
+            prompt_token_ids=[3 + i, 7, 11 + i],
+            sampling_params=SamplingParams(**kw),
+        )
+    results: dict[str, list[int]] = {}
+    steps = 0
+    while engine.has_unfinished_requests():
+        if track is not None:
+            track.append(len(engine._pending))
+        for out in engine.step():
+            if out.finished:
+                results[out.request_id] = out.outputs[0].token_ids
+        steps += 1
+        assert steps < 500
+    return results
+
+
+def test_pipelined_greedy_matches_sync():
+    reqs = [dict(temperature=0.0, max_tokens=33, ignore_eos=True)] * 4
+    sync = _run(1, reqs)
+    pipelined = _run(8, reqs)
+    assert sync == pipelined
+
+
+def test_pipelined_seeded_sampling_matches_sync():
+    reqs = [
+        dict(temperature=0.9, seed=41 + i, max_tokens=19, ignore_eos=True)
+        for i in range(3)
+    ]
+    sync = _run(1, reqs)
+    pipelined = _run(4, reqs)
+    assert sync == pipelined
+
+
+def test_two_dispatches_in_flight():
+    depths: list[int] = []
+    reqs = [dict(temperature=0.0, max_tokens=49, ignore_eos=True)] * 2
+    _run(8, reqs, track=depths)
+    # At least one step() began with a dispatch still unresolved.
+    assert max(depths) >= 1
+
+
+def test_mixed_finish_times_drain():
+    reqs = [
+        dict(temperature=0.0, max_tokens=9, ignore_eos=True),
+        dict(temperature=0.0, max_tokens=30, ignore_eos=True),
+        dict(temperature=0.0, max_tokens=17, ignore_eos=True),
+    ]
+    out = _run(8, reqs)
+    assert sorted(len(v) for v in out.values()) == [9, 17, 30]
+    assert out == _run(1, reqs)
+
+
+def test_penalties_fall_back_to_sync_and_match():
+    reqs = [
+        dict(
+            temperature=0.8,
+            seed=7,
+            repetition_penalty=1.3,
+            max_tokens=12,
+            ignore_eos=True,
+        )
+    ]
+    assert _run(8, reqs) == _run(1, reqs)
+
+
+def test_stop_token_mid_window():
+    # Greedy on dummy weights is deterministic: find what it generates,
+    # then use an early token as a stop token and check truncation.
+    probe = _run(1, [dict(temperature=0.0, max_tokens=24, ignore_eos=True)])
+    toks = probe["r0"]
+    stop_tok = toks[5]
+    reqs = [dict(temperature=0.0, max_tokens=24, stop_token_ids=[stop_tok])]
+    out = _run(8, reqs)
+    idx = toks.index(stop_tok)
+    assert out["r0"] == toks[: idx + 1]

@@ -179,6 +179,11 @@ class SchedulerConfig:
     max_num_batched_tokens: int = 2048
     enable_chunked_prefill: bool = True
     max_model_len: int = 2048
+    # Decode steps fused into one device dispatch when every running
+    # request is decoding (a lax.scan on device).  Amortizes the per-step
+    # host round trip — the TPU answer to SURVEY.md §3.3's "push the
+    # steady-state loop into a compiled while-loop".  1 disables.
+    num_decode_steps: int = 8
 
     def __post_init__(self) -> None:
         if self.max_num_batched_tokens < self.max_num_seqs:
@@ -186,6 +191,8 @@ class SchedulerConfig:
                 "max_num_batched_tokens must be >= max_num_seqs "
                 f"({self.max_num_batched_tokens} < {self.max_num_seqs})"
             )
+        if self.num_decode_steps < 1:
+            raise ValueError("num_decode_steps must be >= 1")
 
 
 @dataclass
@@ -273,6 +280,7 @@ class EngineArgs:
     max_num_seqs: int = 64
     max_num_batched_tokens: int | None = None
     enable_chunked_prefill: bool = True
+    num_decode_steps: int = 8
 
     device: str = "auto"
     profile_dir: str | None = None
@@ -326,6 +334,12 @@ class EngineArgs:
         parser.add_argument("--coordinator-address", type=str, default=None)
         parser.add_argument("--max-num-seqs", type=int, default=64)
         parser.add_argument("--max-num-batched-tokens", type=int, default=None)
+        parser.add_argument(
+            "--num-decode-steps",
+            type=int,
+            default=8,
+            help="decode steps fused into one device dispatch (1 disables)",
+        )
         parser.add_argument(
             "--no-enable-chunked-prefill",
             dest="enable_chunked_prefill",
@@ -382,6 +396,7 @@ class EngineArgs:
             max_num_batched_tokens=max_batched,
             enable_chunked_prefill=self.enable_chunked_prefill,
             max_model_len=model_config.max_model_len,
+            num_decode_steps=self.num_decode_steps,
         )
         return EngineConfig(
             model_config=model_config,

@@ -55,6 +55,11 @@ class LLMEngine:
         self.detokenizers: dict[str, IncrementalDetokenizer] = {}
         self._failed = False
         self.executor.register_failure_callback(self._on_failure)
+        # Pipelining: dispatched-but-unapplied fused-decode steps (at most
+        # one between step() calls, two briefly within a call) — the
+        # engine-side realization of the reference's in-flight batches
+        # (max_concurrent_batches, launch.py:298-302).
+        self._pending: list[tuple[Any, Any]] = []
 
     @classmethod
     def from_engine_args(cls, engine_args: EngineArgs) -> "LLMEngine":
@@ -120,13 +125,86 @@ class LLMEngine:
         return self.scheduler.has_unfinished_requests()
 
     # ---- the loop ----
+    def _pipeline_safe(self) -> bool:
+        """True when the next schedule() is guaranteed to be a pure decode
+        continuation of what's in flight: same running set, no admissions,
+        no prefills, no per-step host feedback (logprobs/penalties), and
+        enough free pages that scheduling cannot preempt anything."""
+        s = self.scheduler
+        if s.config.num_decode_steps <= 1 or s.waiting or not s.running:
+            return False
+        for r in s.running:
+            sp = r.sampling_params
+            if (
+                r.is_prefill
+                or sp.logprobs is not None
+                or sp.repetition_penalty != 1.0
+                or sp.presence_penalty != 0.0
+                or sp.frequency_penalty != 0.0
+            ):
+                return False
+            # A request whose remaining budget is fully in flight would be
+            # skipped by the scheduler, shrinking the batch and breaking
+            # the device carry's request order — drain first instead.
+            room = (
+                min(r.max_total_tokens, s.config.max_model_len)
+                - r.num_tokens
+                - r.num_inflight_tokens
+            )
+            if room <= 0:
+                return False
+        if self._pending:
+            prev_order = [
+                c.req_id for c in self._pending[-1][0].cached_requests
+            ]
+            if prev_order != [r.request_id for r in s.running]:
+                return False
+            k = s.config.num_decode_steps
+            worst = sum(
+                k // s.page_size + 1 for _ in s.running
+            )
+            if s.allocator.num_free_pages < worst:
+                return False
+        return True
+
+    def _finalize_one(self) -> list[RequestOutput]:
+        scheduler_output, result = self._pending.pop(0)
+        if hasattr(result, "result"):  # Future
+            result = result.result()
+        return self._process(scheduler_output, result)
+
+    def _drain_pending(self) -> list[RequestOutput]:
+        outputs: list[RequestOutput] = []
+        while self._pending:
+            outputs.extend(self._finalize_one())
+        return outputs
+
     def step(self) -> list[RequestOutput]:
         if self._failed:
             raise RuntimeError("Engine executor failed.")
+        outputs: list[RequestOutput] = []
+        if self._pending and not self._pipeline_safe():
+            outputs.extend(self._drain_pending())
         scheduler_output = self.scheduler.schedule()
         if scheduler_output.is_empty:
-            return []
+            outputs.extend(self._drain_pending())
+            return outputs
+        if scheduler_output.decode_steps > 1 and self._pipeline_safe():
+            fut = self.executor.execute_model(
+                scheduler_output, non_block=True
+            )
+            self._pending.append((scheduler_output, fut))
+            if len(self._pending) > 1:
+                outputs.extend(self._finalize_one())
+            return outputs
+        outputs.extend(self._drain_pending())
         runner_output = self.executor.execute_model(scheduler_output)
+        outputs.extend(self._process(scheduler_output, runner_output))
+        return outputs
+
+    def _process(
+        self, scheduler_output, runner_output
+    ) -> list[RequestOutput]:
         finished = self.scheduler.update_from_output(
             scheduler_output, runner_output.sampled_token_ids
         )
@@ -154,6 +232,16 @@ class LLMEngine:
             if detok is not None and new_tokens:
                 detok.append(new_tokens)
                 if detok.stopped_on is not None and not req.status.is_finished:
+                    # Truncate tokens generated past the stop string so
+                    # token_ids/logprobs/usage agree with the text.
+                    keep = detok.stop_token_count
+                    dropped = req.output_token_ids[keep:]
+                    if dropped:
+                        del req.output_token_ids[keep:]
+                        if req.logprobs is not None and len(req.logprobs) > keep:
+                            for tok, lp in zip(dropped, req.logprobs[keep:]):
+                                req.cumulative_logprob -= lp.get(tok, 0.0)
+                            del req.logprobs[keep:]
                     self.scheduler.finish_request(
                         req, RequestStatus.FINISHED_STOPPED
                     )

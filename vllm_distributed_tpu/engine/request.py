@@ -48,6 +48,10 @@ class Request:
     output_token_ids: list[int] = field(default_factory=list)
     # How many tokens have had their KV computed (chunked prefill cursor).
     num_computed_tokens: int = 0
+    # Decode tokens scheduled to the device but whose sampled results have
+    # not been applied yet (engine pipelining: dispatch N+1 can be issued
+    # before N's tokens arrive; the device scan carries the real values).
+    num_inflight_tokens: int = 0
     # Page ids owned by this request, in order.
     page_ids: list[int] = field(default_factory=list)
     # After preemption-resume, KV for already-generated tokens must be

@@ -64,11 +64,15 @@ class SchedulerOutput:
     step_id: int
     new_requests: list[NewRequestData] = field(default_factory=list)
     cached_requests: list[CachedRequestData] = field(default_factory=list)
-    # req_id -> num tokens to run this step (prefill chunk len or 1).
+    # req_id -> num tokens to run this step (prefill chunk len, or the
+    # decode_steps fused this dispatch).
     num_scheduled_tokens: dict[str, int] = field(default_factory=dict)
     total_num_scheduled_tokens: int = 0
     finished_req_ids: list[str] = field(default_factory=list)
     preempted_req_ids: list[str] = field(default_factory=list)
+    # >1 = every scheduled request is a decode and the worker runs this
+    # many fused decode micro-steps on device (one sampled token each).
+    decode_steps: int = 1
 
     @property
     def is_empty(self) -> bool:
@@ -155,6 +159,34 @@ class Scheduler:
 
         token_budget = self.config.max_num_batched_tokens
 
+        # Multi-step decode: when the whole batch is decoding and nothing
+        # is waiting to be admitted, fuse K decode steps into one device
+        # dispatch.  K is clamped so no request can overrun its length
+        # limit mid-scan, and floored to a power of two to bound the
+        # number of distinct compiled scan lengths.  Logprobs force K=1
+        # (per-step [S, V] logprob fetches don't amortize).
+        k = 1
+        if (
+            self.config.num_decode_steps > 1
+            and self.running
+            and not self.waiting
+            and all(not r.is_prefill for r in self.running)
+            and all(
+                r.sampling_params.logprobs is None for r in self.running
+            )
+        ):
+            rooms = [
+                min(r.max_total_tokens, self.config.max_model_len)
+                - r.num_tokens
+                - r.num_inflight_tokens
+                for r in self.running
+            ]
+            positive = [x for x in rooms if x > 0]
+            if positive:
+                k = max(min(self.config.num_decode_steps, min(positive)), 1)
+                k = 1 << (k.bit_length() - 1)  # power-of-2 floor
+        out.decode_steps = k
+
         # 1) decodes + in-flight chunked prefills, in arrival order.
         #    Iterate over a copy: preemption mutates self.running.
         scheduled_running: list[Request] = []
@@ -171,9 +203,21 @@ class Scheduler:
                     continue
                 num_new = chunk
             else:
-                num_new = 1
+                # Skip decodes that already have their whole remaining
+                # budget in flight (pipelining: results not applied yet).
+                room = (
+                    min(req.max_total_tokens, self.config.max_model_len)
+                    - req.num_tokens
+                    - req.num_inflight_tokens
+                )
+                if room <= 0:
+                    continue
+                num_new = k
             got = self._allocate_or_preempt(
-                req, num_new, preempted, scheduled_running
+                req,
+                req.num_inflight_tokens + num_new,
+                preempted,
+                scheduled_running,
             )
             if not got:
                 continue
@@ -185,10 +229,15 @@ class Scheduler:
                 CachedRequestData(
                     req_id=req.request_id,
                     new_page_ids=new_pages,
-                    num_computed_tokens=req.num_computed_tokens,
+                    # The worker's view of "computed" at dispatch time
+                    # includes tokens still in flight on the device.
+                    num_computed_tokens=req.num_computed_tokens
+                    + req.num_inflight_tokens,
                     num_new_tokens=num_new,
                 )
             )
+            if not req.is_prefill:
+                req.num_inflight_tokens += num_new
             scheduled_running.append(req)
 
         # 2) admit waiting requests while budget and seats remain.
@@ -281,6 +330,10 @@ class Scheduler:
         self.allocator.free(req)
         req.status = RequestStatus.PREEMPTED
         req.num_computed_tokens = 0
+        # In-flight sampled tokens are lost on preemption; the request
+        # re-prefills to what the host has and regenerates (same PRNG
+        # stream position, so seeded sampling is unaffected).
+        req.num_inflight_tokens = 0
         req.resume_target = req.num_tokens
         if req in self.running:
             self.running.remove(req)
@@ -304,6 +357,7 @@ class Scheduler:
             if req is None or req.status != RequestStatus.RUNNING:
                 continue  # aborted mid-step
             req.num_computed_tokens += num
+            req.num_inflight_tokens = max(req.num_inflight_tokens - num, 0)
             new_tokens = sampled_token_ids.get(req_id, [])
             for tok in new_tokens:
                 req.append_output_token(tok)

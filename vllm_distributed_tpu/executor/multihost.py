@@ -29,6 +29,7 @@ import asyncio
 import concurrent.futures
 import os
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any
 
@@ -233,8 +234,19 @@ class MultiHostExecutor(Executor):
         return self._gather(futures, unique_reply_rank, timeout)
 
     def _gather(self, futures, unique_reply_rank, timeout):
+        # One overall deadline, not timeout × num_hosts.
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
         try:
-            results = [f.result(timeout=timeout) for f in futures]
+            results = [
+                f.result(
+                    timeout=None
+                    if deadline is None
+                    else max(deadline - time.monotonic(), 0.0)
+                )
+                for f in futures
+            ]
         except Exception as e:  # noqa: BLE001
             logger.error("collective_rpc failed: %s", e)
             self._notify_failure()

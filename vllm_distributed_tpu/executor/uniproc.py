@@ -20,8 +20,28 @@ from vllm_distributed_tpu.worker.worker import Worker
 class UniProcExecutor(Executor):
     def _init_executor(self) -> None:
         self.worker = Worker(self.config, rank=0, is_driver_worker=True)
+        # One resolver thread: fetches a dispatched step's results while
+        # the engine thread issues the next dispatch (two in flight).
+        self._resolve_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="vdt-resolve"
+        )
         self.collective_rpc("init_device")
         self.collective_rpc("load_model")
+
+    def execute_model(self, scheduler_output, non_block: bool = False):
+        out = self.worker.execute_model(scheduler_output, defer=True)
+        if callable(out):
+            if non_block:
+                return self._resolve_pool.submit(out)
+            return out()
+        if non_block:
+            fut: concurrent.futures.Future = concurrent.futures.Future()
+            fut.set_result(out)
+            return fut
+        return out
+
+    def shutdown(self) -> None:
+        self._resolve_pool.shutdown(wait=False)
 
     def collective_rpc(
         self,

@@ -182,8 +182,8 @@ class LlamaForCausalLM:
         return specs
 
     def kv_cache_spec(self) -> P:
-        """KV pages [Hkv, P, page, D]: shard kv heads over tp."""
-        return P("tp", None, None, None)
+        """KV pages [P, page, Hkv, D]: shard kv heads over tp."""
+        return P(None, None, "tp", None)
 
     # ---- forward ----
     def forward(
@@ -193,6 +193,7 @@ class LlamaForCausalLM:
         kv_caches: list,  # per layer (k_pages, v_pages)
         meta: AttentionMetadata,
         attn_fn: Callable = paged_attention_reference,
+        kv_write_fn: Callable = write_kv_pages,
     ) -> tuple[jax.Array, list]:
         """Returns (logits [S, V] at meta.logits_indices, updated kv)."""
         x = params["embed"][token_ids].astype(self.dtype)
@@ -214,7 +215,7 @@ class LlamaForCausalLM:
                 k = rms_norm(k, layer["k_norm"], self.rms_eps)
             q = apply_rope(q, meta.q_positions, inv_freq)
             k = apply_rope(k, meta.q_positions, inv_freq)
-            k_pages, v_pages = write_kv_pages(
+            k_pages, v_pages = kv_write_fn(
                 k_pages, v_pages, k, v, meta.slot_mapping
             )
             new_kv.append((k_pages, v_pages))

@@ -166,7 +166,8 @@ class OPTForCausalLM:
         return specs
 
     def kv_cache_spec(self) -> P:
-        return P("tp", None, None, None)
+        """KV pages [P, page, Hkv, D]: shard kv heads over tp."""
+        return P(None, None, "tp", None)
 
     def forward(
         self,
@@ -175,6 +176,7 @@ class OPTForCausalLM:
         kv_caches: list,
         meta: AttentionMetadata,
         attn_fn: Callable = paged_attention_reference,
+        kv_write_fn: Callable = write_kv_pages,
     ) -> tuple[jax.Array, list]:
         t = token_ids.shape[0]
         x = params["embed"][token_ids].astype(self.dtype)
@@ -200,7 +202,7 @@ class OPTForCausalLM:
             v = linear(h, layer["wv"], layer["bv"]).reshape(
                 t, self.num_kv_heads, self.head_dim
             )
-            k_pages, v_pages = write_kv_pages(
+            k_pages, v_pages = kv_write_fn(
                 k_pages, v_pages, k, v, meta.slot_mapping
             )
             new_kv.append((k_pages, v_pages))

@@ -5,12 +5,14 @@ reference inherits from the vLLM image (SURVEY.md §2.2).  The layout
 contract shared by the allocator (engine/block_manager.py), the model
 runner's KV scatter, and the kernels:
 
-- KV pool: ``k_pages``/``v_pages`` of shape ``[num_kv_heads, num_pages,
-  page_size, head_dim]`` — head-major so (a) each (head, page) block is
-  one contiguous, tile-aligned [page_size, head_dim] DMA for the Pallas
-  kernel, and (b) the TP shard axis is the leading dim.  Token ``t`` of a
-  request lives at flat slot ``page_ids[t // page_size] * page_size +
-  t % page_size`` of every head's pool.
+- KV pool: ``k_pages``/``v_pages`` of shape ``[num_pages, page_size,
+  num_kv_heads, head_dim]`` — slot-major so (a) one token's K/V row
+  ``[Hkv, D]`` is a tile-aligned single DMA target (the in-place Pallas
+  writer needs single-slot writes; Mosaic only allows full-tile slices
+  of the minor-two dims), and (b) a page is one contiguous
+  ``[page_size, Hkv, D]`` DMA for the attention kernel.  Token ``t`` of
+  a request lives at flat slot ``page_ids[t // page_size] * page_size +
+  t % page_size``.
 - A step's work is a flat token batch ``[T]`` spanning mixed prefill
   chunks and decodes; ``q_seq_ids``/``q_positions`` say which sequence and
   absolute position each query token has.
@@ -71,35 +73,33 @@ def write_kv_pages(
 ) -> tuple[jax.Array, jax.Array]:
     """Scatter this step's K/V ([T, Hkv, D]) into the paged pool.
 
-    Works on the flat [Hkv, num_pages * page_size, D] view; with the
-    cache donated to the jitted step, XLA performs this in place in HBM.
+    Functional reference / CPU path.  The production TPU path is the
+    aliased Pallas writer (ops/pallas/kv_update.py) — XLA does not keep
+    this scatter in place inside the fused decode scan at large pool
+    sizes.
     """
-    hkv, num_pages, page_size, d = k_pages.shape
+    num_pages, page_size, hkv, d = k_pages.shape
     if k.shape[-1] < d:
         # Pool head dim is lane-padded (to 128) for the Pallas kernel's
         # DMA alignment; zero-pad the incoming heads to match.
         pad = [(0, 0), (0, 0), (0, d - k.shape[-1])]
         k = jnp.pad(k, pad)
         v = jnp.pad(v, pad)
-    flat_k = k_pages.reshape(hkv, num_pages * page_size, d)
-    flat_v = v_pages.reshape(hkv, num_pages * page_size, d)
-    flat_k = flat_k.at[:, slot_mapping].set(
-        k.transpose(1, 0, 2).astype(flat_k.dtype)
-    )
-    flat_v = flat_v.at[:, slot_mapping].set(
-        v.transpose(1, 0, 2).astype(flat_v.dtype)
-    )
+    flat_k = k_pages.reshape(num_pages * page_size, hkv, d)
+    flat_v = v_pages.reshape(num_pages * page_size, hkv, d)
+    flat_k = flat_k.at[slot_mapping].set(k.astype(flat_k.dtype))
+    flat_v = flat_v.at[slot_mapping].set(v.astype(flat_v.dtype))
     return (
-        flat_k.reshape(hkv, num_pages, page_size, d),
-        flat_v.reshape(hkv, num_pages, page_size, d),
+        flat_k.reshape(num_pages, page_size, hkv, d),
+        flat_v.reshape(num_pages, page_size, hkv, d),
     )
 
 
 @partial(jax.jit, static_argnames=("scale", "soft_cap"))
 def paged_attention_reference(
     q: jax.Array,  # [T, Hq, D]
-    k_pages: jax.Array,  # [Hkv, P, page_size, D]
-    v_pages: jax.Array,  # [Hkv, P, page_size, D]
+    k_pages: jax.Array,  # [P, page_size, Hkv, D]
+    v_pages: jax.Array,  # [P, page_size, Hkv, D]
     metadata: AttentionMetadata,
     *,
     scale: float,
@@ -109,7 +109,7 @@ def paged_attention_reference(
     KV history.  O(T × max_ctx) with full gathers — the oracle, not the
     fast path."""
     t, hq, d = q.shape
-    hkv, _, page_size, d_pool = k_pages.shape
+    _, page_size, hkv, d_pool = k_pages.shape
     s, max_pages = metadata.block_tables.shape
     groups = hq // hkv
     max_ctx = max_pages * page_size
@@ -117,17 +117,17 @@ def paged_attention_reference(
         k_pages = k_pages[..., :d]
         v_pages = v_pages[..., :d]
 
-    # Gather each sequence's KV: [Hkv, S, max_ctx, D].
-    k_all = k_pages[:, metadata.block_tables].reshape(hkv, s, max_ctx, d)
-    v_all = v_pages[:, metadata.block_tables].reshape(hkv, s, max_ctx, d)
+    # Gather each sequence's KV: [S, max_ctx, Hkv, D].
+    k_all = k_pages[metadata.block_tables].reshape(s, max_ctx, hkv, d)
+    v_all = v_pages[metadata.block_tables].reshape(s, max_ctx, hkv, d)
 
-    # Per query token, its sequence's KV: [Hkv, T, max_ctx, D].
-    k_tok = k_all[:, metadata.q_seq_ids]
-    v_tok = v_all[:, metadata.q_seq_ids]
+    # Per query token, its sequence's KV: [T, max_ctx, Hkv, D].
+    k_tok = k_all[metadata.q_seq_ids]
+    v_tok = v_all[metadata.q_seq_ids]
 
     qg = q.reshape(t, hkv, groups, d).astype(jnp.float32)
     scores = jnp.einsum(
-        "thgd,htcd->thgc", qg, k_tok.astype(jnp.float32)
+        "thgd,tchd->thgc", qg, k_tok.astype(jnp.float32)
     ) * scale  # [T, Hkv, G, C]
     if soft_cap is not None:
         scores = jnp.tanh(scores / soft_cap) * soft_cap
@@ -142,5 +142,5 @@ def paged_attention_reference(
     denom = jnp.sum(probs, axis=-1, keepdims=True)
     probs = probs / jnp.maximum(denom, 1e-30)
 
-    out = jnp.einsum("thgc,htcd->thgd", probs, v_tok.astype(jnp.float32))
+    out = jnp.einsum("thgc,tchd->thgd", probs, v_tok.astype(jnp.float32))
     return out.reshape(t, hq, d).astype(q.dtype)

@@ -1,0 +1,109 @@
+"""Pallas in-place paged KV-cache writer.
+
+The functional scatter (`ops.attention.write_kv_pages`) is correct but
+XLA does not reliably alias it inside the fused decode scan — at large
+pool sizes it materializes a full pool copy per layer per micro-step,
+which dominates step time (measured: 5× end-to-end).  This kernel writes
+the step's K/V rows straight into the paged HBM pool with
+``input_output_aliases``, so the update is in place by construction —
+the TPU analog of vLLM's CUDA `reshape_and_cache` (SURVEY.md §2.2).
+
+Layout contract (shared with ops/attention.py): pool is slot-major
+``[num_pages, page_size, Hkv, D]``, so one token's K/V row ``[Hkv, D]``
+is a single DMA whose sliced dims are major (Mosaic allows arbitrary
+slicing there; the minor two dims ride whole).  Token t of a request
+lands at flat slot ``page_ids[t // page_size] * page_size +
+t % page_size``; padding tokens carry slots inside reserved page 0.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(
+    slots_ref,  # [T] int32 (SMEM, scalar prefetch)
+    k_new_ref,  # [1, Hkv, D] VMEM block (token t's heads)
+    v_new_ref,
+    k_pages_in,  # [P, page, Hkv, D] ANY (aliased with k_pages_out)
+    v_pages_in,
+    k_pages_out,
+    v_pages_out,
+    sems,  # DMA sems [2]
+    *,
+    page_size: int,
+):
+    t = pl.program_id(0)
+    slot = slots_ref[t]
+    page = slot // page_size
+    row = slot % page_size
+    k_cp = pltpu.make_async_copy(
+        k_new_ref.at[0], k_pages_out.at[page, row], sems.at[0]
+    )
+    v_cp = pltpu.make_async_copy(
+        v_new_ref.at[0], v_pages_out.at[page, row], sems.at[1]
+    )
+    k_cp.start()
+    v_cp.start()
+    k_cp.wait()
+    v_cp.wait()
+
+
+def kv_update(
+    k_pages: jax.Array,  # [P, page, Hkv, D]
+    v_pages: jax.Array,
+    k: jax.Array,  # [T, Hkv, Dq]  (Dq <= D; lane-padded here)
+    v: jax.Array,
+    slot_mapping: jax.Array,  # [T] int32
+    *,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Drop-in for write_kv_pages, writing in place via aliasing."""
+    p_total, page_size, hkv, d = k_pages.shape
+    t = k.shape[0]
+    if k.shape[-1] < d:
+        pad = [(0, 0), (0, 0), (0, d - k.shape[-1])]
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    k = k.astype(k_pages.dtype)
+    v = v.astype(v_pages.dtype)
+
+    kernel = functools.partial(_kernel, page_size=page_size)
+    out_shape = (
+        jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+        jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+    )
+    k_pages, v_pages = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(t,),
+            in_specs=[
+                pl.BlockSpec((1, hkv, d), lambda t_, *refs: (t_, 0, 0)),
+                pl.BlockSpec((1, hkv, d), lambda t_, *refs: (t_, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            scratch_shapes=[pltpu.SemaphoreType.DMA((2,))],
+        ),
+        out_shape=out_shape,
+        # Inputs count scalar-prefetch first: 0=slots, 1=k, 2=v,
+        # 3=k_pages, 4=v_pages → outputs (0=k_pages, 1=v_pages).
+        input_output_aliases={3: 0, 4: 1},
+        interpret=interpret,
+    )(slot_mapping, k, v, k_pages, v_pages)
+    return k_pages, v_pages
+
+
+def kv_update_cpu(*args, **kwargs):
+    """Interpret-mode entry for CPU tests."""
+    return kv_update(*args, interpret=True, **kwargs)

@@ -12,9 +12,11 @@ Design (tuned for DMA efficiency + VMEM budget on v5e):
   flash state (m, l, acc) lives in VMEM scratch across kv steps; q
   blocks tile long prefill chunks so scratch fits VMEM.
 - All KV heads are processed inside one program, so each page is ONE
-  contiguous [Hkv, page_size, D] DMA from HBM instead of per-head
-  slivers.  KV pool layout is head-major ``[Hkv, P, page, D]``
-  (ops/attention.py): `.at[:, page]` is tile-aligned.
+  contiguous [page_size, Hkv, D] DMA from HBM instead of per-head
+  slivers.  KV pool layout is slot-major ``[P, page, Hkv, D]``
+  (ops/attention.py): `.at[page]` is a major-dim slice, and the same
+  layout lets the in-place Pallas writer (kv_update.py) target single
+  token rows.
 - Double buffering: program (s, qb, b) waits for the block prefetched
   by (s, qb, b-1) and prefetches block b+1, overlapping DMA + compute.
 - Causal skip: kv blocks entirely above the q block's last position are
@@ -53,13 +55,13 @@ def _kernel(
     chunk_starts_ref,  # [S] int32
     # inputs
     q_ref,  # [1, Hkv, QROWS, D] VMEM block
-    k_pages_ref,  # [Hkv, P, page, D] in HBM/ANY
+    k_pages_ref,  # [P, page, Hkv, D] in HBM/ANY
     v_pages_ref,
     # outputs
     out_ref,  # [1, Hkv, QROWS, D] VMEM block
     # scratch
-    k_vmem,  # [2, Hkv, BLK, D]
-    v_vmem,  # [2, Hkv, BLK, D]
+    k_vmem,  # [2, BLK, Hkv, D]
+    v_vmem,  # [2, BLK, Hkv, D]
     m_scr,  # [Hkv, QROWS, LANES] f32
     l_scr,  # [Hkv, QROWS, LANES] f32
     acc_scr,  # [Hkv, QROWS, D] f32
@@ -87,21 +89,21 @@ def _kernel(
         return (b * blk < seq_len) & (b * blk <= q_pos_max)
 
     def block_dma(block_idx, buf):
-        """One DMA per page, each covering every head: [Hkv, page, D]."""
+        """One DMA per page, each covering every head: [page, Hkv, D]."""
         copies = []
         for i in range(pages_per_blk):
             page = block_tables_ref[s, block_idx * pages_per_blk + i]
             copies.append(
                 pltpu.make_async_copy(
-                    k_pages_ref.at[:, page],
-                    k_vmem.at[buf, :, pl.ds(i * page_size, page_size), :],
+                    k_pages_ref.at[page],
+                    k_vmem.at[buf, pl.ds(i * page_size, page_size)],
                     sems.at[0, buf],
                 )
             )
             copies.append(
                 pltpu.make_async_copy(
-                    v_pages_ref.at[:, page],
-                    v_vmem.at[buf, :, pl.ds(i * page_size, page_size), :],
+                    v_pages_ref.at[page],
+                    v_vmem.at[buf, pl.ds(i * page_size, page_size)],
                     sems.at[1, buf],
                 )
             )
@@ -146,8 +148,8 @@ def _kernel(
 
         for h in range(num_kv_heads):
             q = q_ref[0, h].astype(jnp.float32)  # [QROWS, D]
-            k = k_vmem[buf, h].astype(jnp.float32)  # [BLK, D]
-            v = v_vmem[buf, h].astype(jnp.float32)
+            k = k_vmem[buf, :, h, :].astype(jnp.float32)  # [BLK, D]
+            v = v_vmem[buf, :, h, :].astype(jnp.float32)
             scores = (
                 jax.lax.dot_general(
                     q, k, (((1,), (1,)), ((), ())),
@@ -189,7 +191,7 @@ def _pow2_floor(x: int) -> int:
 
 def paged_attention(
     q: jax.Array,  # [T, Hq, D] flat
-    k_pages: jax.Array,  # [Hkv, P, page, D]
+    k_pages: jax.Array,  # [P, page, Hkv, D]
     v_pages: jax.Array,
     metadata: AttentionMetadata,
     *,
@@ -202,7 +204,7 @@ def paged_attention(
     flash kernel.  `max_q` is the static per-sequence query bound for this
     step (the runner's padded max chunk length)."""
     t, hq, d_q = q.shape
-    hkv, p_total, page_size, d = k_pages.shape
+    p_total, page_size, hkv, d = k_pages.shape
     s, max_pages = metadata.block_tables.shape
     g = hq // hkv
     if d > d_q:
@@ -245,6 +247,13 @@ def paged_attention(
     pages_per_blk = max(blk_tokens // page_size, 1)
     num_kvb = cdiv(max_pages, pages_per_blk)
     blk = pages_per_blk * page_size
+    if max_pages % pages_per_blk:
+        # Pad the table so block_dma never reads a page id out of bounds
+        # (padding pages are id 0 — a real page, masked out of scores).
+        pad = pages_per_blk - max_pages % pages_per_blk
+        block_tables = jnp.pad(metadata.block_tables, ((0, 0), (0, pad)))
+    else:
+        block_tables = metadata.block_tables
 
     grid = (s, num_qb, num_kvb)
     kernel = functools.partial(
@@ -276,8 +285,8 @@ def paged_attention(
                 lambda s_, qb_, b_, *refs: (s_, 0, qb_, 0),
             ),
             scratch_shapes=[
-                pltpu.VMEM((2, hkv, blk, d), k_pages.dtype),
-                pltpu.VMEM((2, hkv, blk, d), v_pages.dtype),
+                pltpu.VMEM((2, blk, hkv, d), k_pages.dtype),
+                pltpu.VMEM((2, blk, hkv, d), v_pages.dtype),
                 pltpu.VMEM((hkv, qrows, _LANES), jnp.float32),
                 pltpu.VMEM((hkv, qrows, _LANES), jnp.float32),
                 pltpu.VMEM((hkv, qrows, d), jnp.float32),
@@ -287,7 +296,7 @@ def paged_attention(
         out_shape=jax.ShapeDtypeStruct((s, hkv, maxq * g, d), q.dtype),
         interpret=interpret,
     )(
-        metadata.block_tables,
+        block_tables,
         metadata.seq_lens,
         metadata.chunk_starts,
         q_grouped,

@@ -89,6 +89,13 @@ class IncrementalDetokenizer:
                     return new_text
         return new_text
 
+    @property
+    def stop_token_count(self) -> int:
+        """Output tokens consumed up to and including the one that
+        completed the stop string (valid once ``stopped_on`` is set) —
+        used to truncate token_ids/logprobs/usage to match the text."""
+        return self._tokens_seen
+
     def _check_stop(self) -> str | None:
         if not self.stop:
             return None

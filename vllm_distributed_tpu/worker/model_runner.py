@@ -50,6 +50,41 @@ _MIN_SEQ_BUCKET = 8
 _MIN_PAGES_BUCKET = 8
 
 
+def pack_host_arrays(arrays: list[np.ndarray]) -> tuple[np.ndarray, tuple]:
+    """Concatenate 4-byte-dtype host arrays into ONE int32 buffer.
+
+    The per-step host→device hop dominates decode latency when the device
+    is reached over a network tunnel (each transfer pays a round trip), so
+    every step ships exactly one buffer; `unpack_device_arrays` rebuilds
+    the typed views inside the jitted program via static slicing +
+    bitcasts.  Returns (buffer, spec) where spec is hashable (a static jit
+    argument).
+    """
+    views: list[np.ndarray] = []
+    spec: list[tuple] = []
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        assert a.dtype.itemsize == 4, f"pack needs 4-byte dtypes, got {a.dtype}"
+        v = a.view(np.int32).ravel()
+        spec.append((a.shape, a.dtype.str, v.size))
+        views.append(v)
+    return np.concatenate(views), tuple(spec)
+
+
+def unpack_device_arrays(packed: jax.Array, spec: tuple) -> list[jax.Array]:
+    """Inverse of pack_host_arrays, inside jit (static offsets/shapes)."""
+    out = []
+    off = 0
+    for shape, dtype_str, size in spec:
+        seg = jax.lax.slice(packed, (off,), (off + size,))
+        dt = np.dtype(dtype_str)
+        if dt != np.int32:
+            seg = jax.lax.bitcast_convert_type(seg, dt)
+        out.append(seg.reshape(shape))
+        off += size
+    return out
+
+
 @dataclass
 class CachedReqState:
     req_id: str
@@ -90,6 +125,12 @@ class ModelRunner:
         self.requests: dict[str, CachedReqState] = {}
         self.attn_backend = attn_backend
         self._attn_fn = None
+        # Device-resident decode carry: after a fused-K decode dispatch,
+        # (request order, next base lens, last-token device array).  Lets
+        # the next dispatch start from on-device tokens so the engine can
+        # pipeline dispatches without waiting for results (SURVEY.md §3.3,
+        # launch.py:298-302's max_concurrent_batches analog).
+        self._decode_carry: tuple | None = None
         # Input sharding (set at load): step inputs shard their leading
         # dim over the mesh's "dp" axis; with dp=1 they are replicated.
         self._input_spec = None
@@ -101,6 +142,7 @@ class ModelRunner:
             self.config.model_config, load_format=load_format, mesh=self.mesh
         )
         self._attn_fn = self._pick_attn_fn()
+        self._kv_write_fn = self._pick_kv_write_fn()
         if self.mesh is not None:
             self._dp = self.mesh.shape.get("dp", 1)
             if self._dp & (self._dp - 1):
@@ -128,6 +170,30 @@ class ModelRunner:
                 logger.warning("pallas backend unavailable; using reference")
         return paged_attention_reference
 
+    def _pick_kv_write_fn(self):
+        """In-place Pallas KV writer on TPU; functional scatter elsewhere.
+        XLA does not alias the scatter inside the fused decode scan (it
+        copies the whole pool per layer per micro-step at large pool
+        sizes), so the aliased kernel is the production path."""
+        backend = self.attn_backend
+        if backend == "auto":
+            backend = (
+                "pallas" if jax.default_backend() == "tpu" else "reference"
+            )
+        if backend == "pallas":
+            from vllm_distributed_tpu.ops.pallas.kv_update import kv_update
+
+            return kv_update
+        if backend == "pallas_interpret":
+            from vllm_distributed_tpu.ops.pallas.kv_update import (
+                kv_update_cpu,
+            )
+
+            return kv_update_cpu
+        from vllm_distributed_tpu.ops.attention import write_kv_pages
+
+        return write_kv_pages
+
     def kv_cache_bytes_per_page(self) -> int:
         m = self.model
         dtype_size = jnp.dtype(m.dtype).itemsize
@@ -140,6 +206,17 @@ class ModelRunner:
             * dtype_size
         )
 
+    # Per-chip HBM by device-kind prefix, for runtimes that don't expose
+    # memory_stats (e.g. tunneled/proxied devices).
+    _HBM_BYTES_BY_KIND = (
+        ("TPU v6", 32 * 2**30),
+        ("TPU v5p", 95 * 2**30),
+        ("TPU v5", 16 * 2**30),  # v5e
+        ("TPU v4", 32 * 2**30),
+        ("TPU v3", 32 * 2**30),
+        ("TPU v2", 16 * 2**30),
+    )
+
     def profile_num_pages(self) -> int:
         """Derive the KV pool size from free HBM (the analog of
         gpu_memory_utilization profiling in the inherited engine)."""
@@ -149,7 +226,36 @@ class ModelRunner:
         dev = jax.local_devices()[0]
         stats = getattr(dev, "memory_stats", lambda: None)()
         if not stats or "bytes_limit" not in stats:
-            return 512  # CPU / no stats: small default for tests
+            if jax.default_backend() != "tpu":
+                return 512  # CPU: small default for tests
+            # Tunneled TPU runtimes return no stats; budget from the
+            # chip's known HBM minus resident params and a 1 GiB
+            # activation/XLA reserve.
+            kind = getattr(dev, "device_kind", "")
+            hbm = next(
+                (b for p, b in self._HBM_BYTES_BY_KIND if kind.startswith(p)),
+                16 * 2**30,
+            )
+            shards = 1
+            if self.mesh is not None and "tp" in self.mesh.shape:
+                shards = self.mesh.shape["tp"]
+            param_bytes = (
+                sum(x.nbytes for x in jax.tree.leaves(self.params)) // shards
+            )
+            limit = int(hbm * cc.hbm_utilization)
+            free = max(limit - param_bytes - (1 << 30), 0)
+            per_device_page = self.kv_cache_bytes_per_page() // shards
+            num_pages = max(free // max(per_device_page, 1), 16)
+            logger.info(
+                "KV pool (no memory_stats, %s): %d pages × %d tokens "
+                "(%.2f GiB of %.2f GiB HBM budget)",
+                kind or "unknown TPU",
+                num_pages,
+                self.page_size,
+                num_pages * per_device_page / 2**30,
+                free / 2**30,
+            )
+            return int(num_pages)
         limit = int(stats["bytes_limit"] * cc.hbm_utilization)
         in_use = int(stats.get("bytes_in_use", 0))
         free = max(limit - in_use, 0)
@@ -170,10 +276,10 @@ class ModelRunner:
     def init_kv_cache(self, num_pages: int) -> None:
         m = self.model
         self.num_pages = num_pages
-        # Head-major pool: [Hkv, P, page, D] (see ops/attention.py layout);
+        # Slot-major pool: [P, page, Hkv, D] (see ops/attention.py layout);
         # head dim lane-padded to 128 for DMA-aligned Pallas page copies.
         d_pad = round_up(m.head_dim, 128)
-        shape = (m.num_kv_heads, num_pages, self.page_size, d_pad)
+        shape = (num_pages, self.page_size, m.num_kv_heads, d_pad)
         sharding = None
         if self.mesh is not None:
             sharding = NamedSharding(self.mesh, m.kv_cache_spec())
@@ -183,6 +289,20 @@ class ModelRunner:
             return jax.device_put(z, sharding) if sharding is not None else z
 
         self.kv_caches = [(alloc(), alloc()) for _ in range(m.num_layers)]
+
+    def _pages_bucket(self, need: int) -> int:
+        """Static pages-per-seq bucket.  For small max_model_len the bucket
+        is floored at the model-length maximum so growing contexts never
+        trigger a mid-serve recompile; for long-context configs (> 4096
+        tokens of pages) it falls back to power-of-2 growth (log-many
+        compiles, served from the compilation cache)."""
+        floor = _MIN_PAGES_BUCKET
+        ml_pages = next_power_of_2(
+            cdiv(self.config.scheduler_config.max_model_len, self.page_size)
+        )
+        if ml_pages <= 256:
+            floor = max(floor, ml_pages)
+        return max(next_power_of_2(need), floor)
 
     # ---- per-step state mirroring ----
     def _apply_scheduler_deltas(self, so: SchedulerOutput) -> None:
@@ -210,6 +330,9 @@ class ModelRunner:
         self._apply_scheduler_deltas(so)
         if so.is_empty:
             return ModelRunnerOutput()
+        if so.decode_steps > 1:
+            return self._execute_decode_steps(so)
+        self._decode_carry = None
 
         order = [c.req_id for c in so.cached_requests] + [
             n.req_id for n in so.new_requests
@@ -226,7 +349,7 @@ class ModelRunner:
         max_pages = max(
             max((len(st.page_ids) for st in states), default=1), 1
         )
-        pages_pad = max(next_power_of_2(max_pages), _MIN_PAGES_BUCKET)
+        pages_pad = self._pages_bucket(max_pages)
 
         tokens = np.zeros(t_pad, np.int32)
         positions = np.zeros(t_pad, np.int32)
@@ -260,39 +383,67 @@ class ModelRunner:
             needs_sample[s] = hi >= state.prefill_target
             cursor += n
 
-        meta = AttentionMetadata(
-            q_seq_ids=jnp.asarray(seq_ids),
-            q_positions=jnp.asarray(positions),
-            slot_mapping=jnp.asarray(slots),
-            block_tables=jnp.asarray(block_tables),
-            seq_lens=jnp.asarray(seq_lens),
-            logits_indices=jnp.asarray(logits_idx),
-            chunk_starts=jnp.asarray(chunk_starts),
-        )
         max_q_pad = max(next_power_of_2(max(num_new)), 1)
+        smeta_np, flags = self._build_sampling_metadata(states, s_pad)
 
-        smeta, flags = self._build_sampling_metadata(states, s_pad)
-        token_ids = jnp.asarray(tokens)
-
-        if self.mesh is not None:
+        if self._dp == 1:
+            # One packed host→device transfer per step (see
+            # pack_host_arrays).  Replicated across the mesh under tp.
+            packed, pack_spec = pack_host_arrays(
+                [
+                    tokens, seq_ids, positions, slots, block_tables,
+                    seq_lens, logits_idx, chunk_starts,
+                    smeta_np.temperature, smeta_np.top_k, smeta_np.top_p,
+                    smeta_np.min_p, smeta_np.repetition_penalty,
+                    smeta_np.presence_penalty, smeta_np.frequency_penalty,
+                    smeta_np.keys, smeta_np.prompt_tokens,
+                    smeta_np.output_tokens,
+                ]
+            )
+            if self.mesh is not None:
+                packed = jax.device_put(
+                    packed, NamedSharding(self.mesh, P())
+                )
+            sampled, logprobs, self.kv_caches = self._jit_step_packed(
+                self.params,
+                self.kv_caches,
+                packed,
+                spec=pack_spec,
+                max_q_pad=max_q_pad,
+                **flags,
+            )
+        else:
+            meta = AttentionMetadata(
+                q_seq_ids=jnp.asarray(seq_ids),
+                q_positions=jnp.asarray(positions),
+                slot_mapping=jnp.asarray(slots),
+                block_tables=jnp.asarray(block_tables),
+                seq_lens=jnp.asarray(seq_lens),
+                logits_indices=jnp.asarray(logits_idx),
+                chunk_starts=jnp.asarray(chunk_starts),
+            )
+            token_ids = jnp.asarray(tokens)
+            smeta = smeta_np
             spec = self._input_spec
             token_ids = jax.device_put(token_ids, spec)
             meta = jax.tree.map(lambda x: jax.device_put(x, spec), meta)
             smeta = jax.tree.map(lambda x: jax.device_put(x, spec), smeta)
+            sampled, logprobs, self.kv_caches = self._jit_step(
+                self.params,
+                self.kv_caches,
+                token_ids,
+                meta,
+                smeta,
+                max_q_pad=max_q_pad,
+                **flags,
+            )
 
-        sampled, logprobs, self.kv_caches = self._jit_step(
-            self.params,
-            self.kv_caches,
-            token_ids,
-            meta,
-            smeta,
-            max_q_pad=max_q_pad,
-            **flags,
-        )
-
-        sampled = np.asarray(jax.device_get(sampled))
         if logprobs is not None:
-            logprobs = np.asarray(jax.device_get(logprobs))
+            sampled, logprobs = jax.device_get((sampled, logprobs))
+            sampled = np.asarray(sampled)
+            logprobs = np.asarray(logprobs)
+        else:
+            sampled = np.asarray(jax.device_get(sampled))
 
         out = ModelRunnerOutput()
         for s, (state, n) in enumerate(zip(states, num_new)):
@@ -314,7 +465,10 @@ class ModelRunner:
         return out
 
     def _build_sampling_metadata(
-        self, states: list[CachedReqState], s_pad: int
+        self,
+        states: list[CachedReqState],
+        s_pad: int,
+        extra_output_len: int = 1,
     ) -> tuple[SamplingMetadata, dict]:
         vocab = self.model.vocab_size
         temp = np.zeros(s_pad, np.float32)
@@ -355,7 +509,7 @@ class ModelRunner:
             lo = max(
                 next_power_of_2(
                     max(len(st.token_ids) - st.num_prompt for st in states)
-                    + 1
+                    + extra_output_len
                 ),
                 _MIN_TOKEN_BUCKET,
             )
@@ -370,17 +524,19 @@ class ModelRunner:
             prompt_toks = np.full((s_pad, 1), -1, np.int32)
             output_toks = np.full((s_pad, 1), -1, np.int32)
 
+        # Numpy leaves: the packed path ships them in one fused buffer;
+        # the unpacked path converts at the jit boundary.
         smeta = SamplingMetadata(
-            temperature=jnp.asarray(temp),
-            top_k=jnp.asarray(top_k),
-            top_p=jnp.asarray(top_p),
-            min_p=jnp.asarray(min_p),
-            repetition_penalty=jnp.asarray(rep),
-            presence_penalty=jnp.asarray(pres),
-            frequency_penalty=jnp.asarray(freq),
-            keys=jnp.asarray(keys),
-            prompt_tokens=jnp.asarray(prompt_toks),
-            output_tokens=jnp.asarray(output_toks),
+            temperature=temp,
+            top_k=top_k,
+            top_p=top_p,
+            min_p=min_p,
+            repetition_penalty=rep,
+            presence_penalty=pres,
+            frequency_penalty=freq,
+            keys=keys,
+            prompt_tokens=prompt_toks,
+            output_tokens=output_toks,
         )
         flags = dict(
             do_penalties=do_pen,
@@ -388,6 +544,38 @@ class ModelRunner:
             return_logprobs=want_lp,
         )
         return smeta, flags
+
+    def _step_core(
+        self,
+        params,
+        kv_caches,
+        token_ids,
+        meta: AttentionMetadata,
+        smeta: SamplingMetadata,
+        max_q_pad: int,
+        do_penalties: bool,
+        do_top_k_p: bool,
+        return_logprobs: bool,
+    ):
+        attn_fn = self._attn_fn
+        if getattr(attn_fn, "needs_max_q", False):
+            attn_fn = partial(attn_fn, max_q=max_q_pad)
+        logits, kv_caches = self.model.forward(
+            params,
+            token_ids,
+            kv_caches,
+            meta,
+            attn_fn=attn_fn,
+            kv_write_fn=self._kv_write_fn,
+        )
+        tokens, logprobs = sample(
+            logits,
+            smeta,
+            do_penalties=do_penalties,
+            do_top_k_p=do_top_k_p,
+            return_logprobs=return_logprobs,
+        )
+        return tokens, logprobs, kv_caches
 
     @partial(
         jax.jit,
@@ -413,17 +601,262 @@ class ModelRunner:
         do_top_k_p: bool,
         return_logprobs: bool,
     ):
+        return self._step_core(
+            params, kv_caches, token_ids, meta, smeta,
+            max_q_pad, do_penalties, do_top_k_p, return_logprobs,
+        )
+
+    @partial(
+        jax.jit,
+        static_argnames=(
+            "self",
+            "spec",
+            "max_q_pad",
+            "do_penalties",
+            "do_top_k_p",
+            "return_logprobs",
+        ),
+        donate_argnums=(2,),
+    )
+    def _jit_step_packed(
+        self,
+        params,
+        kv_caches,
+        packed,
+        *,
+        spec: tuple,
+        max_q_pad: int,
+        do_penalties: bool,
+        do_top_k_p: bool,
+        return_logprobs: bool,
+    ):
+        (
+            tokens, seq_ids, positions, slots, block_tables, seq_lens,
+            logits_idx, chunk_starts, temp, top_k, top_p, min_p, rep,
+            pres, freq, keys, prompt_toks, out_toks,
+        ) = unpack_device_arrays(packed, spec)
+        meta = AttentionMetadata(
+            q_seq_ids=seq_ids,
+            q_positions=positions,
+            slot_mapping=slots,
+            block_tables=block_tables,
+            seq_lens=seq_lens,
+            logits_indices=logits_idx,
+            chunk_starts=chunk_starts,
+        )
+        smeta = SamplingMetadata(
+            temperature=temp,
+            top_k=top_k,
+            top_p=top_p,
+            min_p=min_p,
+            repetition_penalty=rep,
+            presence_penalty=pres,
+            frequency_penalty=freq,
+            keys=keys,
+            prompt_tokens=prompt_toks,
+            output_tokens=out_toks,
+        )
+        return self._step_core(
+            params, kv_caches, tokens, meta, smeta,
+            max_q_pad, do_penalties, do_top_k_p, return_logprobs,
+        )
+
+    # ---- fused multi-step decode (SchedulerOutput.decode_steps > 1) ----
+    def _execute_decode_steps(self, so: SchedulerOutput) -> ModelRunnerOutput:
+        """Run `so.decode_steps` decode micro-steps in ONE device dispatch
+        (a lax.scan feeding each sampled token back in).  Amortizes the
+        host round trip the reference pays per scheduler step
+        (launch.py:322-343) by K — the TPU-first redesign SURVEY.md §3.3
+        calls for."""
+        k_steps = so.decode_steps
+        order = tuple(c.req_id for c in so.cached_requests)
+        states = [self.requests[r] for r in order]
+        s_real = len(order)
+        s_pad = max(next_power_of_2(s_real), _MIN_SEQ_BUCKET, self._dp)
+        max_pages = max(max(len(st.page_ids) for st in states), 1)
+        pages_pad = self._pages_bucket(max_pages)
+
+        tokens = np.zeros(s_pad, np.int32)
+        base_lens = np.zeros(s_pad, np.int32)
+        valid = np.zeros(s_pad, np.int32)
+        block_tables = np.zeros((s_pad, pages_pad), np.int32)
+        out_lens = np.zeros(s_pad, np.int32)
+        host_current = True
+        for s, st in enumerate(states):
+            base_lens[s] = st.num_computed
+            valid[s] = 1
+            block_tables[s, : len(st.page_ids)] = st.page_ids
+            out_lens[s] = len(st.token_ids) - st.num_prompt
+            if st.num_computed == len(st.token_ids) - 1:
+                tokens[s] = st.token_ids[-1]
+            else:
+                # Results of a previous dispatch are still in flight; the
+                # real token values live in the device carry.
+                host_current = False
+
+        use_carry = False
+        if not host_current:
+            carry = self._decode_carry
+            assert (
+                carry is not None
+                and carry[0] == order
+                and np.array_equal(carry[1][:s_real], base_lens[:s_real])
+            ), "pipelined decode dispatch without a matching device carry"
+            use_carry = True
+        carry_tok = (
+            self._decode_carry[2]
+            if use_carry
+            else jnp.zeros(s_pad, jnp.int32)
+        )
+
+        smeta_np, flags = self._build_sampling_metadata(
+            states, s_pad, extra_output_len=k_steps + 1
+        )
+        assert not flags["return_logprobs"], (
+            "scheduler must not fuse decode steps when logprobs are on"
+        )
+        assert not (use_carry and flags["do_penalties"]), (
+            "pipelined decode cannot run with penalties (stale host state)"
+        )
+        # PRNG stream position must follow the device-side token count,
+        # which host token_ids may lag behind under pipelining.
+        smeta_np.keys[:s_real, 1] = (base_lens[:s_real] + 1).astype(np.uint32)
+        packed, pack_spec = pack_host_arrays(
+            [
+                tokens, base_lens, valid, block_tables, out_lens,
+                smeta_np.temperature, smeta_np.top_k, smeta_np.top_p,
+                smeta_np.min_p, smeta_np.repetition_penalty,
+                smeta_np.presence_penalty, smeta_np.frequency_penalty,
+                smeta_np.keys, smeta_np.prompt_tokens,
+                smeta_np.output_tokens,
+            ]
+        )
+        if self.mesh is not None:
+            packed = jax.device_put(packed, NamedSharding(self.mesh, P()))
+        toks, self.kv_caches = self._jit_decode_steps(
+            self.params,
+            self.kv_caches,
+            packed,
+            carry_tok,
+            spec=pack_spec,
+            k_steps=k_steps,
+            use_carry=use_carry,
+            do_penalties=flags["do_penalties"],
+            do_top_k_p=flags["do_top_k_p"],
+        )
+        # toks[-1] stays on device as the next dispatch's input.
+        self._decode_carry = (order, base_lens + k_steps, toks[-1])
+
+        def resolve() -> ModelRunnerOutput:
+            host_toks = np.asarray(jax.device_get(toks))  # [K, s_pad]
+            out = ModelRunnerOutput()
+            for s, st in enumerate(states):
+                seq_toks = [int(t) for t in host_toks[:, s]]
+                # Absolute (not +=): scheduler deltas for a pipelined
+                # next dispatch may already have advanced num_computed.
+                st.num_computed = int(base_lens[s]) + k_steps
+                st.token_ids.extend(seq_toks)
+                out.sampled_token_ids[st.req_id] = seq_toks
+            return out
+
+        return resolve
+
+    @partial(
+        jax.jit,
+        static_argnames=(
+            "self",
+            "spec",
+            "k_steps",
+            "use_carry",
+            "do_penalties",
+            "do_top_k_p",
+        ),
+        donate_argnums=(2,),
+    )
+    def _jit_decode_steps(
+        self,
+        params,
+        kv_caches,
+        packed,
+        carry_tok,
+        *,
+        spec: tuple,
+        k_steps: int,
+        use_carry: bool,
+        do_penalties: bool,
+        do_top_k_p: bool,
+    ):
+        (
+            tokens, base_lens, valid, block_tables, out_lens, temp, top_k,
+            top_p, min_p, rep, pres, freq, keys, prompt_toks, out_toks,
+        ) = unpack_device_arrays(packed, spec)
+        if use_carry:
+            tokens = carry_tok
+        s_pad = tokens.shape[0]
+        rows = jnp.arange(s_pad, dtype=jnp.int32)
+        page_size = self.page_size
         attn_fn = self._attn_fn
         if getattr(attn_fn, "needs_max_q", False):
-            attn_fn = partial(attn_fn, max_q=max_q_pad)
-        logits, kv_caches = self.model.forward(
-            params, token_ids, kv_caches, meta, attn_fn=attn_fn
+            attn_fn = partial(attn_fn, max_q=1)
+
+        def body(carry, i):
+            kv, tok, out_buf = carry
+            pos = base_lens + i
+            meta = AttentionMetadata(
+                # Padding rows use the kernels' drop convention (id == S).
+                q_seq_ids=jnp.where(valid > 0, rows, s_pad),
+                q_positions=pos,
+                # Padding rows' block-table row is all page-0 (the
+                # reserved dump page), so their writes land there.
+                slot_mapping=(
+                    block_tables[rows, pos // page_size] * page_size
+                    + pos % page_size
+                ),
+                block_tables=block_tables,
+                seq_lens=jnp.where(valid > 0, pos + 1, 0),
+                logits_indices=rows,
+                chunk_starts=pos,
+            )
+            smeta = SamplingMetadata(
+                temperature=temp,
+                top_k=top_k,
+                top_p=top_p,
+                min_p=min_p,
+                repetition_penalty=rep,
+                presence_penalty=pres,
+                frequency_penalty=freq,
+                # Per-token PRNG stream: low word advances with position,
+                # matching the single-step path's keys[s,1]=len(tokens).
+                keys=jnp.stack(
+                    [keys[:, 0], keys[:, 1] + i.astype(jnp.uint32)], axis=1
+                ),
+                prompt_tokens=prompt_toks,
+                output_tokens=out_buf,
+            )
+            logits, kv = self.model.forward(
+                params,
+                tok,
+                kv,
+                meta,
+                attn_fn=attn_fn,
+                kv_write_fn=self._kv_write_fn,
+            )
+            new_tok, _ = sample(
+                logits,
+                smeta,
+                do_penalties=do_penalties,
+                do_top_k_p=do_top_k_p,
+                return_logprobs=False,
+            )
+            if do_penalties:
+                out_buf = out_buf.at[rows, out_lens + i].set(
+                    new_tok, mode="drop"
+                )
+            return (kv, new_tok, out_buf), new_tok
+
+        (kv_caches, _, _), toks = jax.lax.scan(
+            body,
+            (kv_caches, tokens, out_toks),
+            jnp.arange(k_steps, dtype=jnp.int32),
         )
-        tokens, logprobs = sample(
-            logits,
-            smeta,
-            do_penalties=do_penalties,
-            do_top_k_p=do_top_k_p,
-            return_logprobs=return_logprobs,
-        )
-        return tokens, logprobs, kv_caches
+        return toks, kv_caches

@@ -48,6 +48,7 @@ class Worker:
         """Join the distributed world (multi-host: jax.distributed over DCN,
         the analog of the torch/NCCL rendezvous at launch.py:94) and build
         the device mesh."""
+        self._enable_compilation_cache()
         pc = self.config.parallel_config
         if pc.num_hosts > 1 and self.distributed_init_method:
             jax.distributed.initialize(
@@ -66,6 +67,27 @@ class Worker:
             jax.default_backend(),
         )
 
+    def _enable_compilation_cache(self) -> None:
+        """Persistent XLA compilation cache (the analog of the reference's
+        per-container /root/.cache compiled-model volume,
+        docker-compose.yml:24-25).  Makes restart-to-first-token fast —
+        SURVEY.md §5.4 / hard part #4."""
+        import os
+
+        from vllm_distributed_tpu import envs
+
+        cache_dir = envs.VDT_COMPILE_CACHE_DIR
+        if not cache_dir:
+            return
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0
+            )
+        except (OSError, AttributeError) as e:  # read-only fs, old jax
+            logger.warning("compilation cache disabled: %s", e)
+
     def load_model(self, load_format: str | None = None) -> None:
         self.runner = ModelRunner(self.config, mesh=self.mesh)
         self.runner.load_model(
@@ -78,8 +100,16 @@ class Worker:
     def initialize_cache(self, num_pages: int) -> None:
         self.runner.init_kv_cache(num_pages)
 
-    def execute_model(self, scheduler_output: SchedulerOutput) -> ModelRunnerOutput | None:
+    def execute_model(
+        self, scheduler_output: SchedulerOutput, defer: bool = False
+    ) -> ModelRunnerOutput | None:
+        """Run one step.  The runner may return a deferred resolver (fused
+        decode: the dispatch is in flight, results fetched on resolve);
+        over RPC the resolver cannot cross the wire, so it is resolved
+        here unless the in-process caller asks to defer."""
         out = self.runner.execute_model(scheduler_output)
+        if callable(out) and not defer:
+            out = out()
         return out if self.is_driver_worker else None
 
     def check_health(self) -> bool:
