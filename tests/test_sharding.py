@@ -19,7 +19,24 @@ def tiny_llama(tmp_path_factory):
     )
 
 
-def _greedy(model_dir, tp=1, dp=1):
+def _greedy(model_dir, tp=1, dp=1, env=None):
+    import os
+
+    old = {}
+    for k, v in (env or {}).items():
+        old[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        return _greedy_inner(model_dir, tp, dp)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _greedy_inner(model_dir, tp=1, dp=1):
     engine = LLMEngine.from_engine_args(
         EngineArgs(
             model=model_dir,
@@ -57,6 +74,31 @@ def test_tp4_matches_single_device(tiny_llama, baseline):
 
 def test_tp2_dp2_matches_single_device(tiny_llama, baseline):
     assert _greedy(tiny_llama, tp=2, dp=2) == baseline
+
+
+def test_tp4_pallas_matches_single_device(tiny_llama, baseline):
+    """The PRODUCTION kernel path (interpret-mode Pallas attention +
+    in-place KV writer) under shard_map on a real tp=4 mesh must be
+    bit-identical to single-device greedy — the partitioning the real
+    chip mesh relies on (GSPMD cannot partition the custom calls)."""
+    assert (
+        _greedy(
+            tiny_llama, tp=4, env={"VDT_USE_PALLAS": "pallas_interpret"}
+        )
+        == baseline
+    )
+
+
+def test_pallas_dp_rejected(tiny_llama):
+    """dp>1 would diverge the replicated KV pool under per-shard in-place
+    writes; the runner must refuse loudly."""
+    with pytest.raises(Exception, match="dp>1"):
+        _greedy(
+            tiny_llama,
+            tp=2,
+            dp=2,
+            env={"VDT_USE_PALLAS": "pallas_interpret"},
+        )
 
 
 def test_tp8_rejected_when_kv_heads_insufficient(tiny_llama):

@@ -1,0 +1,101 @@
+"""shard_map partitioning of the Pallas paged-attention ops over "tp".
+
+GSPMD cannot partition a Pallas custom call: under a tp>1 mesh it either
+replicates the kernel (wrong memory/compute) or fails to lower.  The
+runner therefore wraps the production kernels in ``jax.shard_map`` so
+each device runs the kernel on its *local* head shard — q heads and KV
+heads both shard over the mesh "tp" axis (llama.py ``partition_specs`` /
+``kv_cache_spec``), and per-head attention is embarrassingly parallel, so
+sharded outputs are bit-identical to the unsharded kernel.  The matmuls
+around the kernels stay GSPMD-partitioned; the row-parallel ``wo``
+all-reduce is still inserted by XLA outside the shard_map region.
+
+This is the TPU-native analog of the reference's per-rank attention: each
+NCCL rank runs CUDA attention on its head shard inside vLLM workers
+(SURVEY.md §2.2, §2.4 TP row; TP-group discipline launch.py:211-247).
+
+dp>1 is not supported on this path: the KV pool is replicated over "dp",
+and a manual per-shard write would diverge the replicas (each dp group
+writes different tokens).  The runner keeps the XLA scatter/gather path
+for dp>1, where GSPMD maintains replica consistency.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from vllm_distributed_tpu.ops.attention import AttentionMetadata
+
+# Attention metadata is replicated: every device sees every sequence's
+# block table / lengths; only heads are sharded.
+_META_SPECS = AttentionMetadata(
+    q_seq_ids=P(),
+    q_positions=P(),
+    slot_mapping=P(),
+    block_tables=P(),
+    seq_lens=P(),
+    logits_indices=P(),
+    chunk_starts=P(),
+)
+
+_Q_SPEC = P(None, "tp", None)  # [T, Hq, D] — heads sharded
+_KV_SPEC = P(None, None, "tp", None)  # [P, page, Hkv, D] — kv heads sharded
+
+
+def _check_divisible(mesh: Mesh, num_heads: int, num_kv_heads: int) -> None:
+    tp = mesh.shape.get("tp", 1)
+    if num_heads % tp or num_kv_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide num_heads={num_heads} and "
+            f"num_kv_heads={num_kv_heads} to shard the Pallas kernels"
+        )
+
+
+def shard_attention(attn_fn, mesh: Mesh):
+    """Wrap a paged-attention kernel to run per-tp-shard under shard_map."""
+
+    def wrapped(q, k_pages, v_pages, metadata, **kw):
+        def body(q_, k_, v_, m_):
+            return attn_fn(q_, k_, v_, m_, **kw)
+
+        f = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(_Q_SPEC, _KV_SPEC, _KV_SPEC, _META_SPECS),
+            out_specs=_Q_SPEC,
+            check_vma=False,
+        )
+        return f(q, k_pages, v_pages, metadata)
+
+    wrapped.needs_max_q = getattr(attn_fn, "needs_max_q", False)
+    return wrapped
+
+
+def shard_kv_write(write_fn, mesh: Mesh):
+    """Wrap a KV-pool writer to run per-tp-shard under shard_map.
+
+    Every device writes the same token rows into its own kv-head shard of
+    the pool (slot mapping is replicated), so the sharded pool stays
+    consistent and the in-place aliasing of the Pallas writer survives —
+    each shard aliases its local buffer.
+    """
+
+    def wrapped(k_pages, v_pages, k, v, slot_mapping):
+        f = jax.shard_map(
+            write_fn,
+            mesh=mesh,
+            in_specs=(
+                _KV_SPEC,
+                _KV_SPEC,
+                P(None, "tp", None),
+                P(None, "tp", None),
+                P(),
+            ),
+            out_specs=(_KV_SPEC, _KV_SPEC),
+            check_vma=False,
+        )
+        return f(k_pages, v_pages, k, v, slot_mapping)
+
+    return wrapped

@@ -152,6 +152,46 @@ class ModelRunner:
                 )
             axis = "dp" if self._dp > 1 else None
             self._input_spec = NamedSharding(self.mesh, P(axis))
+        self._shard_kernels()
+
+    def _shard_kernels(self) -> None:
+        """Partition the Pallas kernels over the mesh "tp" axis.
+
+        GSPMD cannot partition a Pallas custom call, so at tp>1 the
+        kernels are wrapped in jax.shard_map to run on local head shards
+        (ops/sharded.py).  The XLA reference path needs no wrapping —
+        GSPMD partitions gather/scatter/einsum natively.
+        """
+        if self.mesh is None or self.mesh.shape.get("tp", 1) <= 1:
+            return
+        from vllm_distributed_tpu.ops import sharded
+        from vllm_distributed_tpu.ops.attention import (
+            paged_attention_reference,
+            write_kv_pages,
+        )
+
+        uses_pallas = (
+            self._attn_fn is not paged_attention_reference
+            or self._kv_write_fn is not write_kv_pages
+        )
+        if not uses_pallas:
+            return
+        if self._dp > 1:
+            raise ValueError(
+                "the Pallas backend does not support dp>1 (the KV pool is "
+                "replicated over dp; per-shard in-place writes would "
+                "diverge the replicas) — use dp=1 or attn_backend="
+                "'reference'"
+            )
+        sharded._check_divisible(
+            self.mesh, self.model.num_heads, self.model.num_kv_heads
+        )
+        if self._attn_fn is not paged_attention_reference:
+            self._attn_fn = sharded.shard_attention(self._attn_fn, self.mesh)
+        if self._kv_write_fn is not write_kv_pages:
+            self._kv_write_fn = sharded.shard_kv_write(
+                self._kv_write_fn, self.mesh
+            )
 
     def _pick_attn_fn(self):
         backend = self.attn_backend
@@ -168,6 +208,12 @@ class ModelRunner:
                 return paged_attention
             except ImportError:
                 logger.warning("pallas backend unavailable; using reference")
+        if backend == "pallas_interpret":
+            from vllm_distributed_tpu.ops.pallas.paged_attention import (
+                paged_attention_cpu,
+            )
+
+            return paged_attention_cpu
         return paged_attention_reference
 
     def _pick_kv_write_fn(self):

@@ -89,7 +89,11 @@ class Worker:
             logger.warning("compilation cache disabled: %s", e)
 
     def load_model(self, load_format: str | None = None) -> None:
-        self.runner = ModelRunner(self.config, mesh=self.mesh)
+        from vllm_distributed_tpu import envs
+
+        self.runner = ModelRunner(
+            self.config, mesh=self.mesh, attn_backend=envs.VDT_USE_PALLAS
+        )
         self.runner.load_model(
             load_format=load_format or self.config.model_config.load_format
         )
