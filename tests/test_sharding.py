@@ -21,19 +21,10 @@ def tiny_llama(tmp_path_factory):
 
 def _greedy(model_dir, tp=1, dp=1, env=None):
     import os
+    from unittest import mock
 
-    old = {}
-    for k, v in (env or {}).items():
-        old[k] = os.environ.get(k)
-        os.environ[k] = v
-    try:
+    with mock.patch.dict(os.environ, env or {}):
         return _greedy_inner(model_dir, tp, dp)
-    finally:
-        for k, v in old.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
 
 
 def _greedy_inner(model_dir, tp=1, dp=1):
@@ -96,6 +87,18 @@ def test_pallas_dp_rejected(tiny_llama):
         _greedy(
             tiny_llama,
             tp=2,
+            dp=2,
+            env={"VDT_USE_PALLAS": "pallas_interpret"},
+        )
+
+
+def test_pallas_dp_rejected_at_tp1(tiny_llama):
+    """tp=1 must not bypass the dp rejection (the kernels would run
+    unwrapped under a dp-sharded GSPMD mesh)."""
+    with pytest.raises(Exception, match="dp>1"):
+        _greedy(
+            tiny_llama,
+            tp=1,
             dp=2,
             env={"VDT_USE_PALLAS": "pallas_interpret"},
         )

@@ -184,6 +184,11 @@ class Scheduler:
             positive = [x for x in rooms if x > 0]
             if positive:
                 k = max(min(self.config.num_decode_steps, min(positive)), 1)
+                # Clamp by the token budget so len(running)*k never
+                # exceeds max_num_batched_tokens: without this, large
+                # batches would exhaust the budget on the first
+                # budget//k requests and starve the tail every step.
+                k = min(k, max(token_budget // len(self.running), 1))
                 k = 1 << (k.bit_length() - 1)  # power-of-2 floor
         out.decode_steps = k
 

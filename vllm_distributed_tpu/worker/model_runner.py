@@ -142,7 +142,16 @@ class ModelRunner:
             self.config.model_config, load_format=load_format, mesh=self.mesh
         )
         self._attn_fn = self._pick_attn_fn()
-        self._kv_write_fn = self._pick_kv_write_fn()
+        # Two writers: prefill/mixed steps keep the functional XLA
+        # scatter (batched, GSPMD-partitionable — the aliased Pallas
+        # writer's grid=(T,) would issue T serialized per-token DMAs per
+        # layer on a 2048-token chunk); the fused decode scan uses the
+        # in-place Pallas writer, where XLA's non-aliased scatter copies
+        # the whole pool per layer per micro-step.
+        from vllm_distributed_tpu.ops.attention import write_kv_pages
+
+        self._kv_write_fn = write_kv_pages
+        self._kv_write_decode_fn = self._pick_kv_write_fn()
         if self.mesh is not None:
             self._dp = self.mesh.shape.get("dp", 1)
             if self._dp & (self._dp - 1):
@@ -162,7 +171,7 @@ class ModelRunner:
         (ops/sharded.py).  The XLA reference path needs no wrapping —
         GSPMD partitions gather/scatter/einsum natively.
         """
-        if self.mesh is None or self.mesh.shape.get("tp", 1) <= 1:
+        if self.mesh is None:
             return
         from vllm_distributed_tpu.ops import sharded
         from vllm_distributed_tpu.ops.attention import (
@@ -172,10 +181,12 @@ class ModelRunner:
 
         uses_pallas = (
             self._attn_fn is not paged_attention_reference
-            or self._kv_write_fn is not write_kv_pages
+            or self._kv_write_decode_fn is not write_kv_pages
         )
         if not uses_pallas:
             return
+        # dp must be rejected regardless of tp (at tp==1 the kernels
+        # would otherwise run unwrapped under a dp-sharded GSPMD mesh).
         if self._dp > 1:
             raise ValueError(
                 "the Pallas backend does not support dp>1 (the KV pool is "
@@ -183,14 +194,16 @@ class ModelRunner:
                 "diverge the replicas) — use dp=1 or attn_backend="
                 "'reference'"
             )
+        if self.mesh.shape.get("tp", 1) <= 1:
+            return
         sharded._check_divisible(
             self.mesh, self.model.num_heads, self.model.num_kv_heads
         )
         if self._attn_fn is not paged_attention_reference:
             self._attn_fn = sharded.shard_attention(self._attn_fn, self.mesh)
-        if self._kv_write_fn is not write_kv_pages:
-            self._kv_write_fn = sharded.shard_kv_write(
-                self._kv_write_fn, self.mesh
+        if self._kv_write_decode_fn is not write_kv_pages:
+            self._kv_write_decode_fn = sharded.shard_kv_write(
+                self._kv_write_decode_fn, self.mesh
             )
 
     def _pick_attn_fn(self):
@@ -217,10 +230,12 @@ class ModelRunner:
         return paged_attention_reference
 
     def _pick_kv_write_fn(self):
-        """In-place Pallas KV writer on TPU; functional scatter elsewhere.
-        XLA does not alias the scatter inside the fused decode scan (it
-        copies the whole pool per layer per micro-step at large pool
-        sizes), so the aliased kernel is the production path."""
+        """Writer for the fused decode scan ONLY: in-place Pallas KV
+        writer on TPU, functional scatter elsewhere.  XLA does not alias
+        the scatter inside the scan (it copies the whole pool per layer
+        per micro-step at large pool sizes), so the aliased kernel is
+        the production decode path.  Prefill/mixed dispatches always use
+        write_kv_pages (see load_model)."""
         backend = self.attn_backend
         if backend == "auto":
             backend = (
@@ -717,6 +732,19 @@ class ModelRunner:
         k_steps = so.decode_steps
         order = tuple(c.req_id for c in so.cached_requests)
         states = [self.requests[r] for r in order]
+        # Thread-interleaving invariant (engine thread here vs a prior
+        # dispatch's resolve() on the executor's resolver thread): both
+        # may touch CachedReqState concurrently, which is safe because
+        # (a) resolve() writes num_computed as an ABSOLUTE value equal
+        # to its base_lens + k, which the host_current check below
+        # treats identically whether it reads the pre- or post-resolve
+        # value (both outcomes converge to the same dispatched token:
+        # either token_ids[-1] already holds it or the device carry
+        # does), and (b) penalties/logprobs — the only consumers of
+        # token_ids contents — are excluded by _pipeline_safe when a
+        # dispatch is in flight.  CPython's GIL makes each individual
+        # list/int access atomic.  Do not add reads of st.token_ids
+        # beyond the patterns below without revisiting this.
         s_real = len(order)
         s_pad = max(next_power_of_2(s_real), _MIN_SEQ_BUCKET, self._dp)
         max_pages = max(max(len(st.page_ids) for st in states), 1)
@@ -885,7 +913,7 @@ class ModelRunner:
                 kv,
                 meta,
                 attn_fn=attn_fn,
-                kv_write_fn=self._kv_write_fn,
+                kv_write_fn=self._kv_write_decode_fn,
             )
             new_tok, _ = sample(
                 logits,
