@@ -186,6 +186,157 @@ def add_tiny_tokenizer(model_dir: str) -> str:
     return model_dir
 
 
+def make_tiny_mixtral(
+    tmpdir: str,
+    *,
+    vocab_size: int = 128,
+    hidden: int = 64,
+    intermediate: int = 96,
+    layers: int = 2,
+    heads: int = 4,
+    kv_heads: int = 2,
+    num_experts: int = 4,
+    top_k: int = 2,
+    max_pos: int = 512,
+    seed: int = 0,
+) -> str:
+    head_dim = hidden // heads
+    cfg = {
+        "architectures": ["MixtralForCausalLM"],
+        "model_type": "mixtral",
+        "hidden_size": hidden,
+        "intermediate_size": intermediate,
+        "num_hidden_layers": layers,
+        "num_attention_heads": heads,
+        "num_key_value_heads": kv_heads,
+        "head_dim": head_dim,
+        "num_local_experts": num_experts,
+        "num_experts_per_tok": top_k,
+        "vocab_size": vocab_size,
+        "max_position_embeddings": max_pos,
+        "rms_norm_eps": 1e-6,
+        "rope_theta": 10000.0,
+        "torch_dtype": "float32",
+        "tie_word_embeddings": False,
+        "hidden_act": "silu",
+        "sliding_window": None,
+        "bos_token_id": 1,
+        "eos_token_id": 2,
+    }
+    rng = np.random.default_rng(seed)
+
+    def w(*shape, scale=0.05):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    tensors = {
+        "model.embed_tokens.weight": w(vocab_size, hidden),
+        "model.norm.weight": np.ones(hidden, np.float32),
+        "lm_head.weight": w(vocab_size, hidden),
+    }
+    for i in range(layers):
+        p = f"model.layers.{i}."
+        tensors |= {
+            p + "self_attn.q_proj.weight": w(heads * head_dim, hidden),
+            p + "self_attn.k_proj.weight": w(kv_heads * head_dim, hidden),
+            p + "self_attn.v_proj.weight": w(kv_heads * head_dim, hidden),
+            p + "self_attn.o_proj.weight": w(hidden, heads * head_dim),
+            p + "block_sparse_moe.gate.weight": w(num_experts, hidden, scale=0.3),
+            p + "input_layernorm.weight": np.ones(hidden, np.float32),
+            p + "post_attention_layernorm.weight": np.ones(hidden, np.float32),
+        }
+        for e in range(num_experts):
+            ep = p + f"block_sparse_moe.experts.{e}."
+            tensors |= {
+                ep + "w1.weight": w(intermediate, hidden),
+                ep + "w2.weight": w(hidden, intermediate),
+                ep + "w3.weight": w(intermediate, hidden),
+            }
+    os.makedirs(tmpdir, exist_ok=True)
+    with open(os.path.join(tmpdir, "config.json"), "w") as f:
+        json.dump(cfg, f)
+    _save_safetensors(os.path.join(tmpdir, "model.safetensors"), tensors)
+    return tmpdir
+
+
+def make_tiny_qwen3_moe(
+    tmpdir: str,
+    *,
+    vocab_size: int = 128,
+    hidden: int = 64,
+    intermediate: int = 128,
+    moe_intermediate: int = 48,
+    layers: int = 2,
+    heads: int = 4,
+    kv_heads: int = 2,
+    num_experts: int = 4,
+    top_k: int = 2,
+    max_pos: int = 512,
+    seed: int = 1,
+) -> str:
+    head_dim = hidden // heads
+    cfg = {
+        "architectures": ["Qwen3MoeForCausalLM"],
+        "model_type": "qwen3_moe",
+        "hidden_size": hidden,
+        "intermediate_size": intermediate,
+        "moe_intermediate_size": moe_intermediate,
+        "num_hidden_layers": layers,
+        "num_attention_heads": heads,
+        "num_key_value_heads": kv_heads,
+        "head_dim": head_dim,
+        "num_experts": num_experts,
+        "num_experts_per_tok": top_k,
+        "norm_topk_prob": True,
+        "decoder_sparse_step": 1,
+        "mlp_only_layers": [],
+        "vocab_size": vocab_size,
+        "max_position_embeddings": max_pos,
+        "rms_norm_eps": 1e-6,
+        "rope_theta": 10000.0,
+        "attention_bias": False,
+        "torch_dtype": "float32",
+        "tie_word_embeddings": False,
+        "hidden_act": "silu",
+        "bos_token_id": 1,
+        "eos_token_id": 2,
+    }
+    rng = np.random.default_rng(seed)
+
+    def w(*shape, scale=0.05):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    tensors = {
+        "model.embed_tokens.weight": w(vocab_size, hidden),
+        "model.norm.weight": np.ones(hidden, np.float32),
+        "lm_head.weight": w(vocab_size, hidden),
+    }
+    for i in range(layers):
+        p = f"model.layers.{i}."
+        tensors |= {
+            p + "self_attn.q_proj.weight": w(heads * head_dim, hidden),
+            p + "self_attn.k_proj.weight": w(kv_heads * head_dim, hidden),
+            p + "self_attn.v_proj.weight": w(kv_heads * head_dim, hidden),
+            p + "self_attn.o_proj.weight": w(hidden, heads * head_dim),
+            p + "self_attn.q_norm.weight": np.ones(head_dim, np.float32),
+            p + "self_attn.k_norm.weight": np.ones(head_dim, np.float32),
+            p + "mlp.gate.weight": w(num_experts, hidden, scale=0.3),
+            p + "input_layernorm.weight": np.ones(hidden, np.float32),
+            p + "post_attention_layernorm.weight": np.ones(hidden, np.float32),
+        }
+        for e in range(num_experts):
+            ep = p + f"mlp.experts.{e}."
+            tensors |= {
+                ep + "gate_proj.weight": w(moe_intermediate, hidden),
+                ep + "up_proj.weight": w(moe_intermediate, hidden),
+                ep + "down_proj.weight": w(hidden, moe_intermediate),
+            }
+    os.makedirs(tmpdir, exist_ok=True)
+    with open(os.path.join(tmpdir, "config.json"), "w") as f:
+        json.dump(cfg, f)
+    _save_safetensors(os.path.join(tmpdir, "model.safetensors"), tensors)
+    return tmpdir
+
+
 def hf_greedy_generate(model_dir: str, prompt_ids: list[int], max_new: int):
     """Oracle: greedy decode with transformers on torch CPU."""
     import torch

@@ -51,6 +51,9 @@ class ModelConfig:
     quantization: str | None = None
     skip_tokenizer_init: bool = False
     load_format: str = "auto"  # "auto" (safetensors) | "dummy"
+    # Mirrored from ParallelConfig so MoE models can pick the expert
+    # sharding layout (experts whole over "tp" vs split like dense MLPs).
+    enable_expert_parallel: bool = False
 
     def __post_init__(self) -> None:
         if self.tokenizer is None:
@@ -368,6 +371,7 @@ class EngineArgs:
             quantization=self.quantization,
             skip_tokenizer_init=self.skip_tokenizer_init,
             load_format=self.load_format,
+            enable_expert_parallel=self.enable_expert_parallel,
         )
         max_batched = self.max_num_batched_tokens
         if max_batched is None:

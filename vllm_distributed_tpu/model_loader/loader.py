@@ -90,7 +90,15 @@ def load_hf_weights(
     files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
     if not files:
         raise FileNotFoundError(f"no *.safetensors under {model_dir}")
-    specs = model.partition_specs() if hasattr(model, "partition_specs") else None
+    # load_specs (when present) describes per-tensor placement DURING the
+    # load, which can differ from the final partition_specs — e.g. MoE
+    # expert tensors arrive unstacked and are stacked by finalize_params.
+    if hasattr(model, "load_specs"):
+        specs = model.load_specs()
+    elif hasattr(model, "partition_specs"):
+        specs = model.partition_specs()
+    else:
+        specs = None
 
     params: dict = {"layers": [{} for _ in range(model.num_layers)]}
     start = time.monotonic()
@@ -113,6 +121,8 @@ def load_hf_weights(
                     tensor = jax.device_put(tensor, sharding)
                 _set_path(params, path, tensor)
                 n += 1
+    if hasattr(model, "finalize_params"):
+        params = model.finalize_params(params, mesh)
     logger.info(
         "loaded %d tensors from %d shard(s) in %.1fs",
         n,
@@ -133,6 +143,11 @@ def get_model(
     "dummy" random-initializes (tests, perf smoke)."""
     cls = get_model_class(model_config.architecture)
     model = cls(model_config)
+    # Model-specific mesh preconditions (e.g. EP expert divisibility),
+    # checked before any device placement so failures are clear errors
+    # rather than GSPMD sharding failures mid-load.
+    if mesh is not None and hasattr(model, "validate_mesh"):
+        model.validate_mesh(mesh)
     if load_format == "dummy":
         rng = rng if rng is not None else jax.random.PRNGKey(model_config.seed)
         params = model.init_params(rng)

@@ -186,6 +186,13 @@ class LlamaForCausalLM:
         return P(None, None, "tp", None)
 
     # ---- forward ----
+    def _mlp(self, h: jax.Array, layer: dict) -> jax.Array:
+        """Post-attention MLP for one layer (overridden by MoE models)."""
+        gated = jax.nn.silu(linear(h, layer["gate"])) * linear(
+            h, layer["up"]
+        )
+        return linear(gated, layer["down"])
+
     def forward(
         self,
         params: dict,
@@ -223,10 +230,7 @@ class LlamaForCausalLM:
             x = x + linear(attn.reshape(t, -1), layer["wo"])
 
             h = rms_norm(x, layer["post_attn_ln"], self.rms_eps)
-            gated = jax.nn.silu(linear(h, layer["gate"])) * linear(
-                h, layer["up"]
-            )
-            x = x + linear(gated, layer["down"])
+            x = x + self._mlp(h, layer)
 
         x = rms_norm(x, params["norm"], self.rms_eps)
         sel = x[meta.logits_indices]  # [S, H]

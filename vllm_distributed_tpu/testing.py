@@ -53,6 +53,51 @@ def write_llama_config(
     return dirname
 
 
+def write_mixtral_config(
+    dirname: str | None = None,
+    *,
+    vocab_size: int = 128,
+    hidden: int = 64,
+    intermediate: int = 128,
+    layers: int = 2,
+    heads: int = 8,
+    kv_heads: int = 4,
+    num_experts: int = 4,
+    top_k: int = 2,
+    max_pos: int = 2048,
+    dtype: str = "float32",
+) -> str:
+    """Write a Mixtral-architecture config.json; returns the directory."""
+    if dirname is None:
+        dirname = tempfile.mkdtemp(prefix="vdt_tiny_mixtral_")
+    cfg = {
+        "architectures": ["MixtralForCausalLM"],
+        "model_type": "mixtral",
+        "hidden_size": hidden,
+        "intermediate_size": intermediate,
+        "num_hidden_layers": layers,
+        "num_attention_heads": heads,
+        "num_key_value_heads": kv_heads,
+        "head_dim": hidden // heads,
+        "num_local_experts": num_experts,
+        "num_experts_per_tok": top_k,
+        "vocab_size": vocab_size,
+        "max_position_embeddings": max_pos,
+        "rms_norm_eps": 1e-6,
+        "rope_theta": 10000.0,
+        "torch_dtype": dtype,
+        "tie_word_embeddings": False,
+        "hidden_act": "silu",
+        "sliding_window": None,
+        "bos_token_id": 1,
+        "eos_token_id": 2,
+    }
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "config.json"), "w") as f:
+        json.dump(cfg, f)
+    return dirname
+
+
 # Shapes of real family members, for dummy-weight perf runs.
 LLAMA_1B = dict(
     vocab_size=32000, hidden=2048, intermediate=8192, layers=16,
@@ -61,4 +106,10 @@ LLAMA_1B = dict(
 LLAMA_7B = dict(
     vocab_size=32000, hidden=4096, intermediate=11008, layers=32,
     heads=32, kv_heads=32, max_pos=4096, dtype="bfloat16",
+)
+# Mixtral-8x7B (milestone config 5), for dummy-weight EP perf runs.
+MIXTRAL_8X7B = dict(
+    vocab_size=32000, hidden=4096, intermediate=14336, layers=32,
+    heads=32, kv_heads=8, num_experts=8, top_k=2, max_pos=4096,
+    dtype="bfloat16",
 )
