@@ -1,0 +1,178 @@
+"""Weight-only quantization (--quantization int8/int4): roundtrip
+accuracy, engine integration, memory halving, and sharded bit-equality.
+
+The reference serves AWQ 4-bit checkpoints via vLLM's CUDA kernels
+(/root/reference/.env.server:11); the TPU-native design quantizes on
+load and dequantizes in-graph (ops/quant.py).
+"""
+
+import numpy as np
+import pytest
+
+from tests.utils import make_tiny_llama, make_tiny_mixtral
+from vllm_distributed_tpu.config import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.ops.quant import (
+    QuantizedTensor,
+    dequantize,
+    pick_group_size,
+    quantize,
+)
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+PROMPTS = [[1, 5, 9, 23, 77, 41, 3], [7, 2, 88, 14]]
+
+
+@pytest.fixture(scope="module")
+def tiny_llama(tmp_path_factory):
+    return make_tiny_llama(
+        str(tmp_path_factory.mktemp("llama_q")), heads=8, kv_heads=4
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_mixtral(tmp_path_factory):
+    return make_tiny_mixtral(
+        str(tmp_path_factory.mktemp("mixtral_q")), heads=8, kv_heads=4
+    )
+
+
+# ---- kernel-level roundtrips ----
+def test_int8_roundtrip():
+    rng = np.random.default_rng(0)
+    w = (rng.standard_normal((64, 48)) * 0.1).astype(np.float32)
+    qt = quantize(w, 8)
+    got = np.asarray(dequantize(qt, np.float32))
+    assert np.abs(got - w).max() / np.abs(w).max() < 0.01
+    assert qt.nbytes < 0.3 * w.nbytes
+
+
+def test_int4_roundtrip_grouped():
+    rng = np.random.default_rng(1)
+    w = (rng.standard_normal((128, 32)) * 0.1).astype(np.float32)
+    qt = quantize(w, 4, group=32)
+    got = np.asarray(dequantize(qt, np.float32))
+    assert np.abs(got - w).max() / np.abs(w).max() < 0.12
+    assert qt.q.shape == (64, 32)  # two nibbles per byte
+    assert qt.scale.shape == (4, 32)
+    assert qt.nbytes < 0.2 * w.nbytes
+
+
+def test_int4_stacked_experts_roundtrip():
+    rng = np.random.default_rng(2)
+    w = (rng.standard_normal((4, 64, 32)) * 0.1).astype(np.float32)
+    qt = quantize(w, 4)
+    got = np.asarray(dequantize(qt, np.float32))
+    assert np.abs(got - w).max() / np.abs(w).max() < 0.12
+
+
+def test_group_size_respects_shards():
+    assert pick_group_size(11008, 8) <= 11008 // 8
+    assert (11008 // 8) % pick_group_size(11008, 8) == 0
+    assert pick_group_size(4096, 1) == 128
+
+
+def test_rejects_unknown_method(tiny_llama):
+    with pytest.raises(ValueError, match="unsupported quantization"):
+        EngineArgs(model=tiny_llama, quantization="fp8").create_engine_config()
+
+
+# ---- engine integration ----
+def _greedy(model_dir, quantization=None, tp=1, ep=False, max_tokens=6):
+    engine = LLMEngine.from_engine_args(
+        EngineArgs(
+            model=model_dir,
+            skip_tokenizer_init=True,
+            num_kv_pages=64,
+            max_model_len=256,
+            quantization=quantization,
+            tensor_parallel_size=tp,
+            enable_expert_parallel=ep,
+        )
+    )
+    done = {}
+    for i, p in enumerate(PROMPTS):
+        engine.add_request(
+            f"r{i}",
+            prompt_token_ids=p,
+            sampling_params=SamplingParams(
+                temperature=0.0, max_tokens=max_tokens, ignore_eos=True
+            ),
+        )
+    while engine.has_unfinished_requests():
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out.outputs[0].token_ids
+    return engine, [done[f"r{i}"] for i in range(len(PROMPTS))]
+
+
+def _param_bytes(engine):
+    import jax
+
+    return sum(
+        x.nbytes
+        for x in jax.tree.leaves(engine.executor.worker.runner.params)
+    )
+
+
+def test_int8_engine_memory_and_logits(tiny_llama):
+    eng_fp, _ = _greedy(tiny_llama)
+    eng_q, _ = _greedy(tiny_llama, quantization="int8")
+    # Attn+MLP weights dominate this tiny model less than a real one
+    # (embed/lm_head stay fp32), so just require a real reduction.
+    assert _param_bytes(eng_q) < 0.75 * _param_bytes(eng_fp)
+    # Logit agreement on a prefill: loose tolerance, quantization noise.
+    import jax.numpy as jnp
+
+    from vllm_distributed_tpu.ops.attention import AttentionMetadata
+
+    def prefill_logits(eng):
+        runner = eng.executor.worker.runner
+        prompt = PROMPTS[0]
+        t = len(prompt)
+        meta = AttentionMetadata(
+            q_seq_ids=jnp.zeros(t, jnp.int32),
+            q_positions=jnp.arange(t, dtype=jnp.int32),
+            slot_mapping=16 + jnp.arange(t, dtype=jnp.int32),
+            block_tables=jnp.ones((1, 4), jnp.int32),
+            seq_lens=jnp.full(1, t, jnp.int32),
+            logits_indices=jnp.full(1, t - 1, jnp.int32),
+            chunk_starts=jnp.zeros(1, jnp.int32),
+        )
+        logits, _ = runner.model.forward(
+            runner.params,
+            jnp.asarray(prompt, jnp.int32),
+            runner.kv_caches,
+            meta,
+        )
+        return np.asarray(logits)[0]
+
+    lf, lq = prefill_logits(eng_fp), prefill_logits(eng_q)
+    scale = np.abs(lf).max()
+    assert np.abs(lf - lq).max() / scale < 0.05
+
+
+def test_int8_tp4_matches_tp1(tiny_llama):
+    _, base = _greedy(tiny_llama, quantization="int8")
+    _, tp4 = _greedy(tiny_llama, quantization="int8", tp=4)
+    assert tp4 == base
+
+
+def test_int4_engine_runs(tiny_llama):
+    eng_q, toks = _greedy(tiny_llama, quantization="int4")
+    assert all(len(t) == 6 for t in toks)
+    eng_fp, _ = _greedy(tiny_llama)
+    assert _param_bytes(eng_q) < 0.7 * _param_bytes(eng_fp)
+
+
+def test_int8_mixtral_ep(tiny_mixtral):
+    """Quantized experts through the HF load path (per-expert tensors
+    quantized in-stream, stacked by finalize_params) under EP."""
+    _, base = _greedy(tiny_mixtral, quantization="int8")
+    _, ep4 = _greedy(tiny_mixtral, quantization="int8", tp=4, ep=True)
+    assert ep4 == base
+    # Quantized params flow as pytrees with int8 leaves.
+    eng, _ = _greedy(tiny_mixtral, quantization="int8")
+    layer = eng.executor.worker.runner.params["layers"][0]
+    assert isinstance(layer["w1"], QuantizedTensor)
+    assert layer["w1"].q.dtype == np.int8

@@ -56,6 +56,14 @@ class ModelConfig:
     enable_expert_parallel: bool = False
 
     def __post_init__(self) -> None:
+        if self.quantization is not None:
+            from vllm_distributed_tpu.ops.quant import METHODS
+
+            if self.quantization not in METHODS:
+                raise ValueError(
+                    f"unsupported quantization {self.quantization!r}; "
+                    f"supported: {METHODS} (weight-only, quantized on load)"
+                )
         if self.tokenizer is None:
             self.tokenizer = self.model
         if self.hf_config is None:

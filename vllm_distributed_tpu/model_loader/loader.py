@@ -59,19 +59,69 @@ def _set_path(tree: dict, path: tuple, value) -> None:
     node[path[-1]] = value
 
 
-def _sharding_for(path: tuple, specs: dict | None, mesh: Mesh | None):
-    if mesh is None:
-        return None
-    spec = P()
-    if specs is not None:
-        node: Any = specs
-        try:
-            for key in path:
-                node = node[key]
-            spec = node
-        except (KeyError, IndexError, TypeError):
-            spec = P()
-    return NamedSharding(mesh, spec)
+def _spec_for(path: tuple, specs: dict | None) -> P:
+    if specs is None:
+        return P()
+    node: Any = specs
+    try:
+        for key in path:
+            node = node[key]
+        return node
+    except (KeyError, IndexError, TypeError):
+        return P()
+
+
+def _quantize_and_place(model, tensor, spec: P, mesh: Mesh | None, dtype):
+    """Weight-only quantize one tensor and shard its q/scale parts.
+
+    Group size is a function of the tensor ONLY (never the mesh), so
+    tp=N and tp=1 produce bit-identical dequantized weights."""
+    from vllm_distributed_tpu.ops.quant import place_quantized, quantize
+
+    bits = 8 if model.quant_method == "int8" else 4
+    qt = quantize(tensor, bits, dtype=dtype)
+    if mesh is not None:
+        qt = place_quantized(qt, spec, mesh)
+    return qt
+
+
+def _place_tree(model, params, specs, mesh: Mesh | None):
+    """Recursive device placement for an in-memory param tree (dummy
+    init), quantizing the model's QUANT_PARAMS leaves when configured."""
+    quant = getattr(model, "quant_method", None)
+
+    def rec(p, s, path):
+        if isinstance(p, dict):
+            return {
+                k: rec(
+                    v, s.get(k) if isinstance(s, dict) else None, path + (k,)
+                )
+                for k, v in p.items()
+            }
+        if isinstance(p, list):
+            return [
+                rec(
+                    v,
+                    s[i] if isinstance(s, (list, tuple)) else None,
+                    path + (i,),
+                )
+                for i, v in enumerate(p)
+            ]
+        if s is None and specs is not None:
+            # partition_specs() drifted from init_params(): loading a
+            # weight fully replicated at scale is a silent perf/memory
+            # bug, so make the drift visible.
+            logger.warning(
+                "no partition spec for param %r; replicating", path
+            )
+        spec = s if s is not None else P()
+        if quant and model.should_quantize(path):
+            return _quantize_and_place(model, p, spec, mesh, model.dtype)
+        if mesh is not None:
+            return jax.device_put(p, NamedSharding(mesh, spec))
+        return p
+
+    return rec(params, specs, ())
 
 
 def load_hf_weights(
@@ -104,6 +154,7 @@ def load_hf_weights(
     start = time.monotonic()
     n = 0
     cpu = jax.devices("cpu")[0]
+    quant = getattr(model, "quant_method", None)
     for file in files:
         with safe_open(file, framework="flax") as f:
             for name in f.keys():
@@ -116,9 +167,18 @@ def load_hf_weights(
                     if transform == "T":
                         tensor = tensor.T
                     tensor = tensor.astype(dtype)
-                sharding = _sharding_for(path, specs, mesh)
-                if sharding is not None:
-                    tensor = jax.device_put(tensor, sharding)
+                spec = _spec_for(path, specs) if mesh is not None else P()
+                if quant and model.should_quantize(path):
+                    # Quantize per tensor DURING the stream so the full-
+                    # precision model never materializes (the point of
+                    # weight-only quant: 70B-class fits v5e HBM).
+                    tensor = _quantize_and_place(
+                        model, tensor, spec, mesh, dtype
+                    )
+                elif mesh is not None:
+                    tensor = jax.device_put(
+                        tensor, NamedSharding(mesh, spec)
+                    )
                 _set_path(params, path, tensor)
                 n += 1
     if hasattr(model, "finalize_params"):
@@ -151,15 +211,13 @@ def get_model(
     if load_format == "dummy":
         rng = rng if rng is not None else jax.random.PRNGKey(model_config.seed)
         params = model.init_params(rng)
-        if mesh is not None:
-            specs = model.partition_specs()
-            # tree.map flattens `specs` up to the structure of `params`, so
-            # each PartitionSpec (a tuple subclass) arrives whole as `s`.
-            params = jax.tree.map(
-                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-                params,
-                specs,
+        if mesh is not None or getattr(model, "quant_method", None):
+            specs = (
+                model.partition_specs()
+                if hasattr(model, "partition_specs")
+                else None
             )
+            params = _place_tree(model, params, specs, mesh)
         return model, params
     model_dir = resolve_model_dir(model_config.model)
     params = load_hf_weights(model, model_dir, mesh=mesh)

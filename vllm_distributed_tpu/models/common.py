@@ -34,8 +34,10 @@ def layer_norm(
     )
 
 
-def linear(x: jax.Array, w: jax.Array, b: jax.Array | None = None) -> jax.Array:
-    out = x @ w.astype(x.dtype)
+def linear(x: jax.Array, w, b: jax.Array | None = None) -> jax.Array:
+    from vllm_distributed_tpu.ops.quant import maybe_dequantize
+
+    out = x @ maybe_dequantize(w, x.dtype)
     if b is not None:
         out = out + b.astype(out.dtype)
     return out

@@ -41,6 +41,12 @@ class LlamaForCausalLM:
         "Qwen2ForCausalLM",
         "Qwen3ForCausalLM",
     )
+    # Weight-only quantization targets: the large matmuls.  Embeddings
+    # (gathered, and possibly tied to lm_head), norms, and biases stay in
+    # the model dtype.
+    QUANT_PARAMS = frozenset(
+        {"wq", "wk", "wv", "wo", "gate", "up", "down", "lm_head"}
+    )
 
     def __init__(self, model_config: Any) -> None:
         hf = model_config.hf_config
@@ -63,6 +69,15 @@ class LlamaForCausalLM:
         self.tie_embeddings = bool(getattr(hf, "tie_word_embeddings", False))
         self.dtype = jnp.dtype(model_config.dtype)
         self.scale = self.head_dim**-0.5
+        # Weight-only quantization method (None | "int8" | "int4"),
+        # applied tensor-by-tensor by the loader (ops/quant.py).
+        self.quant_method = model_config.quantization
+
+    def should_quantize(self, path: tuple) -> bool:
+        """Whether the param at `path` gets weight-only quantization
+        (per-expert paths end in an int index; the name precedes it)."""
+        names = [k for k in path if isinstance(k, str)]
+        return bool(names) and names[-1] in self.QUANT_PARAMS
 
     # ---- params ----
     def init_params(self, rng: jax.Array) -> dict:
@@ -238,5 +253,7 @@ class LlamaForCausalLM:
         if lm_head is None:
             logits = sel @ params["embed"].T.astype(sel.dtype)
         else:
-            logits = sel @ lm_head.astype(sel.dtype)
+            from vllm_distributed_tpu.ops.quant import maybe_dequantize
+
+            logits = sel @ maybe_dequantize(lm_head, sel.dtype)
         return logits.astype(jnp.float32), new_kv

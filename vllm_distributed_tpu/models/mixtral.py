@@ -56,6 +56,10 @@ class MixtralForCausalLM(LlamaForCausalLM):
         "MixtralForCausalLM",
         "Qwen3MoeForCausalLM",
     )
+    # Router stays unquantized (tiny and routing-decision-sensitive).
+    QUANT_PARAMS = (
+        LlamaForCausalLM.QUANT_PARAMS - {"gate", "up", "down"}
+    ) | {"w1", "w2", "w3"}
 
     def __init__(self, model_config: Any) -> None:
         super().__init__(model_config)
@@ -187,13 +191,18 @@ class MixtralForCausalLM(LlamaForCausalLM):
     def load_specs(self) -> dict:
         """Per-tensor specs used DURING HF load, where expert tensors are
         still unstacked ({e: [h, im]} dicts).  Under EP an unstacked
-        expert belongs wholly to one device, which NamedSharding cannot
-        express — experts load replicated and finalize_params reshards
-        the stack (fine at test scale; streaming EP placement is a load-
-        time optimization, not a correctness issue)."""
+        expert's final home is one device group, which NamedSharding
+        cannot express for a single tensor — so in-flight experts shard
+        over their input dim (bounded memory: tensor/tp per device) and
+        finalize_params reshards the per-layer stack to the expert
+        layout."""
         specs = self.partition_specs()
         if self.expert_parallel:
-            per_expert = {"w1": P(), "w3": P(), "w2": P()}
+            per_expert = {
+                "w1": P("tp", None),
+                "w3": P("tp", None),
+                "w2": P("tp", None),
+            }
         else:
             per_expert = {
                 "w1": P(None, "tp"),
@@ -207,8 +216,31 @@ class MixtralForCausalLM(LlamaForCausalLM):
 
     def finalize_params(self, params: dict, mesh) -> dict:
         """Stack per-expert weight dicts into [E, ...] arrays with the
-        final sharding (called by the loader after all tensors land)."""
+        final sharding (called by the loader after all tensors land).
+        Quantized experts stack their q/scale parts.  Stacking runs
+        under jit with explicit out_shardings so XLA reshards in-flight
+        (input-dim shards -> expert shards) without a replicated
+        transient — the per-layer peak stays O(layer/tp) per device."""
         from jax.sharding import NamedSharding
+
+        from vllm_distributed_tpu.ops.quant import (
+            QuantizedTensor,
+            aligned_spec,
+            quant_spec,
+        )
+
+        def stack_to(parts, spec):
+            if mesh is None:
+                return jnp.stack(parts)
+            out = NamedSharding(
+                mesh,
+                aligned_spec(
+                    spec, (len(parts), *parts[0].shape), mesh
+                ),
+            )
+            return jax.jit(
+                lambda *xs: jnp.stack(xs), out_shardings=out
+            )(*parts)
 
         final = self._expert_specs()
         for layer in params["layers"]:
@@ -221,18 +253,25 @@ class MixtralForCausalLM(LlamaForCausalLM):
                         f"checkpoint is missing experts for {name}: "
                         f"have {sorted(entry)}, want 0..{self.num_experts - 1}"
                     )
-                stacked = jnp.stack(
-                    [entry[e] for e in range(self.num_experts)]
-                )
-                if mesh is not None:
-                    stacked = jax.device_put(
-                        stacked, NamedSharding(mesh, final[name])
+                parts = [entry[e] for e in range(self.num_experts)]
+                if isinstance(parts[0], QuantizedTensor):
+                    qs = quant_spec(final[name], parts[0].bits)
+                    layer[name] = QuantizedTensor(
+                        q=stack_to([p.q for p in parts], qs.q),
+                        scale=stack_to([p.scale for p in parts], qs.scale),
+                        bits=parts[0].bits,
+                        group=parts[0].group,
+                        shape=(self.num_experts, *parts[0].shape),
+                        dtype=parts[0].dtype,
                     )
-                layer[name] = stacked
+                    continue
+                layer[name] = stack_to(parts, final[name])
         return params
 
     # ---- forward (attention loop inherited; MLP is the routed MoE) ----
     def _mlp(self, h: jax.Array, layer: dict) -> jax.Array:
+        from vllm_distributed_tpu.ops.quant import maybe_dequantize
+
         t = h.shape[0]
         logits = h @ layer["router"].astype(h.dtype)  # [T, E]
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
@@ -245,7 +284,10 @@ class MixtralForCausalLM(LlamaForCausalLM):
             .set(topw)
             .astype(h.dtype)
         )
-        h1 = jnp.einsum("th,ehi->tei", h, layer["w1"])
-        h3 = jnp.einsum("th,ehi->tei", h, layer["w3"])
+        w1 = maybe_dequantize(layer["w1"], h.dtype)
+        w3 = maybe_dequantize(layer["w3"], h.dtype)
+        w2 = maybe_dequantize(layer["w2"], h.dtype)
+        h1 = jnp.einsum("th,ehi->tei", h, w1)
+        h3 = jnp.einsum("th,ehi->tei", h, w3)
         inner = jax.nn.silu(h1) * h3
-        return jnp.einsum("tei,eih,te->th", inner, layer["w2"], combine)
+        return jnp.einsum("tei,eih,te->th", inner, w2, combine)
