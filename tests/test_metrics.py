@@ -1,0 +1,140 @@
+"""Prometheus metrics instrumentation (SURVEY.md §5.5; VERDICT r2 #5:
+the registry must carry real instruments — TTFT/ITL/throughput — wired
+from the engine loop, and /metrics must be non-empty under load)."""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from tests.utils import add_tiny_tokenizer, make_tiny_llama
+from vllm_distributed_tpu.config import EngineArgs
+from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.entrypoints.openai.api_server import (
+    build_app,
+    init_app_state,
+)
+from vllm_distributed_tpu.metrics import EngineMetrics
+from vllm_distributed_tpu.outputs import RequestMetrics
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+def test_engine_metrics_records():
+    m = EngineMetrics("m", enabled=True)
+    rm = RequestMetrics(arrival_time=100.0)
+    rm.first_token_time = 100.5
+    m.record_prompt_tokens(7)
+    m.record_new_tokens(rm, 1, now=100.5)  # first token -> TTFT
+    m.record_new_tokens(rm, 4, now=100.9)  # fused batch -> 4 ITL obs
+    m.record_queues(3, 2)
+    m.record_preemptions(1)
+    rm.finished_time = 101.0
+    m.record_finished(rm, "stop")
+    text = m.render().decode()
+    assert 'vllm:time_to_first_token_seconds_count{model_name="m"} 1.0' in text
+    assert 'vllm:time_per_output_token_seconds_count{model_name="m"} 4.0' in text
+    assert 'vllm:generation_tokens_total{model_name="m"} 5.0' in text
+    assert 'vllm:prompt_tokens_total{model_name="m"} 7.0' in text
+    assert 'vllm:num_requests_running{model_name="m"} 3.0' in text
+    assert 'vllm:num_preemptions_total{model_name="m"} 1.0' in text
+    assert (
+        'vllm:request_success_total{finished_reason="stop",model_name="m"} 1.0'
+        in text
+    )
+    # TTFT observed value lands in the right bucket neighborhood.
+    assert 'vllm:time_to_first_token_seconds_sum{model_name="m"} 0.5' in text
+
+
+def test_metrics_disabled_noop():
+    m = EngineMetrics("m", enabled=False)
+    rm = RequestMetrics(arrival_time=0.0)
+    m.record_new_tokens(rm, 3)
+    m.record_queues(1, 1)
+    assert b"disabled" in m.render()
+
+
+def test_engine_loop_populates_metrics(tmp_path):
+    engine = LLMEngine.from_engine_args(
+        EngineArgs(
+            model=make_tiny_llama(str(tmp_path / "m")),
+            skip_tokenizer_init=True,
+            num_kv_pages=64,
+            max_model_len=128,
+        )
+    )
+    engine.add_request(
+        "r0",
+        prompt_token_ids=[1, 5, 9, 23],
+        sampling_params=SamplingParams(
+            temperature=0.0, max_tokens=12, ignore_eos=True
+        ),
+    )
+    while engine.has_unfinished_requests():
+        engine.step()
+    text = engine.metrics.render().decode()
+    assert "vllm:generation_tokens_total" in text and " 12.0" in text
+    assert "vllm:time_to_first_token_seconds_count" in text
+    assert "vllm:e2e_request_latency_seconds_count" in text
+    assert 'finished_reason="length"' in text
+
+
+def test_disable_log_stats_honored(tmp_path):
+    engine = LLMEngine.from_engine_args(
+        EngineArgs(
+            model=make_tiny_llama(str(tmp_path / "m2")),
+            skip_tokenizer_init=True,
+            num_kv_pages=64,
+            max_model_len=128,
+            disable_log_stats=True,
+        )
+    )
+    assert not engine.metrics.enabled
+    assert b"disabled" in engine.metrics.render()
+
+
+@pytest.fixture(scope="module")
+def served_app(tmp_path_factory):
+    model_dir = make_tiny_llama(str(tmp_path_factory.mktemp("msrv")))
+    add_tiny_tokenizer(model_dir)
+    engine = AsyncLLM.from_engine_args(
+        EngineArgs(
+            model=model_dir,
+            num_kv_pages=128,
+            max_model_len=256,
+            max_num_seqs=8,
+        )
+    )
+    state = init_app_state(engine, served_model_name="tiny")
+    yield lambda: build_app(state)
+    engine.shutdown()
+
+
+def test_metrics_endpoint_under_load(served_app):
+    async def go(client):
+        r = await client.post(
+            "/v1/completions",
+            json={
+                "model": "tiny",
+                "prompt": "hello world",
+                "max_tokens": 8,
+                "temperature": 0,
+            },
+        )
+        assert r.status == 200
+        r = await client.get("/metrics")
+        text = await r.text()
+        assert "vllm:generation_tokens_total" in text
+        assert "vllm:time_to_first_token_seconds_bucket" in text
+        assert "vllm:num_requests_running" in text
+
+    async def run():
+        server = TestServer(served_app())
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            await go(client)
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(run())

@@ -165,6 +165,15 @@ def test_int4_engine_runs(tiny_llama):
     assert _param_bytes(eng_q) < 0.7 * _param_bytes(eng_fp)
 
 
+def test_int4_tp4_deterministic(tiny_llama):
+    """int4 grouping follows the tp layout (shard-aligned groups), so
+    tp=4 is compared against itself (determinism), not bit-against tp=1
+    — int8 is the layout-independent scheme (see test above)."""
+    _, a = _greedy(tiny_llama, quantization="int4", tp=4)
+    _, b = _greedy(tiny_llama, quantization="int4", tp=4)
+    assert a == b
+
+
 def test_int8_mixtral_ep(tiny_mixtral):
     """Quantized experts through the HF load path (per-expert tensors
     quantized in-stream, stacked by finalize_params) under EP."""

@@ -46,6 +46,14 @@ class LLMEngine:
             config.scheduler_config, config.cache_config, num_pages
         )
 
+        from vllm_distributed_tpu.metrics import EngineMetrics
+
+        self.metrics = EngineMetrics(
+            config.model_config.model,
+            enabled=config.observability_config.collect_metrics,
+        )
+        self._preemptions_seen = 0
+
         self.tokenizer = None
         if not config.model_config.skip_tokenizer_init:
             self.tokenizer = get_tokenizer(
@@ -209,6 +217,13 @@ class LLMEngine:
             scheduler_output, runner_output.sampled_token_ids
         )
         now = time.time()
+        self.metrics.record_queues(
+            len(self.scheduler.running), len(self.scheduler.waiting)
+        )
+        self.metrics.record_preemptions(
+            self.scheduler.num_preemptions - self._preemptions_seen
+        )
+        self._preemptions_seen = self.scheduler.num_preemptions
 
         outputs: list[RequestOutput] = []
         for req_id in scheduler_output.num_scheduled_tokens:
@@ -222,6 +237,10 @@ class LLMEngine:
             new_tokens = runner_output.sampled_token_ids.get(req_id, [])
             if new_tokens and req.metrics.first_token_time is None:
                 req.metrics.first_token_time = now
+                self.metrics.record_prompt_tokens(req.num_prompt_tokens)
+            self.metrics.record_new_tokens(
+                req.metrics, len(new_tokens), now
+            )
             if req_id in runner_output.logprobs and req.logprobs is not None:
                 lps = runner_output.logprobs[req_id]
                 req.logprobs.extend(lps)
@@ -253,6 +272,9 @@ class LLMEngine:
             outputs.append(self._make_output(req, detok))
 
         for req in finished:
+            self.metrics.record_finished(
+                req.metrics, FINISH_REASON.get(req.status)
+            )
             self.detokenizers.pop(req.request_id, None)
         return outputs
 

@@ -95,6 +95,8 @@ class Scheduler:
         self._step_id = 0
         # Finished/preempted since last step, to notify workers.
         self._finished_since_last: list[str] = []
+        # Cumulative preemption count (metrics, SURVEY.md §5.5).
+        self.num_preemptions = 0
 
     # ---- intake ----
     def add_request(self, req: Request) -> None:
@@ -332,6 +334,7 @@ class Scheduler:
 
     def _preempt(self, req: Request, preempted: set[str]) -> None:
         logger.debug("preempting request %s", req.request_id)
+        self.num_preemptions += 1
         self.allocator.free(req)
         req.status = RequestStatus.PREEMPTED
         req.num_computed_tokens = 0

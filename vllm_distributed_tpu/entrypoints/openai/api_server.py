@@ -494,15 +494,12 @@ async def _stream_completion(
 
 
 async def metrics(request: web.Request) -> web.Response:
-    try:
-        from prometheus_client import REGISTRY, generate_latest
-
-        return web.Response(
-            body=generate_latest(REGISTRY),
-            content_type="text/plain",
-        )
-    except ImportError:
-        return _error("prometheus_client unavailable", 501)
+    """Engine-loop Prometheus instruments (TTFT/ITL/throughput/queues —
+    the reference serves vLLM's via build_app, launch.py:429-432)."""
+    state: ServerState = request.app["state"]
+    return web.Response(
+        body=state.engine.metrics.render(), content_type="text/plain"
+    )
 
 
 # ---- app assembly ----

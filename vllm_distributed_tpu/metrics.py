@@ -1,0 +1,174 @@
+"""Prometheus instrumentation for the engine loop (SURVEY.md §5.5).
+
+The reference serves vLLM's Prometheus metrics through `build_app`
+(/root/reference/src/launch.py:429-432); this is the TPU-native engine's
+equivalent, using vLLM's metric names (prefix ``vllm:``) so existing
+dashboards/alerts keep working after a backend swap.
+
+One ``EngineMetrics`` per engine with its own CollectorRegistry (no
+global-registry collisions across engines/tests).  Disabled via
+``--disable-log-stats`` (ObservabilityConfig.collect_metrics=False), in
+which case every record call is a no-op and /metrics reports only
+process defaults.
+"""
+
+from __future__ import annotations
+
+import time
+
+_TTFT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1, 0.25, 0.5,
+    0.75, 1.0, 2.5, 5.0, 7.5, 10.0, 20.0, 40.0, 80.0,
+)
+_ITL_BUCKETS = (
+    0.0005, 0.001, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1, 0.25,
+    0.5, 0.75, 1.0, 2.5,
+)
+_E2E_BUCKETS = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 40.0, 80.0, 160.0,
+)
+
+
+class EngineMetrics:
+    """Engine-loop instruments; every method is a no-op when disabled."""
+
+    def __init__(self, model_name: str, enabled: bool = True) -> None:
+        self.enabled = enabled
+        self.registry = None
+        if not enabled:
+            return
+        try:
+            from prometheus_client import (
+                CollectorRegistry,
+                Counter,
+                Gauge,
+                Histogram,
+            )
+        except ImportError:
+            # Degrade to disabled rather than failing engine startup on
+            # an install without the (optional) prometheus_client.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "prometheus_client not installed; metrics disabled"
+            )
+            self.enabled = False
+            return
+
+        self.registry = CollectorRegistry()
+        label = {"model_name": model_name}
+
+        def counter(name, doc):
+            return Counter(
+                name, doc, ["model_name"], registry=self.registry
+            ).labels(**label)
+
+        def gauge(name, doc):
+            return Gauge(
+                name, doc, ["model_name"], registry=self.registry
+            ).labels(**label)
+
+        def histogram(name, doc, buckets):
+            return Histogram(
+                name,
+                doc,
+                ["model_name"],
+                buckets=buckets,
+                registry=self.registry,
+            ).labels(**label)
+
+        self.num_running = gauge(
+            "vllm:num_requests_running",
+            "Requests currently executing on the device",
+        )
+        self.num_waiting = gauge(
+            "vllm:num_requests_waiting", "Requests queued for admission"
+        )
+        self.prompt_tokens = counter(
+            "vllm:prompt_tokens", "Prefill tokens processed"
+        )
+        self.generation_tokens = counter(
+            "vllm:generation_tokens", "Tokens generated"
+        )
+        self.preemptions = counter(
+            "vllm:num_preemptions", "Requests preempted by the scheduler"
+        )
+        self.ttft = histogram(
+            "vllm:time_to_first_token_seconds",
+            "Time from request arrival to first generated token",
+            _TTFT_BUCKETS,
+        )
+        self.itl = histogram(
+            "vllm:time_per_output_token_seconds",
+            "Inter-token latency (per generated token after the first)",
+            _ITL_BUCKETS,
+        )
+        self.e2e_latency = histogram(
+            "vllm:e2e_request_latency_seconds",
+            "Request end-to-end latency",
+            _E2E_BUCKETS,
+        )
+        from prometheus_client import Counter as _Counter
+
+        self._success = _Counter(
+            "vllm:request_success",
+            "Finished requests by finish reason",
+            ["model_name", "finished_reason"],
+            registry=self.registry,
+        )
+        self._model_name = model_name
+
+    # ---- engine-loop hooks ----
+    def record_queues(self, running: int, waiting: int) -> None:
+        if not self.enabled:
+            return
+        self.num_running.set(running)
+        self.num_waiting.set(waiting)
+
+    def record_preemptions(self, n: int) -> None:
+        if self.enabled and n:
+            self.preemptions.inc(n)
+
+    def record_prompt_tokens(self, n: int) -> None:
+        if self.enabled and n:
+            self.prompt_tokens.inc(n)
+
+    def record_new_tokens(self, req_metrics, n: int, now: float | None = None) -> None:
+        """n new tokens for one request: TTFT on the first, ITL after."""
+        if not self.enabled or n <= 0:
+            return
+        now = now if now is not None else time.time()
+        self.generation_tokens.inc(n)
+        last = req_metrics.last_token_time
+        if req_metrics.first_token_time is not None and last is None:
+            # first batch of tokens for this request
+            self.ttft.observe(
+                req_metrics.first_token_time - req_metrics.arrival_time
+            )
+            n_after_first = n - 1
+        else:
+            n_after_first = n
+        if last is not None and n_after_first > 0:
+            per_tok = max(now - last, 0.0) / n_after_first
+            for _ in range(n_after_first):
+                self.itl.observe(per_tok)
+        req_metrics.last_token_time = now
+
+    def record_finished(self, req_metrics, reason: str | None) -> None:
+        if not self.enabled:
+            return
+        if req_metrics.finished_time is not None:
+            self.e2e_latency.observe(
+                req_metrics.finished_time - req_metrics.arrival_time
+            )
+        self._success.labels(
+            model_name=self._model_name, finished_reason=reason or "unknown"
+        ).inc()
+
+    def render(self) -> bytes:
+        """Prometheus text exposition of this engine's registry."""
+        if self.registry is None:
+            return b"# metrics disabled (--disable-log-stats)\n"
+        from prometheus_client import generate_latest
+
+        return generate_latest(self.registry)

@@ -71,15 +71,41 @@ def _spec_for(path: tuple, specs: dict | None) -> P:
         return P()
 
 
+def _in_dim_shards(spec: P, mesh: Mesh | None, ndim: int) -> int:
+    """Mesh shards along the weight's input (second-to-last) dim."""
+    from vllm_distributed_tpu.ops.quant import axis_shards
+
+    if mesh is None:
+        return 1
+    t = tuple(spec)
+    pos = ndim - 2
+    if pos < 0 or pos >= len(t) or t[pos] is None:
+        return 1
+    return axis_shards(t[pos], mesh)
+
+
 def _quantize_and_place(model, tensor, spec: P, mesh: Mesh | None, dtype):
     """Weight-only quantize one tensor and shard its q/scale parts.
 
-    Group size is a function of the tensor ONLY (never the mesh), so
-    tp=N and tp=1 produce bit-identical dequantized weights."""
-    from vllm_distributed_tpu.ops.quant import place_quantized, quantize
+    int4 group boundaries align with the deployment's tp shards (so the
+    grouped dequant reshape never crosses devices in the decode hot
+    path).  This makes int4 grouping a function of the tp layout — like
+    an AWQ checkpoint generated for a target config, int4 outputs agree
+    across tp sizes within quantization tolerance, not bit-for-bit
+    (int8 is layout-independent and bit-identical across tp)."""
+    from vllm_distributed_tpu.ops.quant import (
+        pick_group_size,
+        place_quantized,
+        quantize,
+    )
 
     bits = 8 if model.quant_method == "int8" else 4
-    qt = quantize(tensor, bits, dtype=dtype)
+    group = 0
+    if bits == 4:
+        group = pick_group_size(
+            tensor.shape[-2], _in_dim_shards(spec, mesh, tensor.ndim)
+        )
+    qt = quantize(tensor, bits, group, dtype=dtype)
     if mesh is not None:
         qt = place_quantized(qt, spec, mesh)
     return qt

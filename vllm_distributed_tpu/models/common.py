@@ -13,6 +13,26 @@ import jax
 import jax.numpy as jnp
 
 
+class SupportsQuantization:
+    """Weight-only quantization hooks shared by the model zoo.
+
+    Subclasses set ``QUANT_PARAMS`` (leaf names of the big matmuls —
+    embeddings/norms/biases/routers stay in the model dtype) and call
+    ``_init_quant(model_config)`` from ``__init__``.  A model that skips
+    both simply never quantizes (the loader checks ``quant_method``)."""
+
+    QUANT_PARAMS: frozenset = frozenset()
+
+    def _init_quant(self, model_config) -> None:
+        self.quant_method = model_config.quantization
+
+    def should_quantize(self, path: tuple) -> bool:
+        """Whether the param at `path` gets weight-only quantization
+        (per-expert paths end in an int index; the name precedes it)."""
+        names = [k for k in path if isinstance(k, str)]
+        return bool(names) and names[-1] in self.QUANT_PARAMS
+
+
 def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     dtype = x.dtype
     x = x.astype(jnp.float32)

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from vllm_distributed_tpu.models.common import (
+    SupportsQuantization,
     apply_rope,
     linear,
     rms_norm,
@@ -34,7 +35,7 @@ from vllm_distributed_tpu.ops.attention import (
 )
 
 
-class LlamaForCausalLM:
+class LlamaForCausalLM(SupportsQuantization):
     architectures = (
         "LlamaForCausalLM",
         "MistralForCausalLM",
@@ -69,15 +70,7 @@ class LlamaForCausalLM:
         self.tie_embeddings = bool(getattr(hf, "tie_word_embeddings", False))
         self.dtype = jnp.dtype(model_config.dtype)
         self.scale = self.head_dim**-0.5
-        # Weight-only quantization method (None | "int8" | "int4"),
-        # applied tensor-by-tensor by the loader (ops/quant.py).
-        self.quant_method = model_config.quantization
-
-    def should_quantize(self, path: tuple) -> bool:
-        """Whether the param at `path` gets weight-only quantization
-        (per-expert paths end in an int index; the name precedes it)."""
-        names = [k for k in path if isinstance(k, str)]
-        return bool(names) and names[-1] in self.QUANT_PARAMS
+        self._init_quant(model_config)
 
     # ---- params ----
     def init_params(self, rng: jax.Array) -> dict:

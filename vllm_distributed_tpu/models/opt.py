@@ -13,7 +13,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from vllm_distributed_tpu.models.common import layer_norm, linear
+from vllm_distributed_tpu.models.common import (
+    SupportsQuantization,
+    layer_norm,
+    linear,
+)
 from vllm_distributed_tpu.ops.attention import (
     AttentionMetadata,
     paged_attention_reference,
@@ -23,13 +27,13 @@ from vllm_distributed_tpu.ops.attention import (
 _POS_OFFSET = 2  # HF OPT reserves the first two position rows.
 
 
-class OPTForCausalLM:
+class OPTForCausalLM(SupportsQuantization):
     architectures = ("OPTForCausalLM",)
     QUANT_PARAMS = frozenset({"wq", "wk", "wv", "wo", "fc1", "fc2"})
 
     def __init__(self, model_config: Any) -> None:
         hf = model_config.hf_config
-        self.quant_method = model_config.quantization
+        self._init_quant(model_config)
         self.num_layers = hf.num_hidden_layers
         self.hidden_size = hf.hidden_size
         self.num_heads = hf.num_attention_heads
@@ -47,10 +51,6 @@ class OPTForCausalLM:
         self.dtype = jnp.dtype(model_config.dtype)
         self.scale = self.head_dim**-0.5
         self.eps = 1e-5
-
-    def should_quantize(self, path: tuple) -> bool:
-        names = [k for k in path if isinstance(k, str)]
-        return bool(names) and names[-1] in self.QUANT_PARAMS
 
     def init_params(self, rng: jax.Array) -> dict:
         h, d, f, v = self.hidden_size, self.head_dim, self.ffn_dim, self.vocab_size

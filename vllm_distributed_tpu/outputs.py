@@ -58,6 +58,8 @@ class RequestMetrics:
     first_scheduled_time: float | None = None
     first_token_time: float | None = None
     finished_time: float | None = None
+    # Wall time of the most recent token delivery (ITL instrumentation).
+    last_token_time: float | None = None
 
     @property
     def ttft(self) -> float | None:
