@@ -5,8 +5,13 @@ selection, env replication, and failure propagation without chips)."""
 from __future__ import annotations
 
 import os
+import queue
+import time
 
 from vllm_distributed_tpu.outputs import ModelRunnerOutput
+
+# Simulated device time per fused dispatch in the two-phase protocol.
+MOCK_STEP_SECONDS = 0.3
 
 
 class MockWorker:
@@ -23,6 +28,10 @@ class MockWorker:
         self.distributed_init_method = distributed_init_method
         self.is_driver_worker = is_driver_worker
         self.calls: list[str] = []
+        self._deferred: queue.Queue = queue.Queue()
+        # (event, step_id, monotonic time) — lets tests assert that
+        # dispatch N+1 reached this worker before fetch N completed.
+        self.timeline: list[tuple[str, int, float]] = []
 
     def init_device(self) -> None:
         self.calls.append("init_device")
@@ -45,8 +54,34 @@ class MockWorker:
             out.sampled_token_ids[req_id] = [42]
         return out
 
+    # ---- two-phase step (cross-RPC pipelining) ----
+    def dispatch_model(self, scheduler_output) -> int:
+        self.timeline.append(
+            ("dispatch", scheduler_output.step_id, time.monotonic())
+        )
+        self._deferred.put(scheduler_output)
+        return scheduler_output.step_id
+
+    def fetch_results(self, step_id: int) -> ModelRunnerOutput | None:
+        so = self._deferred.get(timeout=10)
+        assert so.step_id == step_id, (so.step_id, step_id)
+        time.sleep(MOCK_STEP_SECONDS)  # pretend the device is busy
+        self.timeline.append(("fetch_done", step_id, time.monotonic()))
+        if not self.is_driver_worker:
+            return None
+        out = ModelRunnerOutput()
+        for req_id in so.num_scheduled_tokens:
+            out.sampled_token_ids[req_id] = [42]
+        return out
+
+    def get_timeline(self) -> list[tuple[str, int, float]]:
+        return list(self.timeline)
+
     def check_health(self) -> bool:
         return True
+
+    def shutdown(self) -> None:
+        pass
 
     def get_rank_and_env(self, var: str) -> tuple[int, str | None]:
         return self.rank, os.environ.get(var)

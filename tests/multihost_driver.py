@@ -1,0 +1,49 @@
+"""Subprocess entry for the 2-process real-model multihost test: runs the
+driver half (MultiHostExecutor + real Worker, tp=2 over a 2-process
+jax.distributed CPU world) and prints the greedy tokens.
+
+Run with: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1
+          VDT_SERVER_PORT=<port> VDT_HOST_IP=127.0.0.1
+          python tests/multihost_driver.py <model_dir>
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    model_dir = sys.argv[1]
+    from vllm_distributed_tpu.config import EngineArgs
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+
+    engine = LLMEngine.from_engine_args(
+        EngineArgs(
+            model=model_dir,
+            skip_tokenizer_init=True,
+            load_format="dummy",
+            num_kv_pages=32,
+            max_model_len=64,
+            tensor_parallel_size=2,
+            num_hosts=2,
+            num_decode_steps=4,
+            distributed_executor_backend="multihost",
+        )
+    )
+    engine.add_request(
+        "x",
+        prompt_token_ids=[1, 5, 9],
+        sampling_params=SamplingParams(
+            temperature=0.0, max_tokens=6, ignore_eos=True
+        ),
+    )
+    toks = None
+    while engine.has_unfinished_requests():
+        for out in engine.step():
+            toks = out.outputs[0].token_ids
+    print("TOKENS=" + json.dumps(toks), flush=True)
+    engine.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -45,15 +45,22 @@ class WorkerHost:
     def __init__(self, worker: Any) -> None:
         self.worker = worker
         # Device work blocks; keep RPC handling responsive and calls
-        # ordered with a single-thread pool.
+        # ordered with a single-thread pool.  fetch_results gets its OWN
+        # ordered pool: it blocks until a dispatched step's results are
+        # ready, and must not stall the next dispatch_model behind it
+        # (cross-RPC pipelining: dispatch N+1 overlaps fetch N).
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="vdt-worker"
+        )
+        self._fetch_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="vdt-worker-fetch"
         )
 
     async def run(self, method: str, args: tuple, kwargs: dict) -> Any:
         loop = asyncio.get_running_loop()
+        pool = self._fetch_pool if method == "fetch_results" else self._pool
         return await loop.run_in_executor(
-            self._pool, run_method, self.worker, method, args, kwargs or {}
+            pool, run_method, self.worker, method, args, kwargs or {}
         )
 
 
@@ -84,12 +91,37 @@ async def agent_async_main(server_ip: str, port: int | None = None) -> None:
     state: dict[str, Any] = {"worker_host": None}
     gc_task = asyncio.ensure_future(_gc_pacer())
 
-    def host_info() -> dict:
-        import jax
+    info_cache: dict[str, Any] = {}
 
+    async def host_info() -> dict:
+        """Chips this host offers.  Probed in a SUBPROCESS: initializing
+        jax here would pin the agent's backend before the worker's
+        jax.distributed.initialize (which must run first).  Env
+        overrides let operators/tests pin the advertisement."""
+        env_chips = os.environ.get("VDT_ADVERTISE_NUM_CHIPS")
+        env_platform = os.environ.get("VDT_ADVERTISE_PLATFORM")
+        if env_chips and env_platform:
+            return {"num_chips": int(env_chips), "platform": env_platform}
+        if not info_cache:
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable,
+                "-c",
+                "import jax; print(jax.local_device_count(), "
+                "jax.default_backend())",
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.DEVNULL,
+            )
+            out, _ = await proc.communicate()
+            try:
+                chips, platform = out.decode().split()[-2:]
+                info_cache.update(
+                    num_chips=int(chips), platform=platform
+                )
+            except (ValueError, IndexError):
+                info_cache.update(num_chips=0, platform="unknown")
         return {
-            "num_chips": jax.local_device_count(),
-            "platform": jax.default_backend(),
+            "num_chips": int(env_chips or info_cache["num_chips"]),
+            "platform": env_platform or info_cache["platform"],
         }
 
     async def create_worker(
@@ -108,6 +140,9 @@ async def agent_async_main(server_ip: str, port: int | None = None) -> None:
         logger.info("worker created: host rank %d/%d", rank, num_hosts)
         return state["worker_host"]
 
+    # Pre-warm the chip probe so the driver's host_info call answers
+    # from cache instead of paying the cold jax import inline.
+    warm_task = asyncio.ensure_future(host_info())
     try:
         while True:
             try:
@@ -142,6 +177,7 @@ async def agent_async_main(server_ip: str, port: int | None = None) -> None:
                 sys.exit(1)
             await asyncio.sleep(RETRY_SECONDS)
     finally:
+        warm_task.cancel()
         gc_task.cancel()
 
 

@@ -85,6 +85,16 @@ class MultiHostExecutor(Executor):
         self._local_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="vdt-local-worker"
         )
+        # Local fetch_results runs off the dispatch thread (mirrors the
+        # agent's split pools) so dispatch N+1 overlaps fetch N.
+        self._local_fetch_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="vdt-local-fetch"
+        )
+        # Resolver threads for in-flight steps (two dispatches in flight
+        # at steady state; replaces thread-per-dispatch).
+        self._gather_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="vdt-gather"
+        )
 
         self.distributed_init_method = get_distributed_init_method(
             os.environ.get("VDT_HOST_IP") or get_ip(), get_open_port()
@@ -149,6 +159,38 @@ class MultiHostExecutor(Executor):
                 logger.warning("surplus agent from %s; rejecting", addr)
                 writer.close()
                 return
+            # Validate the host's chips before giving it a slot (the
+            # reference warns and skips short nodes, launch.py:226-231;
+            # round 2 published host_info but never read it).
+            readloop_task = asyncio.ensure_future(readloop())
+            try:
+                # Generous timeout: the agent's probe subprocess imports
+                # jax, which initializes the TPU runtime cold.
+                info = await asyncio.wait_for(self._host_info(peer), 60)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("agent %s: host_info failed (%s)", addr, e)
+                writer.close()
+                return await self._await_readloop(readloop_task)
+            required = max(self.parallel_config.world_size // self.num_hosts, 1)
+            if (
+                info.get("platform") == "tpu"
+                and info.get("num_chips", 0) < required
+            ):
+                logger.warning(
+                    "agent %s offers %d chip(s); deployment needs %d per "
+                    "host — skipping this host",
+                    addr,
+                    info.get("num_chips", 0),
+                    required,
+                )
+                writer.close()
+                return await self._await_readloop(readloop_task)
+            # Re-check capacity: the host_info await above suspended this
+            # handler, so another agent may have taken the last slot.
+            if len(self._remote_hosts) >= self.num_hosts - 1:
+                logger.warning("surplus agent from %s; rejecting", addr)
+                writer.close()
+                return await self._await_readloop(readloop_task)
             host = RemoteHost(
                 host_rank=len(self._remote_hosts) + 1,
                 peer=peer,
@@ -158,9 +200,12 @@ class MultiHostExecutor(Executor):
             logger.info(
                 "agent %s connected as host rank %d", addr, host.host_rank
             )
-            if len(self._remote_hosts) == self.num_hosts - 1:
+            if (
+                len(self._remote_hosts) == self.num_hosts - 1
+                and not self._hosts_ready.done()
+            ):
                 self._hosts_ready.set_result(True)
-            await readloop()
+            await readloop_task
         except Exception as e:  # noqa: BLE001
             logger.warning("agent %s read loop ended: %s", addr, e)
         finally:
@@ -175,6 +220,19 @@ class MultiHostExecutor(Executor):
                     self._notify_failure()
                 elif host in self._remote_hosts:
                     self._remote_hosts.remove(host)
+
+    async def _host_info(self, peer) -> dict:
+        host_info = await peer.get_param("host_info")
+        return await host_info()
+
+    @staticmethod
+    async def _await_readloop(task) -> None:
+        """Drain a rejected agent's read loop (errors expected: we just
+        closed its transport)."""
+        try:
+            await task
+        except Exception:  # noqa: BLE001
+            pass
 
     async def _create_remote_workers(self) -> None:
         env = envs.replication_env()
@@ -219,19 +277,65 @@ class MultiHostExecutor(Executor):
         futures = [local_fut, *remote_futs]
 
         if non_block:
-            out: concurrent.futures.Future = concurrent.futures.Future()
-
-            def _resolve():
-                try:
-                    out.set_result(
-                        self._gather(futures, unique_reply_rank, timeout)
-                    )
-                except Exception as e:  # noqa: BLE001
-                    out.set_exception(e)
-
-            threading.Thread(target=_resolve, daemon=True).start()
-            return out
+            return self._gather_pool.submit(
+                self._gather, futures, unique_reply_rank, timeout
+            )
         return self._gather(futures, unique_reply_rank, timeout)
+
+    def execute_model(self, scheduler_output, non_block: bool = False):
+        """Blocking path: one collective execute_model RPC.  Pipelined
+        path (non_block): two-phase dispatch_model / fetch_results so
+        the per-step DCN round trip overlaps device compute — the
+        steady-state amortization the fused-decode design exists for
+        (SURVEY.md §3.3; reference's in-flight batches,
+        launch.py:298-302).
+
+        Per-peer ordering: dispatch and fetch RPCs are scheduled on the
+        executor loop from this (engine) thread, in program order; the
+        agent routes the two verbs to separate single-thread pools, so
+        dispatches stay ordered, fetches stay ordered, and fetch N never
+        blocks dispatch N+1."""
+        if not non_block:
+            return super().execute_model(scheduler_output)
+        if self.is_failed:
+            raise RuntimeError("Executor failed.")
+        step_id = scheduler_output.step_id
+        local_d = self._local_pool.submit(
+            run_method,
+            self._local_worker,
+            "dispatch_model",
+            (scheduler_output,),
+            {},
+        )
+        remote_d = [
+            asyncio.run_coroutine_threadsafe(
+                host.worker.run("dispatch_model", (scheduler_output,), {}),
+                self._loop,
+            )
+            for host in self._remote_hosts
+            if host.worker is not None
+        ]
+
+        def _local_fetch():
+            local_d.result()  # dispatch errors surface here, in order
+            return run_method(
+                self._local_worker, "fetch_results", (step_id,), {}
+            )
+
+        local_f = self._local_fetch_pool.submit(_local_fetch)
+        remote_f = [
+            asyncio.run_coroutine_threadsafe(
+                host.worker.run("fetch_results", (step_id,), {}), self._loop
+            )
+            for host in self._remote_hosts
+            if host.worker is not None
+        ]
+        return self._gather_pool.submit(
+            self._gather,
+            [local_f, *remote_f, *remote_d],
+            0,  # host 0 (local driver) holds the canonical output
+            self.execute_timeout,
+        )
 
     def _gather(self, futures, unique_reply_rank, timeout):
         # One overall deadline, not timeout × num_hosts.
@@ -259,7 +363,21 @@ class MultiHostExecutor(Executor):
     def output_rank(self) -> int:
         return 0  # SPMD: host 0's copy of the output is canonical.
 
+    def _notify_failure(self) -> None:
+        # Errors during an intentional shutdown are teardown noise, not
+        # deployment failures — don't mark the engine dead for them.
+        if getattr(self, "_shutting_down", False):
+            return
+        super()._notify_failure()
+
     def shutdown(self) -> None:
+        self._shutting_down = True
+        # Clean jax.distributed teardown on every host BEFORE dropping
+        # the control plane (the shutdown barrier needs all tasks).
+        try:
+            self.collective_rpc("shutdown", timeout=15.0)
+        except Exception:  # noqa: BLE001 — failed/partial deployments
+            pass
         for host in self._remote_hosts:
             try:
                 host.peer.kill("executor shutdown")
@@ -267,3 +385,5 @@ class MultiHostExecutor(Executor):
                 pass
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._local_pool.shutdown(wait=False)
+        self._local_fetch_pool.shutdown(wait=False)
+        self._gather_pool.shutdown(wait=False)
