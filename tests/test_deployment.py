@@ -1,0 +1,67 @@
+"""Deployment harness contract (SURVEY.md §2 C9/L5): the .env +
+`docker compose up` flow with the crash-restart loop.  docker isn't
+available in CI, so the compose file is validated structurally (the
+fields `docker compose config` would check) plus the env contract."""
+
+import os
+import re
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _compose():
+    with open(os.path.join(REPO, "docker-compose.yml")) as f:
+        return yaml.safe_load(f)
+
+
+def test_compose_parses_with_service():
+    doc = _compose()
+    assert "vdt" in doc["services"]
+
+
+def test_crash_restart_loop():
+    # restart: unless-stopped + agent/executor fail-fast exits form the
+    # recovery loop (reference docker-compose.yml:8; SURVEY.md §3.5).
+    svc = _compose()["services"]["vdt"]
+    assert svc["restart"] == "unless-stopped"
+
+
+def test_command_env_contract():
+    svc = _compose()["services"]["vdt"]
+    assert svc["command"] == "${COMMAND}"
+    # Both role files define COMMAND and agree on the harness contract.
+    roles = {}
+    for name in (".env.server", ".env.client"):
+        text = open(os.path.join(REPO, name)).read()
+        cmd = re.search(r"^COMMAND=(.+)$", text, re.M)
+        assert cmd, f"{name} must set COMMAND"
+        roles[name] = cmd.group(1)
+    assert roles[".env.server"].startswith("serve ")
+    assert roles[".env.client"].startswith("remote ")
+
+
+def test_host_network_and_cache_volumes():
+    svc = _compose()["services"]["vdt"]
+    assert svc["network_mode"] == "host"
+    vols = " ".join(svc["volumes"])
+    assert "ROOT_CACHE_PATH" in vols and "/root/.cache" in vols
+
+
+def test_env_commands_parse_with_cli():
+    """The COMMANDs in the role files must parse with the real CLI parser
+    (catches drift between the harness and the arg surface)."""
+    from vllm_distributed_tpu.entrypoints.cli import make_parser
+
+    parser = make_parser()
+    for name in (".env.server", ".env.client"):
+        text = open(os.path.join(REPO, name)).read()
+        cmd = re.search(r"^COMMAND=(.+)$", text, re.M).group(1)
+        args = parser.parse_args(cmd.split())
+        assert args.command in ("serve", "remote")
+
+
+def test_dockerfile_entrypoint_matches():
+    text = open(os.path.join(REPO, "Dockerfile")).read()
+    assert '"-m", "vllm_distributed_tpu"' in text

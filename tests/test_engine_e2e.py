@@ -199,3 +199,22 @@ def test_sampling_seed_determinism(tiny_llama):
     c = run(999)
     assert a == b
     assert a != c or len(a) == 0  # overwhelmingly likely to differ
+
+
+def test_pipeline_parallel_rejected(tiny_llama):
+    """PP is deliberately unsupported on TPU (see README rationale);
+    the flag errors loudly instead of being accepted and ignored."""
+    with pytest.raises(ValueError, match="pipeline parallelism"):
+        _make_engine(tiny_llama, pipeline_parallel_size=2)
+
+
+def test_kv_cache_dtype_honored(tiny_llama):
+    """cache_dtype narrows the KV pool (doubling capacity) while the
+    model stays in its own dtype."""
+    import jax.numpy as jnp
+
+    engine = _make_engine(tiny_llama, kv_cache_dtype="bfloat16")
+    runner = engine.executor.worker.runner
+    assert runner.kv_caches[0][0].dtype == jnp.bfloat16
+    toks = _run_greedy(engine, [[1, 5, 9, 23]], max_tokens=4)[0]
+    assert len(toks) == 4

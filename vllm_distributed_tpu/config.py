@@ -144,9 +144,18 @@ class CacheConfig:
     hbm_utilization: float = 0.9
     cache_dtype: str = "auto"  # "auto" follows model dtype
 
+    _CACHE_DTYPES = ("auto", "bfloat16", "float16", "float32")
+
     def __post_init__(self) -> None:
         if self.page_size & (self.page_size - 1):
             raise ValueError(f"page_size must be a power of 2, got {self.page_size}")
+        if self.cache_dtype not in self._CACHE_DTYPES:
+            raise ValueError(
+                f"unsupported kv-cache dtype {self.cache_dtype!r}; "
+                f"supported: {self._CACHE_DTYPES} (quantized KV caches "
+                "are not implemented — weights quantize via "
+                "--quantization)"
+            )
 
 
 @dataclass
@@ -180,6 +189,17 @@ class ParallelConfig:
         )
 
     def __post_init__(self) -> None:
+        if self.pipeline_parallel_size != 1:
+            raise ValueError(
+                "pipeline parallelism is deliberately not supported on "
+                "TPU: one jitted SPMD program spans every mesh device, so "
+                "stage-level overlap between in-flight batches cannot "
+                "happen inside a single program, and ICI bandwidth makes "
+                "pure tensor parallelism scale to pod slices without PP's "
+                "pipeline bubbles (the reference needed PP because its "
+                "data plane was NCCL over a LAN, launch.py:211-314).  Use "
+                "-tp across chips/hosts instead; see README.md."
+            )
         if self.enable_expert_parallel and self.expert_parallel_size == 1:
             self.expert_parallel_size = self.tensor_parallel_size
 

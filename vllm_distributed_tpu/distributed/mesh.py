@@ -1,11 +1,12 @@
 """Device-mesh construction.
 
-The world is one jax.sharding.Mesh with named axes ("dp", "tp", and
-"expert" folded onto tp when EP is enabled) — parallelism becomes sharding
-annotations over these axes instead of the reference's rank arithmetic
-(launch.py:211-247; SURVEY.md §2.4, §7 design stance).  ICI carries
-same-slice axes; DCN-spanning meshes put the outer (dp/pp) axis across
-hosts, which is what `jax.make_mesh` does by default with device order.
+The world is one jax.sharding.Mesh with named axes ("dp", "tp"; the
+expert axis folds onto tp when EP is enabled) — parallelism becomes
+sharding annotations over these axes instead of the reference's rank
+arithmetic (launch.py:211-247; SURVEY.md §2.4, §7 design stance).  ICI
+carries same-slice axes; DCN-spanning meshes put the outer (dp) axis
+across hosts, which is what device order gives by default.  There is no
+"pp" axis on purpose — see ParallelConfig's rejection rationale.
 """
 
 from __future__ import annotations
@@ -20,13 +21,11 @@ from vllm_distributed_tpu.config import ParallelConfig
 def build_mesh(parallel_config: ParallelConfig, devices=None) -> Mesh:
     tp = parallel_config.tensor_parallel_size
     dp = parallel_config.data_parallel_size
-    pp = parallel_config.pipeline_parallel_size
     devices = devices if devices is not None else jax.devices()
-    need = tp * dp * pp
+    need = tp * dp
     if len(devices) < need:
         raise ValueError(
-            f"need {need} devices for dp={dp} pp={pp} tp={tp}, have "
-            f"{len(devices)}"
+            f"need {need} devices for dp={dp} tp={tp}, have {len(devices)}"
         )
-    devices = np.asarray(devices[:need]).reshape(dp, pp, tp)
-    return Mesh(devices, axis_names=("dp", "pp", "tp"))
+    devices = np.asarray(devices[:need]).reshape(dp, tp)
+    return Mesh(devices, axis_names=("dp", "tp"))

@@ -35,9 +35,6 @@ environment_variables: dict[str, Callable[[], Any]] = {
     "VDT_HBM_UTILIZATION": lambda: float(
         os.environ.get("VDT_HBM_UTILIZATION", "0.9")
     ),
-    # pipeline layer split override, analog of VLLM_PP_LAYER_PARTITION
-    # (docker-compose.yml:38)
-    "VDT_PP_LAYER_PARTITION": lambda: os.environ.get("VDT_PP_LAYER_PARTITION", ""),
     "VDT_HTTP_TIMEOUT_KEEP_ALIVE": lambda: int(
         os.environ.get("VDT_HTTP_TIMEOUT_KEEP_ALIVE", "5")
     ),

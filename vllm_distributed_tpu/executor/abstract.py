@@ -80,7 +80,10 @@ class Executor:
 
     @property
     def max_concurrent_batches(self) -> int:
-        return self.parallel_config.pipeline_parallel_size
+        """In-flight dispatch depth.  The reference ties this to pp
+        (launch.py:298-302); here fused-decode pipelining keeps two
+        dispatches in flight whenever multi-step decode is on."""
+        return 2 if self.scheduler_config.num_decode_steps > 1 else 1
 
     def execute_model(
         self, scheduler_output: SchedulerOutput, non_block: bool = False

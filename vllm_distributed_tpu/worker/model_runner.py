@@ -255,9 +255,18 @@ class ModelRunner:
 
         return write_kv_pages
 
+    def kv_cache_dtype(self):
+        """Pool dtype: cache_config.cache_dtype, "auto" = model dtype.
+        A narrower cache (e.g. bfloat16 under a float32 model) doubles
+        the KV capacity; kernels read/write the pool dtype directly."""
+        name = self.config.cache_config.cache_dtype
+        if name in (None, "auto"):
+            return self.model.dtype
+        return jnp.dtype(name)
+
     def kv_cache_bytes_per_page(self) -> int:
         m = self.model
-        dtype_size = jnp.dtype(m.dtype).itemsize
+        dtype_size = jnp.dtype(self.kv_cache_dtype()).itemsize
         return (
             m.num_layers
             * 2
@@ -345,8 +354,10 @@ class ModelRunner:
         if self.mesh is not None:
             sharding = NamedSharding(self.mesh, m.kv_cache_spec())
 
+        dtype = self.kv_cache_dtype()
+
         def alloc():
-            z = jnp.zeros(shape, m.dtype)
+            z = jnp.zeros(shape, dtype)
             return jax.device_put(z, sharding) if sharding is not None else z
 
         self.kv_caches = [(alloc(), alloc()) for _ in range(m.num_layers)]
