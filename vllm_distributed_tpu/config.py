@@ -313,6 +313,10 @@ class EngineArgs:
     enable_chunked_prefill: bool = True
     num_decode_steps: int = 8
 
+    # JSON dict (or dict) configuring a KV connector (disaggregated
+    # prefill hook, SURVEY.md §3.4); None = off.
+    kv_transfer_config: Any = None
+
     device: str = "auto"
     profile_dir: str | None = None
     disable_log_stats: bool = False
@@ -379,6 +383,14 @@ class EngineArgs:
         parser.add_argument("--device", type=str, default="auto")
         parser.add_argument("--profile-dir", type=str, default=None)
         parser.add_argument("--disable-log-stats", action="store_true")
+        parser.add_argument(
+            "--kv-transfer-config",
+            type=str,
+            default=None,
+            help="JSON KV-connector config (disaggregated prefill hook): "
+            "all workers reply per step and KV-transfer progress is "
+            "merged by KVOutputAggregator",
+        )
         return parser
 
     @classmethod
@@ -430,6 +442,9 @@ class EngineArgs:
             max_model_len=model_config.max_model_len,
             num_decode_steps=self.num_decode_steps,
         )
+        kv_transfer = self.kv_transfer_config
+        if isinstance(kv_transfer, str):
+            kv_transfer = json.loads(kv_transfer)
         return EngineConfig(
             model_config=model_config,
             cache_config=cache_config,
@@ -440,4 +455,5 @@ class EngineArgs:
                 collect_metrics=not self.disable_log_stats,
                 profile_dir=self.profile_dir,
             ),
+            kv_transfer_config=kv_transfer,
         )

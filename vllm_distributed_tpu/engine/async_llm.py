@@ -55,7 +55,7 @@ class AsyncLLM:
 
     # ---- the background loop ----
     def _drain_intake(self) -> None:
-        """Apply queued add/abort commands (engine thread only)."""
+        """Apply queued add/abort/aux commands (engine thread only)."""
         while True:
             try:
                 op, payload = self._intake.get_nowait()
@@ -70,8 +70,40 @@ class AsyncLLM:
                     # on the request's own stream, preserving the type so
                     # the API layer can map e.g. ValueError -> 400.
                     self._to_request_queue(request_id, e)
+            elif op == "aux":
+                # Auxiliary device work (embed/score) runs HERE so its
+                # collective dispatch is totally ordered with step
+                # dispatches — on a multihost mesh, racing callers would
+                # otherwise enqueue mismatched programs across hosts.
+                fn, args, fut = payload
+                try:
+                    result = fn(*args)
+                    err = None
+                except Exception as e:  # noqa: BLE001
+                    result, err = None, e
+                if self._loop is not None:
+                    self._loop.call_soon_threadsafe(
+                        self._resolve_aux, fut, result, err
+                    )
             else:  # "abort"
                 self.engine.abort_request(payload)
+
+    @staticmethod
+    def _resolve_aux(fut, result, err) -> None:
+        if fut.cancelled():
+            return
+        if err is not None:
+            fut.set_exception(err)
+        else:
+            fut.set_result(result)
+
+    async def _run_aux(self, fn, *args):
+        loop = asyncio.get_running_loop()
+        self._loop = loop
+        fut = loop.create_future()
+        self._intake.put(("aux", (fn, args, fut)))
+        self._wake.set()
+        return await fut
 
     def _to_request_queue(self, request_id: str, item) -> None:
         if self._loop is None:
@@ -172,6 +204,14 @@ class AsyncLLM:
         self._intake.put(("abort", request_id))
         self._wake.set()
         self._queues.pop(request_id, None)
+
+    async def embed(self, prompt_token_ids: list[int]) -> list[float]:
+        """Runs on the engine thread between steps (_drain_intake), so
+        the aux collective is ordered with step dispatches mesh-wide."""
+        return await self._run_aux(self.engine.embed, prompt_token_ids)
+
+    async def score(self, prompt_token_ids: list[int]) -> list:
+        return await self._run_aux(self.engine.score, prompt_token_ids)
 
     # Introspection for the API layer.
     @property

@@ -302,6 +302,22 @@ class LLMEngine:
             metrics=req.metrics,
         )
 
+    def embed(self, prompt_token_ids: list[int]) -> list[float]:
+        """Pooled embedding of a prompt (/v1/embeddings)."""
+        return self.executor.collective_rpc(
+            "embed",
+            (prompt_token_ids,),
+            unique_reply_rank=self.executor.output_rank,
+        )
+
+    def score(self, prompt_token_ids: list[int]) -> list[float | None]:
+        """Prompt logprobs (completions echo+logprobs)."""
+        return self.executor.collective_rpc(
+            "score",
+            (prompt_token_ids,),
+            unique_reply_rank=self.executor.output_rank,
+        )
+
     def shutdown(self) -> None:
         self.executor.shutdown()
 

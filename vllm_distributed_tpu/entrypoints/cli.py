@@ -40,6 +40,12 @@ def _add_server_args(parser: argparse.ArgumentParser) -> None:
         "--enable-auto-tool-choice", action="store_true", default=False
     )
     parser.add_argument("--disable-log-requests", action="store_true")
+    parser.add_argument(
+        "--api-key",
+        type=str,
+        default=None,
+        help="require 'Authorization: Bearer <key>' on API endpoints",
+    )
 
 
 def make_parser() -> argparse.ArgumentParser:
@@ -125,6 +131,7 @@ async def _serve_async(args: argparse.Namespace) -> None:
         tool_call_parser=args.tool_call_parser,
         enable_auto_tool_choice=args.enable_auto_tool_choice,
         chat_template=chat_template,
+        api_key=args.api_key,
     )
     app = build_app(state)
     runner = await serve_http(

@@ -22,6 +22,9 @@ from aiohttp import web
 from vllm_distributed_tpu import envs
 from vllm_distributed_tpu.engine.async_llm import AsyncLLM, EngineDeadError
 from vllm_distributed_tpu.entrypoints.openai.protocol import (
+    EmbeddingData,
+    EmbeddingRequest,
+    EmbeddingResponse,
     ChatChoice,
     ChatCompletionRequest,
     ChatCompletionResponse,
@@ -62,8 +65,24 @@ class ServerState:
     tool_call_parser: str | None = None
     enable_auto_tool_choice: bool = False
     chat_template: str | None = None
+    api_key: str | None = None
     request_counter: Counter = field(default_factory=Counter)
     metrics: Any = None
+
+
+# Endpoints that stay open without an API key (probes + scrapers), the
+# same split vLLM's build_app auth middleware makes.
+_UNAUTHENTICATED = {"/health", "/ping", "/version", "/metrics"}
+
+
+@web.middleware
+async def auth_middleware(request: web.Request, handler):
+    state: ServerState = request.app["state"]
+    if state.api_key and request.path not in _UNAUTHENTICATED:
+        header = request.headers.get("Authorization", "")
+        if header != f"Bearer {state.api_key}":
+            return _error("invalid or missing API key", 401)
+    return await handler(request)
 
 
 # ---- helpers ----
@@ -408,19 +427,42 @@ async def completions(request: web.Request) -> web.Response:
 
     choices = []
     usage = UsageInfo()
+    score_cache: dict[tuple, list] = {}  # n choices share one prompt
     for idx, out in enumerate(outs):
         comp = out.outputs[0]
         text = comp.text
+        lp_dict = _logprobs_dict(out, chat=False)
         if req.echo:
             prefix = out.prompt or (
                 tokenizer.decode(out.prompt_token_ids) if tokenizer else ""
             )
             text = prefix + text
+            if lp_dict is not None:
+                # Echoed prompts report prompt logprobs too (vLLM's
+                # prompt_logprobs surface): a teacher-forced scoring
+                # pass off the hot path (model_runner.score).
+                key = tuple(out.prompt_token_ids)
+                try:
+                    if key not in score_cache:
+                        score_cache[key] = await state.engine.score(
+                            out.prompt_token_ids
+                        )
+                    prompt_lps = score_cache[key]
+                except EngineDeadError as e:
+                    return _error(str(e), 500)
+                lp_dict = {
+                    "tokens": [str(t) for t in out.prompt_token_ids]
+                    + lp_dict["tokens"],
+                    "token_logprobs": prompt_lps
+                    + lp_dict["token_logprobs"],
+                    "top_logprobs": [None] * len(out.prompt_token_ids)
+                    + lp_dict["top_logprobs"],
+                }
         choices.append(
             CompletionChoice(
                 index=idx,
                 text=text,
-                logprobs=_logprobs_dict(out, chat=False),
+                logprobs=lp_dict,
                 finish_reason=comp.finish_reason,
             )
         )
@@ -502,9 +544,80 @@ async def metrics(request: web.Request) -> web.Response:
     )
 
 
+async def embeddings(request: web.Request) -> web.Response:
+    """Pooled (mean, L2-normalized) final-hidden-state embeddings — the
+    causal-LM pooling path the reference inherits via vLLM's app
+    (launch.py:429; SURVEY.md §2.3 build_app row)."""
+    state: ServerState = request.app["state"]
+    try:
+        req = EmbeddingRequest(**await request.json())
+    except Exception as e:  # noqa: BLE001
+        return _error(f"invalid request: {e}")
+    if req.encoding_format not in ("float", "base64"):
+        return _error(
+            f"unsupported encoding_format {req.encoding_format!r}"
+        )
+    tokenizer = state.engine.tokenizer
+
+    try:
+        p = req.input
+        if isinstance(p, str):
+            p = [p]
+        if isinstance(p, list) and p and isinstance(p[0], int):
+            items = [[int(t) for t in p]]  # single token list
+        elif isinstance(p, list) and p and isinstance(p[0], str):
+            if tokenizer is None:
+                return _error("tokenizer unavailable for text input")
+            items = [tokenizer.encode(str(s)) for s in p]
+        elif isinstance(p, list) and p and isinstance(p[0], list):
+            items = [[int(t) for t in ids] for ids in p]
+        else:
+            return _error("invalid input")
+    except (TypeError, ValueError) as e:
+        return _error(f"invalid input: {e}")
+    if any(not ids for ids in items):
+        return _error("input must contain at least one token")
+    longest = max(len(ids) for ids in items)
+    # `>` not `>=`: embeddings generate nothing, so no headroom needed.
+    if longest > state.max_model_len:
+        return _error(
+            f"input has {longest} tokens, exceeding max_model_len "
+            f"{state.max_model_len}"
+        )
+    try:
+        vectors = await asyncio.gather(
+            *(state.engine.embed(ids) for ids in items)
+        )
+    except EngineDeadError as e:
+        return _error(str(e), 500)
+    if req.encoding_format == "base64":
+        import base64
+        import struct
+
+        vectors = [
+            base64.b64encode(
+                struct.pack(f"<{len(v)}f", *v)
+            ).decode("ascii")
+            for v in vectors
+        ]
+    usage = UsageInfo(prompt_tokens=sum(len(i) for i in items))
+    usage.total_tokens = usage.prompt_tokens
+    resp = EmbeddingResponse(
+        model=state.model_name,
+        data=[
+            EmbeddingData(index=i, embedding=v)
+            for i, v in enumerate(vectors)
+        ],
+        usage=usage,
+    )
+    return web.json_response(resp.model_dump())
+
+
 # ---- app assembly ----
 def build_app(state: ServerState) -> web.Application:
-    app = web.Application(client_max_size=64 * 2**20)
+    app = web.Application(
+        client_max_size=64 * 2**20, middlewares=[auth_middleware]
+    )
     app["state"] = state
     app.router.add_get("/health", health)
     app.router.add_get("/ping", health)
@@ -514,6 +627,7 @@ def build_app(state: ServerState) -> web.Application:
     app.router.add_post("/detokenize", detokenize)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
+    app.router.add_post("/v1/embeddings", embeddings)
     app.router.add_get("/metrics", metrics)
     return app
 
@@ -525,6 +639,7 @@ def init_app_state(
     tool_call_parser: str | None = None,
     enable_auto_tool_choice: bool = False,
     chat_template: str | None = None,
+    api_key: str | None = None,
 ) -> ServerState:
     model_config = engine.get_model_config()
     return ServerState(
@@ -534,6 +649,7 @@ def init_app_state(
         tool_call_parser=tool_call_parser,
         enable_auto_tool_choice=enable_auto_tool_choice,
         chat_template=chat_template,
+        api_key=api_key,
     )
 
 

@@ -214,3 +214,25 @@ class DetokenizeRequest(BaseModel):
 
 class DetokenizeResponse(BaseModel):
     prompt: str
+
+
+class EmbeddingRequest(BaseModel):
+    model: str = ""
+    input: str | list[str] | list[int] | list[list[int]] = ""
+    encoding_format: str = "float"
+    user: str | None = None
+
+
+class EmbeddingData(BaseModel):
+    object: str = "embedding"
+    index: int = 0
+    # list[float], or a base64 string when encoding_format="base64"
+    # (the openai-python client's default).
+    embedding: list[float] | str
+
+
+class EmbeddingResponse(BaseModel):
+    object: str = "list"
+    model: str
+    data: list[EmbeddingData]
+    usage: UsageInfo = UsageInfo()

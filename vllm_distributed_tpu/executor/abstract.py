@@ -85,9 +85,43 @@ class Executor:
         dispatches in flight whenever multi-step decode is on."""
         return 2 if self.scheduler_config.num_decode_steps > 1 else 1
 
+    @property
+    def num_reply_workers(self) -> int:
+        """How many worker replies one collective_rpc returns (worker
+        PROCESSES, not chips: 1 for uniproc, num_hosts for multihost)."""
+        return 1
+
+    @property
+    def kv_output_aggregator(self):
+        """Lazy KVOutputAggregator, built iff kv_transfer_config is set
+        (the reference's gate, launch.py:295-296)."""
+        agg = getattr(self, "_kv_aggregator", None)
+        if agg is None:
+            from vllm_distributed_tpu.executor.kv_aggregator import (
+                KVOutputAggregator,
+            )
+
+            agg = KVOutputAggregator(self.num_reply_workers)
+            self._kv_aggregator = agg
+        return agg
+
     def execute_model(
         self, scheduler_output: SchedulerOutput, non_block: bool = False
     ) -> ModelRunnerOutput | concurrent.futures.Future:
+        if self.config.kv_transfer_config is not None:
+            # KV-connector path: fan out to ALL workers and merge
+            # (launch.py:338-349).  Resolved inline — KV-transfer steps
+            # are not decode-scan-pipelined.  The reply list is ordered
+            # [driver, *others], so the canonical output is index 0.
+            outputs = self.collective_rpc(
+                "execute_model", (scheduler_output,)
+            )
+            result = self.kv_output_aggregator.aggregate(outputs, 0)
+            if non_block:
+                fut: concurrent.futures.Future = concurrent.futures.Future()
+                fut.set_result(result)
+                return fut
+            return result
         return self.collective_rpc(
             "execute_model",
             (scheduler_output,),

@@ -1,0 +1,59 @@
+"""KV-output aggregation — the disaggregated-prefill hook.
+
+The reference gates this on ``vllm_config.kv_transfer_config``: with a
+KV connector configured, execute_model fans out to ALL workers and the
+per-worker outputs are merged by vLLM's KVOutputAggregator
+(launch.py:295-296, 338-349; SURVEY.md §3.4).  The wrapper only routes
+outputs — the transfer itself lives behind the connector interface —
+and this rebuild matches that scope: sampled tokens come from the
+designated output rank, while per-worker KV-transfer progress
+(request ids whose KV finished sending/receiving on that worker) is
+merged across the whole world, because a request's KV movement is only
+complete when EVERY shard-holder is done.
+"""
+
+from __future__ import annotations
+
+from vllm_distributed_tpu.outputs import ModelRunnerOutput
+
+
+class KVOutputAggregator:
+    def __init__(self, world_size: int) -> None:
+        self.world_size = world_size
+        # req_id -> number of workers still to report completion.
+        self._send_remaining: dict[str, int] = {}
+        self._recv_remaining: dict[str, int] = {}
+
+    def aggregate(
+        self, outputs: list[ModelRunnerOutput | None], output_rank: int
+    ) -> ModelRunnerOutput:
+        """Merge one step's per-worker outputs: model results from
+        `output_rank`, KV-transfer progress from everyone (a request is
+        done moving KV only when all world_size workers reported it)."""
+        base = outputs[output_rank]
+        if base is None:
+            raise ValueError(
+                f"output rank {output_rank} returned no output"
+            )
+        finished_sending: set[str] = set()
+        finished_recving: set[str] = set()
+        for out in outputs:
+            if out is None:
+                continue
+            for req_id in out.kv_finished_sending:
+                left = self._send_remaining.get(req_id, self.world_size) - 1
+                if left:
+                    self._send_remaining[req_id] = left
+                else:
+                    self._send_remaining.pop(req_id, None)
+                    finished_sending.add(req_id)
+            for req_id in out.kv_finished_recving:
+                left = self._recv_remaining.get(req_id, self.world_size) - 1
+                if left:
+                    self._recv_remaining[req_id] = left
+                else:
+                    self._recv_remaining.pop(req_id, None)
+                    finished_recving.add(req_id)
+        base.kv_finished_sending = finished_sending
+        base.kv_finished_recving = finished_recving
+        return base

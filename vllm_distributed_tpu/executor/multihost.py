@@ -295,8 +295,8 @@ class MultiHostExecutor(Executor):
         agent routes the two verbs to separate single-thread pools, so
         dispatches stay ordered, fetches stay ordered, and fetch N never
         blocks dispatch N+1."""
-        if not non_block:
-            return super().execute_model(scheduler_output)
+        if not non_block or self.config.kv_transfer_config is not None:
+            return super().execute_model(scheduler_output, non_block=False)
         if self.is_failed:
             raise RuntimeError("Executor failed.")
         step_id = scheduler_output.step_id
@@ -362,6 +362,10 @@ class MultiHostExecutor(Executor):
     @property
     def output_rank(self) -> int:
         return 0  # SPMD: host 0's copy of the output is canonical.
+
+    @property
+    def num_reply_workers(self) -> int:
+        return self.num_hosts
 
     def _notify_failure(self) -> None:
         # Errors during an intentional shutdown are teardown noise, not

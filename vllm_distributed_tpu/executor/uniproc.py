@@ -29,6 +29,8 @@ class UniProcExecutor(Executor):
         self.collective_rpc("load_model")
 
     def execute_model(self, scheduler_output, non_block: bool = False):
+        if self.config.kv_transfer_config is not None:
+            return super().execute_model(scheduler_output, non_block)
         out = self.worker.execute_model(scheduler_output, defer=True)
         if callable(out):
             if non_block:

@@ -209,8 +209,11 @@ class LlamaForCausalLM(SupportsQuantization):
         meta: AttentionMetadata,
         attn_fn: Callable = paged_attention_reference,
         kv_write_fn: Callable = write_kv_pages,
-    ) -> tuple[jax.Array, list]:
-        """Returns (logits [S, V] at meta.logits_indices, updated kv)."""
+        return_hidden: bool = False,
+    ) -> tuple:
+        """Returns (logits [S, V] at meta.logits_indices, updated kv);
+        with return_hidden also the final-norm hidden states [S, H]
+        (embeddings / scoring, /v1/embeddings parity)."""
         x = params["embed"][token_ids].astype(self.dtype)
         inv_freq = rope_frequencies(
             self.head_dim, self.rope_theta, rope_scaling=self.rope_scaling
@@ -249,4 +252,7 @@ class LlamaForCausalLM(SupportsQuantization):
             from vllm_distributed_tpu.ops.quant import maybe_dequantize
 
             logits = sel @ maybe_dequantize(lm_head, sel.dtype)
-        return logits.astype(jnp.float32), new_kv
+        logits = logits.astype(jnp.float32)
+        if return_hidden:
+            return logits, new_kv, sel.astype(jnp.float32)
+        return logits, new_kv

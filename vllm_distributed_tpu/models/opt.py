@@ -183,7 +183,8 @@ class OPTForCausalLM(SupportsQuantization):
         meta: AttentionMetadata,
         attn_fn: Callable = paged_attention_reference,
         kv_write_fn: Callable = write_kv_pages,
-    ) -> tuple[jax.Array, list]:
+        return_hidden: bool = False,
+    ) -> tuple:
         t = token_ids.shape[0]
         x = params["embed"][token_ids].astype(self.dtype)
         if "project_in" in params:
@@ -241,5 +242,9 @@ class OPTForCausalLM(SupportsQuantization):
         if "project_out" in params:
             x = linear(x, params["project_out"])
         sel = x[meta.logits_indices]
-        logits = sel @ params["embed"].T.astype(sel.dtype)
-        return logits.astype(jnp.float32), new_kv
+        logits = (sel @ params["embed"].T.astype(sel.dtype)).astype(
+            jnp.float32
+        )
+        if return_hidden:
+            return logits, new_kv, sel.astype(jnp.float32)
+        return logits, new_kv

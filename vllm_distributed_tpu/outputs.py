@@ -25,6 +25,11 @@ class ModelRunnerOutput:
     logprobs: dict[str, list[dict[int, float]]] = field(default_factory=dict)
     # req_id -> number of prompt tokens processed this step (chunked prefill).
     num_prompt_tokens_processed: dict[str, int] = field(default_factory=dict)
+    # KV-connector progress (disaggregated prefill, SURVEY.md §3.4):
+    # requests whose KV finished moving on THIS worker this step; the
+    # executor-side KVOutputAggregator intersects across the world.
+    kv_finished_sending: set[str] = field(default_factory=set)
+    kv_finished_recving: set[str] = field(default_factory=set)
 
 
 @dataclass

@@ -343,24 +343,105 @@ class ModelRunner:
         )
         return int(num_pages)
 
-    def init_kv_cache(self, num_pages: int) -> None:
+    def alloc_kv_pool(self, num_pages: int) -> list:
+        """Allocate a paged KV pool: slot-major [P, page, Hkv, D] per
+        layer (see ops/attention.py layout), head dim lane-padded to 128
+        for DMA-aligned Pallas page copies, sharded per the model's
+        kv_cache_spec.  Used for the serving cache and for aux-forward
+        scratch pools — one definition of the layout."""
         m = self.model
-        self.num_pages = num_pages
-        # Slot-major pool: [P, page, Hkv, D] (see ops/attention.py layout);
-        # head dim lane-padded to 128 for DMA-aligned Pallas page copies.
         d_pad = round_up(m.head_dim, 128)
         shape = (num_pages, self.page_size, m.num_kv_heads, d_pad)
         sharding = None
         if self.mesh is not None:
             sharding = NamedSharding(self.mesh, m.kv_cache_spec())
-
         dtype = self.kv_cache_dtype()
 
         def alloc():
             z = jnp.zeros(shape, dtype)
             return jax.device_put(z, sharding) if sharding is not None else z
 
-        self.kv_caches = [(alloc(), alloc()) for _ in range(m.num_layers)]
+        return [(alloc(), alloc()) for _ in range(m.num_layers)]
+
+    def init_kv_cache(self, num_pages: int) -> None:
+        self.num_pages = num_pages
+        self.kv_caches = self.alloc_kv_pool(num_pages)
+
+    # ---- auxiliary (non-scheduled) forwards: embeddings & scoring ----
+    @partial(jax.jit, static_argnames=("self",))
+    def _jit_aux_forward(self, params, kv, tokens, meta):
+        from vllm_distributed_tpu.ops.attention import (
+            paged_attention_reference,
+            write_kv_pages,
+        )
+
+        return self.model.forward(
+            params,
+            tokens,
+            kv,
+            meta,
+            attn_fn=paged_attention_reference,
+            kv_write_fn=write_kv_pages,
+            return_hidden=True,
+        )
+
+    def _aux_forward(self, token_ids: list[int]):
+        """One-off teacher-forced forward over a scratch KV pool with
+        logits/hidden at EVERY position (the scheduled path only emits
+        last-position logits).  Off the hot path by design: serves
+        /v1/embeddings and prompt-logprobs scoring."""
+        from vllm_distributed_tpu.ops.attention import AttentionMetadata
+
+        t = len(token_ids)
+        t_pad = max(next_power_of_2(t), _MIN_TOKEN_BUCKET)
+        pages = cdiv(t_pad, self.page_size) + 1  # +1: reserved dump page
+        kv = self.alloc_kv_pool(pages)
+        tokens = np.zeros(t_pad, np.int32)
+        tokens[:t] = token_ids
+        positions = np.zeros(t_pad, np.int32)
+        positions[:t] = np.arange(t)
+        seq_ids = np.full(t_pad, 1, np.int32)  # padding -> dropped row
+        seq_ids[:t] = 0
+        slots = np.full(t_pad, 0, np.int32)  # padding -> dump page 0
+        slots[:t] = self.page_size + np.arange(t)  # data pages from 1
+        meta = AttentionMetadata(
+            q_seq_ids=jnp.asarray(seq_ids),
+            q_positions=jnp.asarray(positions),
+            slot_mapping=jnp.asarray(slots),
+            block_tables=jnp.asarray(
+                np.arange(1, pages + 1, dtype=np.int32)[None, :] % pages
+            ),
+            seq_lens=jnp.asarray([t], jnp.int32),
+            logits_indices=jnp.arange(t_pad, dtype=jnp.int32),
+            chunk_starts=jnp.zeros(1, jnp.int32),
+        )
+        args = (jnp.asarray(tokens), meta)
+        if self.mesh is not None:
+            args = jax.device_put(args, NamedSharding(self.mesh, P()))
+        logits, _, hidden = self._jit_aux_forward(
+            self.params, kv, args[0], args[1]
+        )
+        return np.asarray(logits)[:t], np.asarray(hidden)[:t]
+
+    def embed(self, token_ids: list[int]) -> list[float]:
+        """Mean-pooled, L2-normalized final hidden states (the pooling
+        vLLM's embedding path applies to causal LMs)."""
+        _, hidden = self._aux_forward(token_ids)
+        vec = hidden.mean(axis=0)
+        norm = float(np.linalg.norm(vec))
+        return (vec / norm if norm > 0 else vec).astype(float).tolist()
+
+    def score(self, token_ids: list[int]) -> list[float | None]:
+        """Prompt logprobs: log p(token_i | tokens_<i); index 0 is None
+        (no context).  Serves completions echo+logprobs."""
+        logits, _ = self._aux_forward(token_ids)
+        # Stable log_softmax: shift by max.
+        shifted = logits - logits.max(-1, keepdims=True)
+        logps = shifted - np.log(np.exp(shifted).sum(-1, keepdims=True))
+        out: list[float | None] = [None]
+        for i in range(1, len(token_ids)):
+            out.append(float(logps[i - 1, token_ids[i]]))
+        return out
 
     def _pages_bucket(self, need: int) -> int:
         """Static pages-per-seq bucket.  For small max_model_len the bucket
