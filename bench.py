@@ -1,16 +1,24 @@
 """Throughput bench — prints ONE JSON line for the driver.
 
-Measures steady-state decode throughput (tokens/sec/chip) of the engine on
-a Llama-1B-shaped model with dummy bf16 weights on whatever backend is
-live (the real TPU chip under the driver).  The reference publishes no
-numbers (BASELINE.md: "published": {}), so vs_baseline is reported as 1.0
-by convention; the `detail` block carries the honest engineering numbers:
-per-dispatch latency percentiles, HBM-roofline fraction for the decode
-micro-step, TTFT, and a Pallas-vs-reference kernel check run on the live
-backend before any timing.
+Measures steady-state decode throughput (tokens/sec/chip) of the engine
+on Llama-shaped models with dummy weights on whatever backend is live
+(the real TPU chip under the driver).  The reference publishes no
+numbers (BASELINE.md: "published": {}), so vs_baseline is reported as
+1.0 by convention; the `detail` block carries the honest engineering
+numbers per config: dispatch percentiles, inter-token latency, a
+roofline that counts BOTH weight and KV-cache traffic, warm/cold TTFT,
+and on-chip kernel checks (Pallas attention, in-place KV writer, int8
+weight-streaming matmul) run before any timing.
 
-Env knobs: VDT_BENCH_MODEL=1b|7b|tiny, VDT_BENCH_BATCH, VDT_BENCH_STEPS
-(decode steps fused per dispatch), VDT_BENCH_DISPATCHES (timed window).
+Default configs: Llama-1B bf16 @ batch 32 (the r1/r2 continuity
+config), Llama-1B int8 @ batch 64 (best single-chip throughput), and
+Llama-7B int8 @ batch 32 (the BASELINE.md-tracked shape; int8 is how
+7B fits one v5e chip).  The headline value is the best decode tok/s per
+chip across configs.
+
+Env knobs: VDT_BENCH_MODEL=1b|7b|tiny + VDT_BENCH_BATCH/VDT_BENCH_STEPS/
+VDT_BENCH_QUANT run one explicit config instead; VDT_BENCH_DISPATCHES
+sizes the timed window; VDT_BENCH_FAST=1 skips the 7B config.
 """
 
 from __future__ import annotations
@@ -22,10 +30,10 @@ import sys
 import time
 
 
-def _check_pallas_kernel() -> str:
-    """Compare the Pallas kernel against the pure-JAX oracle on the live
-    backend (VERDICT r1 weak #4: the kernel had only ever been
-    correctness-tested in interpreter mode on CPU)."""
+def _check_kernels() -> str:
+    """Compare the Pallas kernels against pure-JAX oracles on the live
+    backend (VERDICT r1 weak #4: interpret-only testing is not enough —
+    aliasing/DMA behavior is exactly where real Mosaic can diverge)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -36,6 +44,7 @@ def _check_pallas_kernel() -> str:
     from vllm_distributed_tpu.ops.attention import (
         AttentionMetadata,
         paged_attention_reference,
+        write_kv_pages,
     )
     from vllm_distributed_tpu.ops.pallas.paged_attention import paged_attention
 
@@ -79,10 +88,7 @@ def _check_pallas_kernel() -> str:
     if err > 2e-2:
         raise AssertionError(f"pallas kernel mismatch on chip: max err {err}")
 
-    # In-place KV writer vs the functional scatter, on the live chip
-    # (ADVICE r2: interpret mode can diverge from real Mosaic exactly
-    # where input_output_aliases/DMA semantics are involved).
-    from vllm_distributed_tpu.ops.attention import write_kv_pages
+    # In-place KV writer vs the functional scatter, on the live chip.
     from vllm_distributed_tpu.ops.pallas.kv_update import kv_update
 
     kq = jnp.asarray(rng.normal(size=(t, hkv, d)), jnp.float32)
@@ -97,66 +103,74 @@ def _check_pallas_kernel() -> str:
     )
     if kv_err > 0:
         raise AssertionError(f"kv_update mismatch on chip: max err {kv_err}")
-    return f"pass (attn max err {err:.1e}; kv_update exact)"
+
+    # int8 weight-streaming matmul vs dequant-in-graph.
+    from vllm_distributed_tpu.ops.pallas.quant_matmul import int8_matmul
+    from vllm_distributed_tpu.ops.quant import dequantize, quantize
+
+    x = jnp.asarray(rng.normal(size=(32, 1024)) * 0.5, jnp.float32)
+    w = (rng.normal(size=(1024, 512)) * 0.1).astype(np.float32)
+    qt = quantize(w, 8)
+    mm_want = np.asarray(x @ dequantize(qt, jnp.float32))
+    mm_got = np.asarray(
+        int8_matmul(x, jnp.asarray(qt.q), jnp.asarray(qt.scale))
+    )
+    mm_err = float(
+        np.max(np.abs(mm_got - mm_want)) / (np.abs(mm_want).max() + 1e-9)
+    )
+    if mm_err > 2e-2:
+        raise AssertionError(f"int8_matmul mismatch on chip: {mm_err}")
+    return (
+        f"pass (attn {err:.1e}; kv_update exact; int8_matmul {mm_err:.1e})"
+    )
 
 
-def main() -> None:
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
-        # The env var alone can lose to an interpreter-startup jax import
-        # (sitecustomize); the config update before first backend use wins.
-        import jax
+def _hbm_bw() -> tuple[str, float]:
+    import jax
 
-        jax.config.update("jax_platforms", "cpu")
+    table = (
+        ("TPU v6", 1640e9),
+        ("TPU v5p", 2765e9),
+        ("TPU v5", 819e9),  # v5e / v5 lite
+        ("TPU v4", 1228e9),
+    )
+    kind = jax.devices()[0].device_kind
+    return kind, next(
+        (bw for p, bw in table if kind.startswith(p)), 819e9
+    )
+
+
+def _run_config(shapes, *, batch, k_steps, quant, timed_dispatches,
+                warm_engine_probe=False):
+    """One engine, one decode measurement.  Returns a detail dict."""
     import jax
 
     from vllm_distributed_tpu.config import EngineArgs
     from vllm_distributed_tpu.engine.llm_engine import LLMEngine
     from vllm_distributed_tpu.sampling_params import SamplingParams
-    from vllm_distributed_tpu.testing import (
-        LLAMA_1B,
-        LLAMA_7B,
-        write_llama_config,
-    )
+    from vllm_distributed_tpu.testing import write_llama_config
 
-    which = os.environ.get("VDT_BENCH_MODEL", "1b")
-    shapes = {"1b": LLAMA_1B, "7b": LLAMA_7B}.get(which)
-    if shapes is None:
-        shapes = dict(
-            vocab_size=1024, hidden=256, intermediate=512, layers=4,
-            heads=8, kv_heads=4, dtype="float32",
-        )
-    if jax.default_backend() == "cpu" and which in ("1b", "7b"):
-        # CPU smoke fallback: the big shapes would take minutes to compile.
-        shapes = dict(
-            vocab_size=1024, hidden=256, intermediate=512, layers=4,
-            heads=8, kv_heads=4, dtype="float32",
-        )
-    batch = int(os.environ.get("VDT_BENCH_BATCH", "32"))
-    k_steps = int(os.environ.get("VDT_BENCH_STEPS", "16"))
-    timed_dispatches = int(os.environ.get("VDT_BENCH_DISPATCHES", "6"))
     warmup_dispatches = 2
     prompt_len = 32
-    # 1 token sampled at prefill + a whole number of fused-K dispatches.
     max_tokens = 1 + k_steps * (warmup_dispatches + timed_dispatches)
-
-    kernel_check = _check_pallas_kernel()
-
     model_dir = write_llama_config(**shapes)
-    engine = LLMEngine.from_engine_args(
-        EngineArgs(
-            model=model_dir,
-            skip_tokenizer_init=True,
-            load_format="dummy",
-            max_num_seqs=batch,
-            max_num_batched_tokens=max(2048, batch * prompt_len),
-            max_model_len=prompt_len + max_tokens + 8,
-            num_decode_steps=k_steps,
+
+    def build():
+        return LLMEngine.from_engine_args(
+            EngineArgs(
+                model=model_dir,
+                skip_tokenizer_init=True,
+                load_format="dummy",
+                max_num_seqs=batch,
+                max_num_batched_tokens=max(2048, batch * prompt_len),
+                max_model_len=prompt_len + max_tokens + 8,
+                num_decode_steps=k_steps,
+                quantization=quant,
+            )
         )
-    )
-    sp = SamplingParams(
-        temperature=0.0, max_tokens=max_tokens, ignore_eos=True
-    )
+
+    engine = build()
+    sp = SamplingParams(temperature=0.0, max_tokens=max_tokens, ignore_eos=True)
     for i in range(batch):
         prompt = [(7 * i + j) % 1000 + 1 for j in range(prompt_len)]
         engine.add_request(f"b{i}", prompt_token_ids=prompt, sampling_params=sp)
@@ -169,12 +183,9 @@ def main() -> None:
             produced[out.request_id] = len(out.outputs[0].token_ids)
         return sum(produced.values()) - before
 
-    # Prefill (compiles the prefill program) — time it for TTFT.
     t0 = time.perf_counter()
-    run_step()
+    run_step()  # prefill (compiles the prefill program)
     ttft_cold_s = time.perf_counter() - t0
-
-    # Warmup decode dispatches (compiles the fused-K scan).
     for _ in range(warmup_dispatches):
         run_step()
 
@@ -186,58 +197,172 @@ def main() -> None:
         timed_tokens += run_step()
         step_ms.append((time.perf_counter() - t1) * 1e3)
     elapsed = time.perf_counter() - t0
-
     tps = timed_tokens / elapsed
-    n_chips = jax.local_device_count()
 
-    # HBM roofline for one decode micro-step: every parameter byte must be
-    # read once per token batch (weights dominate; KV traffic at this
-    # context length is <1%).  Bandwidth picked by device kind; the
-    # params attribute chain is uniproc-only, so guard it (under the
-    # multihost executor the roofline block is skipped, not crashed).
-    hbm_bw_by_kind = (
-        ("TPU v6", 1640e9),
-        ("TPU v5p", 2765e9),
-        ("TPU v5", 819e9),  # v5e / v5 lite
-        ("TPU v4", 1228e9),
-    )
-    device_kind = jax.devices()[0].device_kind
-    hbm_bw = next(
-        (bw for prefix, bw in hbm_bw_by_kind if device_kind.startswith(prefix)),
-        819e9,
-    )
+    # Roofline for one decode micro-step: weight bytes as RESIDENT
+    # (quantized weights stream their compressed bytes) plus the KV
+    # history the attention actually reads (bucketed pages per seq).
     runner = getattr(
         getattr(getattr(engine, "executor", None), "worker", None),
         "runner",
         None,
     )
-    params = getattr(runner, "params", None)
-    param_bytes = (
-        sum(x.nbytes for x in jax.tree.leaves(params)) if params else 0
-    )
-    floor_ms = param_bytes / hbm_bw * 1e3
+    param_bytes = 0
+    kv_read_bytes = 0
+    if runner is not None:
+        param_bytes = sum(
+            x.nbytes for x in jax.tree.leaves(runner.params)
+        )
+        mean_ctx = prompt_len + max_tokens // 2
+        pages_pad = runner._pages_bucket(
+            -(-mean_ctx // runner.page_size)
+        )
+        m = runner.model
+        d_pad = -(-m.head_dim // 128) * 128  # lane-padded head dim
+        kv_read_bytes = (
+            batch
+            * pages_pad
+            * runner.page_size
+            * m.num_kv_heads
+            * d_pad
+            * 2  # K and V
+            * jax.numpy.dtype(runner.kv_cache_dtype()).itemsize
+            * m.num_layers
+        )
+    kind, bw = _hbm_bw()
+    floor_ms = (param_bytes + kv_read_bytes) / bw * 1e3
     micro_ms = 1e3 / (tps / batch) if tps else float("inf")
+    itl = sorted(ms / k_steps for ms in step_ms)
+
+    def pct(p):
+        return round(itl[min(int(len(itl) * p), len(itl) - 1)], 3)
+
+    detail = {
+        "batch": batch,
+        "decode_steps_fused": k_steps,
+        "quantization": quant,
+        "timed_tokens": timed_tokens,
+        "elapsed_s": round(elapsed, 3),
+        "tokens_per_sec": round(tps, 1),
+        "dispatch_ms_p50": round(statistics.median(step_ms), 2),
+        "dispatch_ms_max": round(max(step_ms), 2),
+        "decode_microstep_ms": round(micro_ms, 3),
+        "itl_ms_p50": pct(0.5),
+        "itl_ms_p90": pct(0.9),
+        "itl_ms_p99": pct(0.99),
+        "roofline_microstep_ms": round(floor_ms, 3),
+        "roofline_frac": round(min(floor_ms / micro_ms, 1.0), 3),
+        "ttft_cold_s": round(ttft_cold_s, 2),
+        "param_bytes": param_bytes,
+        "kv_read_bytes_per_microstep": kv_read_bytes,
+    }
+    def free_engine(eng):
+        """Release HBM: the jit cache keys on the runner (static self),
+        pinning params/KV beyond the engine's lifetime — delete the
+        device buffers explicitly."""
+        eng.shutdown()
+        r = getattr(getattr(eng, "executor", None), "worker", None)
+        r = getattr(r, "runner", None)
+        if r is not None:
+            for leaf in jax.tree.leaves((r.params, r.kv_caches)):
+                leaf.delete()
+            carry = getattr(r, "_decode_carry", None)
+            if carry is not None:
+                carry[2].delete()
+            r.params, r.kv_caches, r._decode_carry = None, None, None
+
+    if warm_engine_probe:
+        # Warm TTFT: a fresh engine on the same shapes hits the jit
+        # cache — the restart-to-first-token story (§5.4).
+        free_engine(engine)
+        engine2 = build()
+        engine2.add_request(
+            "warm",
+            prompt_token_ids=[3] * prompt_len,
+            sampling_params=SamplingParams(
+                temperature=0.0, max_tokens=2, ignore_eos=True
+            ),
+        )
+        t0 = time.perf_counter()
+        engine2.step()
+        detail["ttft_warm_s"] = round(time.perf_counter() - t0, 2)
+        free_engine(engine2)
+    else:
+        free_engine(engine)
+    return detail
+
+
+def main() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    # Persistent XLA compile cache: makes the warm-TTFT probe measure
+    # the restart story (§5.4) rather than a full recompile (the
+    # in-memory jit cache can't help — it keys on the runner instance).
+    os.environ.setdefault("VDT_COMPILE_CACHE_DIR", "/tmp/vdt_bench_xla_cache")
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        # The env var alone can lose to an interpreter-startup jax import
+        # (sitecustomize); the config update before first backend use wins.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from vllm_distributed_tpu.testing import LLAMA_1B, LLAMA_7B
+
+    tiny = dict(
+        vocab_size=1024, hidden=256, intermediate=512, layers=4,
+        heads=8, kv_heads=4, dtype="float32",
+    )
+    kernel_check = _check_kernels()
+    timed = int(os.environ.get("VDT_BENCH_DISPATCHES", "6"))
+    on_cpu = jax.default_backend() == "cpu"
+
+    explicit = os.environ.get("VDT_BENCH_MODEL")
+    if explicit or on_cpu:
+        shapes = {"1b": LLAMA_1B, "7b": LLAMA_7B}.get(explicit, tiny)
+        if on_cpu:
+            shapes = tiny  # big shapes would take minutes to compile
+        cfg = dict(
+            shapes=shapes,
+            batch=int(os.environ.get("VDT_BENCH_BATCH", "32")),
+            k_steps=int(os.environ.get("VDT_BENCH_STEPS", "16")),
+            quant=os.environ.get("VDT_BENCH_QUANT") or None,
+        )
+        configs = [(explicit or "tiny", cfg)]
+    else:
+        configs = [
+            ("llama_1b_bf16_b32", dict(
+                shapes=LLAMA_1B, batch=32, k_steps=16, quant=None)),
+            ("llama_1b_int8_b64", dict(
+                shapes=LLAMA_1B, batch=64, k_steps=16, quant="int8")),
+        ]
+        if os.environ.get("VDT_BENCH_FAST") != "1":
+            configs.append(
+                ("llama_7b_int8_b32", dict(
+                    shapes=LLAMA_7B, batch=32, k_steps=16, quant="int8"))
+            )
+
+    details = {}
+    best_name, best = None, None
+    for i, (name, cfg) in enumerate(configs):
+        det = _run_config(
+            **cfg, timed_dispatches=timed, warm_engine_probe=(i == 0)
+        )
+        details[name] = det
+        if best is None or det["tokens_per_sec"] > best["tokens_per_sec"]:
+            best_name, best = name, det
+
+    n_chips = jax.local_device_count()
     result = {
-        "metric": f"decode_tokens_per_sec_per_chip_llama_{which}",
-        "value": round(tps / n_chips, 2),
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": round(best["tokens_per_sec"] / n_chips, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": 1.0,
         "detail": {
             "backend": jax.default_backend(),
-            "device_kind": device_kind,
-            "hbm_bw_gbps": round(hbm_bw / 1e9),
-            "batch": batch,
-            "decode_steps_fused": k_steps,
-            "timed_tokens": timed_tokens,
-            "elapsed_s": round(elapsed, 3),
-            "dispatch_ms_p50": round(statistics.median(step_ms), 2),
-            "dispatch_ms_max": round(max(step_ms), 2),
-            "decode_microstep_ms": round(micro_ms, 3),
-            "hbm_roofline_microstep_ms": round(floor_ms, 3),
-            "roofline_frac": round(min(floor_ms / micro_ms, 1.0), 3),
-            "ttft_cold_s": round(ttft_cold_s, 2),
-            "param_bytes": param_bytes,
+            "device_kind": _hbm_bw()[0],
+            "best_config": best_name,
             "pallas_kernel_check": kernel_check,
+            "configs": details,
         },
     }
     print(json.dumps(result))

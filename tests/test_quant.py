@@ -185,3 +185,37 @@ def test_int8_mixtral_ep(tiny_mixtral):
     layer = eng.executor.worker.runner.params["layers"][0]
     assert isinstance(layer["w1"], QuantizedTensor)
     assert layer["w1"].q.dtype == np.int8
+
+
+def test_int8_matmul_kernel_interpret():
+    """Pallas weight-streaming matmul vs dequant-in-graph (interpret
+    mode; the bench re-checks on the live chip)."""
+    import jax.numpy as jnp
+
+    from vllm_distributed_tpu.ops.pallas.quant_matmul import int8_matmul
+
+    rng = np.random.default_rng(3)
+    for (t, i, o, blk) in [(32, 2048, 512, 512), (16, 256, 1024, 512),
+                           (8, 128, 640, 128)]:
+        x = jnp.asarray(rng.standard_normal((t, i)) * 0.5, jnp.float32)
+        qt = quantize((rng.standard_normal((i, o)) * 0.1).astype(np.float32), 8)
+        want = np.asarray(x @ dequantize(qt, jnp.float32))
+        got = np.asarray(int8_matmul(
+            x, jnp.asarray(qt.q), jnp.asarray(qt.scale),
+            block_out=min(blk, o), interpret=True))
+        assert np.abs(got - want).max() / np.abs(want).max() < 1e-5
+
+
+def test_int8_engine_pallas_interpret_path(tiny_llama):
+    """The quant-matmul 'pallas' mode end to end via VDT_USE_PALLAS
+    (interpret kernels on CPU), vs the dequant path: same tokens."""
+    import os
+    from unittest import mock
+
+    _, base = _greedy(tiny_llama, quantization="int8")
+    with mock.patch.dict(os.environ, {"VDT_USE_PALLAS": "pallas_interpret"}):
+        eng, via_kernel = _greedy(tiny_llama, quantization="int8")
+    # The loader stamps the backend on each tensor at load time.
+    layer = eng.executor.worker.runner.params["layers"][0]
+    assert layer["wq"].matmul == "pallas_interpret"
+    assert via_kernel == base

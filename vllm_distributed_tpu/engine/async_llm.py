@@ -98,11 +98,16 @@ class AsyncLLM:
             fut.set_result(result)
 
     async def _run_aux(self, fn, *args):
+        if self._dead is not None:
+            raise EngineDeadError(str(self._dead))
         loop = asyncio.get_running_loop()
         self._loop = loop
         fut = loop.create_future()
         self._intake.put(("aux", (fn, args, fut)))
         self._wake.set()
+        if self._dead is not None and not fut.done():
+            # Raced the engine death after its intake drain.
+            raise EngineDeadError(str(self._dead))
         return await fut
 
     def _to_request_queue(self, request_id: str, item) -> None:
@@ -137,6 +142,20 @@ class AsyncLLM:
                 self._loop.call_soon_threadsafe(
                     self._fail_all_queues, EngineDeadError(str(e))
                 )
+            # Aux ops already queued (or racing the death) would await
+            # forever — fail them too.
+            while True:
+                try:
+                    op, payload = self._intake.get_nowait()
+                except _queue.Empty:
+                    break
+                if op == "aux" and self._loop is not None:
+                    self._loop.call_soon_threadsafe(
+                        self._resolve_aux,
+                        payload[2],
+                        None,
+                        EngineDeadError(str(e)),
+                    )
 
     def _dispatch_outputs(self, outputs: list[RequestOutput]) -> None:
         for out in outputs:

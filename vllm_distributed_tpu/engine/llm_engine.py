@@ -128,6 +128,8 @@ class LLMEngine:
     def abort_request(self, request_id: str) -> None:
         self.scheduler.abort_request(request_id)
         self.detokenizers.pop(request_id, None)
+        if self.config.kv_transfer_config is not None:
+            self.executor.kv_output_aggregator.forget(request_id)
 
     def has_unfinished_requests(self) -> bool:
         return self.scheduler.has_unfinished_requests()
@@ -276,6 +278,8 @@ class LLMEngine:
                 req.metrics, FINISH_REASON.get(req.status)
             )
             self.detokenizers.pop(req.request_id, None)
+            if self.config.kv_transfer_config is not None:
+                self.executor.kv_output_aggregator.forget(req.request_id)
         return outputs
 
     def _make_output(

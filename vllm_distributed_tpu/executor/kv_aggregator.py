@@ -57,3 +57,11 @@ class KVOutputAggregator:
         base.kv_finished_sending = finished_sending
         base.kv_finished_recving = finished_recving
         return base
+
+    def forget(self, req_id: str) -> None:
+        """Drop partial progress for a request that left the system
+        (finished/aborted) before all workers reported — otherwise the
+        remaining-counts grow without bound, and a reused request id
+        would complete early."""
+        self._send_remaining.pop(req_id, None)
+        self._recv_remaining.pop(req_id, None)

@@ -95,6 +95,7 @@ def _quantize_and_place(model, tensor, spec: P, mesh: Mesh | None, dtype):
     (int8 is layout-independent and bit-identical across tp)."""
     from vllm_distributed_tpu.ops.quant import (
         pick_group_size,
+        pick_matmul_mode,
         place_quantized,
         quantize,
     )
@@ -105,7 +106,13 @@ def _quantize_and_place(model, tensor, spec: P, mesh: Mesh | None, dtype):
         group = pick_group_size(
             tensor.shape[-2], _in_dim_shards(spec, mesh, tensor.ndim)
         )
-    qt = quantize(tensor, bits, group, dtype=dtype)
+    qt = quantize(
+        tensor,
+        bits,
+        group,
+        dtype=dtype,
+        matmul=pick_matmul_mode(mesh, model.quant_method),
+    )
     if mesh is not None:
         qt = place_quantized(qt, spec, mesh)
     return qt
@@ -117,22 +124,32 @@ def _place_tree(model, params, specs, mesh: Mesh | None):
     quant = getattr(model, "quant_method", None)
 
     def rec(p, s, path):
+        # Containers are drained as they are processed (entries nulled
+        # right after use) so original full-precision device arrays free
+        # eagerly — otherwise quantizing a model that nearly fills HBM
+        # peaks at original + quantized and OOMs (e.g. 7B bf16 on v5e).
         if isinstance(p, dict):
-            return {
-                k: rec(
-                    v, s.get(k) if isinstance(s, dict) else None, path + (k,)
+            out = {}
+            for k in list(p):
+                out[k] = rec(
+                    p[k],
+                    s.get(k) if isinstance(s, dict) else None,
+                    path + (k,),
                 )
-                for k, v in p.items()
-            }
+                p[k] = None
+            return out
         if isinstance(p, list):
-            return [
-                rec(
-                    v,
-                    s[i] if isinstance(s, (list, tuple)) else None,
-                    path + (i,),
+            out_list = []
+            for i in range(len(p)):
+                out_list.append(
+                    rec(
+                        p[i],
+                        s[i] if isinstance(s, (list, tuple)) else None,
+                        path + (i,),
+                    )
                 )
-                for i, v in enumerate(p)
-            ]
+                p[i] = None
+            return out_list
         if s is None and specs is not None:
             # partition_specs() drifted from init_params(): loading a
             # weight fully replicated at scale is a silent perf/memory

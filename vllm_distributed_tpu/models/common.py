@@ -55,12 +55,9 @@ def layer_norm(
 
 
 def linear(x: jax.Array, w, b: jax.Array | None = None) -> jax.Array:
-    from vllm_distributed_tpu.ops.quant import maybe_dequantize
+    from vllm_distributed_tpu.ops.quant import quant_matmul
 
-    out = x @ maybe_dequantize(w, x.dtype)
-    if b is not None:
-        out = out + b.astype(out.dtype)
-    return out
+    return quant_matmul(x, w, b)
 
 
 def rope_frequencies(

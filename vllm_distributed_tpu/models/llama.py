@@ -249,9 +249,9 @@ class LlamaForCausalLM(SupportsQuantization):
         if lm_head is None:
             logits = sel @ params["embed"].T.astype(sel.dtype)
         else:
-            from vllm_distributed_tpu.ops.quant import maybe_dequantize
+            from vllm_distributed_tpu.ops.quant import quant_matmul
 
-            logits = sel @ maybe_dequantize(lm_head, sel.dtype)
+            logits = quant_matmul(sel, lm_head)
         logits = logits.astype(jnp.float32)
         if return_hidden:
             return logits, new_kv, sel.astype(jnp.float32)

@@ -263,6 +263,7 @@ class MixtralForCausalLM(LlamaForCausalLM):
                         group=parts[0].group,
                         shape=(self.num_experts, *parts[0].shape),
                         dtype=parts[0].dtype,
+                        matmul=parts[0].matmul,
                     )
                     continue
                 layer[name] = stack_to(parts, final[name])

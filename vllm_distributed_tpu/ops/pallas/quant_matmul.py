@@ -1,0 +1,82 @@
+"""Pallas weight-streaming int8 matmul: x @ dequant(Wq).
+
+The decode hot path is HBM-bound on weight reads; weight-only int8
+halves those bytes — but ONLY if the int8 weights are what actually
+streams.  XLA either hoists the dequant out of the fused decode scan
+(materializing the bf16 model; blocked by an optimization_barrier in
+model_runner) or materializes a dequantized copy per micro-step, which
+pays int8-read + bf16-write + bf16-read and erases the win.  This
+kernel does what the hardware wants: DMA int8 tiles HBM→VMEM (Pallas
+pipelines/double-buffers the grid blocks), dequantize in VMEM, feed the
+MXU in bf16 — the only HBM traffic is the int8 bytes.
+
+Activations stay exact (weight-only quantization, same numerics as
+``dequantize()`` + matmul: q.astype(f32) * scale).
+
+Used for 2D per-channel int8 weights on the single-chip path; under
+tp>1 the matmuls belong to GSPMD (a custom call would break its
+partitioning), so the dequant-in-graph fallback applies there.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def fits_vmem_budget(in_dim: int, block_out: int, x_nbytes: int) -> bool:
+    """x + 2 double-buffered int8 weight tiles within ~16 MB VMEM/core.
+    The single source of truth for both the caller's eligibility check
+    and the kernel's own guard."""
+    return in_dim * block_out * 2 + x_nbytes <= 12 * 2**20
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref, *, out_dtype):
+    # x [T, IN] bf16/f32; q [IN, BLK] int8; s [1, BLK] f32 -> o [T, BLK]
+    w = q_ref[...].astype(jnp.float32) * s_ref[0, :][None, :]
+    acc = jnp.dot(
+        x_ref[...].astype(jnp.float32),
+        w,
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[...] = acc.astype(out_dtype)
+
+
+def int8_matmul(
+    x: jax.Array,  # [T, IN]
+    q: jax.Array,  # [IN, OUT] int8
+    scale: jax.Array,  # [OUT] f32 (per output channel)
+    *,
+    block_out: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """x @ (q * scale) with int8 weights streamed tile-by-tile."""
+    t, in_dim = x.shape
+    in_q, out_dim = q.shape
+    assert in_q == in_dim, (x.shape, q.shape)
+    block_out = min(block_out, out_dim)
+    if out_dim % block_out:
+        raise ValueError(f"out dim {out_dim} % block {block_out} != 0")
+    # [8192, 512] int8 = 4 MB/tile; x [T<=256, 8192] bf16 = 4 MB.  Bigger
+    # in_dims would need an inner K loop; serving shapes fit.
+    if not fits_vmem_budget(in_dim, block_out, x.nbytes):
+        raise ValueError(
+            f"int8_matmul tile budget exceeded (in={in_dim}, "
+            f"block={block_out}, T={t})"
+        )
+    kernel = functools.partial(_kernel, out_dtype=x.dtype)
+    return pl.pallas_call(
+        kernel,
+        grid=(out_dim // block_out,),
+        in_specs=[
+            pl.BlockSpec((t, in_dim), lambda j: (0, 0)),
+            pl.BlockSpec((in_dim, block_out), lambda j: (0, j)),
+            pl.BlockSpec((1, block_out), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((t, block_out), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((t, out_dim), x.dtype),
+        interpret=interpret,
+    )(x, q, scale.reshape(1, -1).astype(jnp.float32))

@@ -40,6 +40,11 @@ class QuantizedTensor:
     int8: q [..., in, out] int8, scale [..., out].
     int4: q [..., in/2, out] uint8 (low nibble = even input row),
           scale [..., in/group, out].
+
+    ``matmul`` is the execution backend stamped at LOAD time per tensor
+    ("dequant" | "pallas" | "pallas_interpret") — carried on the tensor,
+    not in module state, so multiple engines in one process can't flip
+    each other's path on a retrace.
     """
 
     q: Any
@@ -48,6 +53,7 @@ class QuantizedTensor:
     group: int  # 0 for per-channel (int8)
     shape: tuple  # logical (dequantized) shape
     dtype: Any  # logical dtype
+    matmul: str = "dequant"
 
     def tree_flatten(self):
         return (self.q, self.scale), (
@@ -55,6 +61,7 @@ class QuantizedTensor:
             self.group,
             self.shape,
             self.dtype,
+            self.matmul,
         )
 
     @classmethod
@@ -80,7 +87,9 @@ def pick_group_size(in_dim: int, shards: int = 1, cap: int = 128) -> int:
     return _pow2_divisor(per_shard, cap)
 
 
-def quantize(w, bits: int, group: int = 0, dtype=None) -> QuantizedTensor:
+def quantize(
+    w, bits: int, group: int = 0, dtype=None, matmul: str = "dequant"
+) -> QuantizedTensor:
     """Quantize [..., in, out] weights.  Host (numpy) or device arrays.
     `dtype` records the logical dtype dequantization restores."""
     is_jax = isinstance(w, jax.Array)
@@ -91,7 +100,9 @@ def quantize(w, bits: int, group: int = 0, dtype=None) -> QuantizedTensor:
         s = xp.max(xp.abs(wf), axis=-2) / 127.0  # [..., out]
         s = xp.maximum(s, 1e-8)
         q = xp.clip(xp.round(wf / s[..., None, :]), -127, 127).astype(xp.int8)
-        return QuantizedTensor(q, s.astype(xp.float32), 8, 0, shape, dtype)
+        return QuantizedTensor(
+            q, s.astype(xp.float32), 8, 0, shape, dtype, matmul
+        )
     if bits == 4:
         if group <= 0:
             group = pick_group_size(in_dim)
@@ -107,7 +118,7 @@ def quantize(w, bits: int, group: int = 0, dtype=None) -> QuantizedTensor:
         q = q.reshape(*shape[:-1], shape[-1]).astype(xp.uint8)
         packed = (q[..., 0::2, :] | (q[..., 1::2, :] << 4)).astype(xp.uint8)
         return QuantizedTensor(
-            packed, s.astype(xp.float32), 4, group, shape, dtype
+            packed, s.astype(xp.float32), 4, group, shape, dtype, matmul
         )
     raise ValueError(f"unsupported bits {bits} (use 8 or 4)")
 
@@ -138,6 +149,68 @@ def maybe_dequantize(w, dtype) -> jax.Array:
     if isinstance(w, QuantizedTensor):
         return dequantize(w, dtype)
     return w.astype(dtype)
+
+
+def pick_matmul_mode(mesh, quant_method: str | None) -> str:
+    """Execution backend for quantized matmuls, decided at load time:
+    "dequant" composes with GSPMD (tp/dp>1 — a custom call would break
+    its partitioning); "pallas" streams int8 tiles through the Pallas
+    kernel on the single-chip TPU path."""
+    if mesh is not None or quant_method != "int8":
+        return "dequant"
+    from vllm_distributed_tpu import envs
+
+    backend = envs.VDT_USE_PALLAS
+    if backend == "auto":
+        backend = "pallas" if jax.default_backend() == "tpu" else "reference"
+    if backend in ("pallas", "pallas_interpret"):
+        return backend
+    return "dequant"
+
+
+def _pick_block(out_dim: int) -> int | None:
+    for blk in (512, 256, 128):
+        if out_dim % blk == 0:
+            return blk
+    return None
+
+
+def quant_matmul(x: jax.Array, w, bias=None) -> jax.Array:
+    """x @ w for plain or QuantizedTensor weights.  On the Pallas path
+    eligible int8 2D weights stream through ops/pallas/quant_matmul (the
+    only HBM traffic is the int8 bytes); everything else dequantizes
+    in-graph."""
+    if isinstance(w, QuantizedTensor):
+        from vllm_distributed_tpu.ops.pallas.quant_matmul import (
+            fits_vmem_budget,
+            int8_matmul,
+        )
+
+        blk = _pick_block(w.q.shape[-1]) if w.q.ndim == 2 else None
+        eligible = (
+            w.matmul != "dequant"
+            and w.bits == 8
+            and w.q.ndim == 2
+            and x.ndim == 2
+            and blk is not None
+            and x.shape[0] <= 256
+            and fits_vmem_budget(w.q.shape[0], blk, x.nbytes)
+        )
+        if eligible:
+            out = int8_matmul(
+                x,
+                w.q,
+                w.scale,
+                block_out=blk,
+                interpret=w.matmul == "pallas_interpret",
+            )
+        else:
+            out = x @ dequantize(w, x.dtype)
+    else:
+        out = x @ w.astype(x.dtype)
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
+    return out
 
 
 def axis_shards(entry, mesh) -> int:
@@ -179,6 +252,7 @@ def place_quantized(qt: QuantizedTensor, wspec: P, mesh) -> QuantizedTensor:
         qt.group,
         qt.shape,
         qt.dtype,
+        qt.matmul,
     )
 
 

@@ -395,7 +395,16 @@ class ModelRunner:
         t = len(token_ids)
         t_pad = max(next_power_of_2(t), _MIN_TOKEN_BUCKET)
         pages = cdiv(t_pad, self.page_size) + 1  # +1: reserved dump page
-        kv = self.alloc_kv_pool(pages)
+        # Cached scratch pool, grown to the largest request seen: aux
+        # calls must not allocate a fresh pool each time on a device
+        # whose HBM the serving pool was sized to fill.
+        cached = getattr(self, "_aux_pool", None)
+        if cached is None or cached[0] < pages:
+            self._aux_pool = (pages, self.alloc_kv_pool(pages))
+        kv = self._aux_pool[1]
+        # Previous contents are dead history for this single-sequence
+        # teacher-forced pass (slots are overwritten; reads are bounded
+        # by seq_lens=t), so reuse without zeroing.
         tokens = np.zeros(t_pad, np.int32)
         tokens[:t] = token_ids
         positions = np.zeros(t_pad, np.int32)
@@ -418,9 +427,10 @@ class ModelRunner:
         args = (jnp.asarray(tokens), meta)
         if self.mesh is not None:
             args = jax.device_put(args, NamedSharding(self.mesh, P()))
-        logits, _, hidden = self._jit_aux_forward(
+        logits, kv_out, hidden = self._jit_aux_forward(
             self.params, kv, args[0], args[1]
         )
+        self._aux_pool = (pages, kv_out)  # keep the written pool warm
         return np.asarray(logits)[:t], np.asarray(hidden)[:t]
 
     def embed(self, token_ids: list[int]) -> list[float]:
@@ -999,8 +1009,15 @@ class ModelRunner:
                 prompt_tokens=prompt_toks,
                 output_tokens=out_buf,
             )
+            # Barrier blocks XLA's loop-invariant code motion on the
+            # params: without it, quantized weights get dequantized ONCE
+            # outside the scan — materializing the full bf16 model in
+            # HBM (OOM at serving pool sizes) and erasing the int8
+            # bandwidth win.  With it, the int8 bytes stream per
+            # micro-step and the dequant fuses into the matmuls.
+            params_i = jax.lax.optimization_barrier(params)
             logits, kv = self.model.forward(
-                params,
+                params_i,
                 tok,
                 kv,
                 meta,
