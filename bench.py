@@ -169,7 +169,41 @@ def _run_config(shapes, *, batch, k_steps, quant, timed_dispatches,
             )
         )
 
+    def free_engine(eng):
+        """Release HBM: the jit cache keys on the runner (static self),
+        pinning params/KV beyond the engine's lifetime — delete the
+        device buffers explicitly."""
+        eng.shutdown()
+        r = getattr(getattr(eng, "executor", None), "worker", None)
+        r = getattr(r, "runner", None)
+        if r is not None and r.params is not None:
+            for leaf in jax.tree.leaves((r.params, r.kv_caches)):
+                leaf.delete()
+            carry = getattr(r, "_decode_carry", None)
+            if carry is not None:
+                carry[2].delete()
+            r.params, r.kv_caches, r._decode_carry = None, None, None
+
     engine = build()
+    try:
+        return _measure(
+            engine, build, free_engine, batch=batch, k_steps=k_steps,
+            quant=quant, prompt_len=prompt_len, max_tokens=max_tokens,
+            warmup_dispatches=warmup_dispatches,
+            warm_engine_probe=warm_engine_probe,
+        )
+    finally:
+        # Always release HBM — a failed config must not leak its pool
+        # into the next config's budget.
+        free_engine(engine)
+
+
+def _measure(engine, build, free_engine, *, batch, k_steps, quant,
+             prompt_len, max_tokens, warmup_dispatches, warm_engine_probe):
+    import jax
+
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+
     sp = SamplingParams(temperature=0.0, max_tokens=max_tokens, ignore_eos=True)
     for i in range(batch):
         prompt = [(7 * i + j) % 1000 + 1 for j in range(prompt_len)]
@@ -256,39 +290,25 @@ def _run_config(shapes, *, batch, k_steps, quant, timed_dispatches,
         "param_bytes": param_bytes,
         "kv_read_bytes_per_microstep": kv_read_bytes,
     }
-    def free_engine(eng):
-        """Release HBM: the jit cache keys on the runner (static self),
-        pinning params/KV beyond the engine's lifetime — delete the
-        device buffers explicitly."""
-        eng.shutdown()
-        r = getattr(getattr(eng, "executor", None), "worker", None)
-        r = getattr(r, "runner", None)
-        if r is not None:
-            for leaf in jax.tree.leaves((r.params, r.kv_caches)):
-                leaf.delete()
-            carry = getattr(r, "_decode_carry", None)
-            if carry is not None:
-                carry[2].delete()
-            r.params, r.kv_caches, r._decode_carry = None, None, None
-
     if warm_engine_probe:
-        # Warm TTFT: a fresh engine on the same shapes hits the jit
-        # cache — the restart-to-first-token story (§5.4).
+        # Warm TTFT: a fresh engine on the same shapes hits the
+        # persistent compile cache — the restart-to-first-token story
+        # (§5.4).  Free the first engine's HBM before the rebuild.
         free_engine(engine)
         engine2 = build()
-        engine2.add_request(
-            "warm",
-            prompt_token_ids=[3] * prompt_len,
-            sampling_params=SamplingParams(
-                temperature=0.0, max_tokens=2, ignore_eos=True
-            ),
-        )
-        t0 = time.perf_counter()
-        engine2.step()
-        detail["ttft_warm_s"] = round(time.perf_counter() - t0, 2)
-        free_engine(engine2)
-    else:
-        free_engine(engine)
+        try:
+            engine2.add_request(
+                "warm",
+                prompt_token_ids=[3] * prompt_len,
+                sampling_params=SamplingParams(
+                    temperature=0.0, max_tokens=2, ignore_eos=True
+                ),
+            )
+            t0 = time.perf_counter()
+            engine2.step()
+            detail["ttft_warm_s"] = round(time.perf_counter() - t0, 2)
+        finally:
+            free_engine(engine2)
     return detail
 
 
@@ -343,13 +363,23 @@ def main() -> None:
 
     details = {}
     best_name, best = None, None
-    for i, (name, cfg) in enumerate(configs):
-        det = _run_config(
-            **cfg, timed_dispatches=timed, warm_engine_probe=(i == 0)
-        )
+    warm_pending = True  # probe warm TTFT on the first SUCCESSFUL config
+    for name, cfg in configs:
+        try:
+            det = _run_config(
+                **cfg, timed_dispatches=timed,
+                warm_engine_probe=warm_pending,
+            )
+        except Exception as e:  # noqa: BLE001 — one config must not
+            # take down the whole bench (e.g. OOM on a busy chip)
+            details[name] = {"error": f"{type(e).__name__}: {e}"}
+            continue
+        warm_pending = False
         details[name] = det
         if best is None or det["tokens_per_sec"] > best["tokens_per_sec"]:
             best_name, best = name, det
+    if best is None:
+        raise RuntimeError(f"every bench config failed: {details}")
 
     n_chips = jax.local_device_count()
     result = {

@@ -1,0 +1,138 @@
+"""Ring attention: causal attention with the SEQUENCE sharded over a
+mesh axis (context parallelism for long prefill).
+
+The reference serves 262k-token contexts from a single worker's paged
+KV (SURVEY.md §5.7 — no sequence parallelism exists there); on TPU the
+mesh makes the stronger design natural: shard the sequence over an
+axis, keep each device's Q resident, and rotate K/V shards around the ring
+with `ppermute` while accumulating flash-style online softmax — the
+blockwise ring attention of Liu et al., expressed as a shard_map over
+the same Mesh the rest of the engine uses.  Compute overlaps the
+neighbor exchange because XLA pipelines the permute with the per-step
+einsums; the collective rides ICI.
+
+This is the long-context building block (prefill attention for
+sequences larger than one device's HBM/compute appetite).  Decode stays
+on the paged kernel — a decode step touches one token per sequence, so
+sequence-sharding it has nothing to win.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = float("-inf")
+
+
+def _block_attention(q, k, v, *, scale, q_start, kv_start, causal):
+    """Partial flash-attention of one (q-block, kv-block) pair.
+
+    Returns (unnormalized out [B, H, D], row max m [B, H], row sum l
+    [B, H]) for online-softmax accumulation.
+    """
+    bq, hq, d = q.shape
+    bk = k.shape[0]
+    # GQA: repeat kv heads to match q heads.
+    if k.shape[1] != hq:
+        rep = hq // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    logits = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        q_pos = q_start + jnp.arange(bq)
+        kv_pos = kv_start + jnp.arange(bk)
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        logits = jnp.where(mask[None, :, :], logits, _NEG_INF)
+    m = jnp.max(logits, axis=-1)  # [H, Q]
+    # Fully-masked rows (this kv block is entirely in the future) must
+    # not poison the accumulator: exp(-inf - -inf) -> nan.
+    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(logits - safe_m[..., None])
+    p = jnp.where(jnp.isfinite(logits), p, 0.0)
+    l = jnp.sum(p, axis=-1)  # [H, Q]
+    out = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+    return out, jnp.swapaxes(m, 0, 1), jnp.swapaxes(l, 0, 1)
+
+
+def _merge(acc, new, m_acc, m_new, l_acc, l_new):
+    """Online-softmax merge of two partial results."""
+    m = jnp.maximum(m_acc, m_new)
+    safe = lambda x: jnp.where(jnp.isfinite(x), x, 0.0)  # noqa: E731
+    a_scale = jnp.exp(safe(m_acc) - safe(m)) * jnp.isfinite(m_acc)
+    n_scale = jnp.exp(safe(m_new) - safe(m)) * jnp.isfinite(m_new)
+    acc = acc * a_scale[:, :, None] + new * n_scale[:, :, None]
+    l = l_acc * a_scale + l_new * n_scale
+    return acc, m, l
+
+
+def ring_attention(
+    q: jax.Array,  # [T, Hq, D] sequence-sharded over `axis`
+    k: jax.Array,  # [T, Hkv, D] likewise
+    v: jax.Array,
+    mesh,
+    *,
+    axis: str = "sp",
+    scale: float,
+    causal: bool = True,
+) -> jax.Array:
+    """Causal attention over a sequence sharded across `axis`.
+
+    Each device keeps its Q shard and sends its K/V shard around the
+    ring; after `sp` steps every Q block has attended to every K/V
+    block at or before it.  Output is sequence-sharded like q.
+    """
+    sp = mesh.shape[axis]
+
+    def per_device(q_blk, k_blk, v_blk):
+        idx = jax.lax.axis_index(axis)
+        bq = q_blk.shape[0]
+        q_start = idx * bq
+        perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+        # Peel the local block (no exchange needed), then scan sp-1
+        # rotate-then-compute steps — no dead final permute shipping
+        # shards nobody reads.
+        acc, m_acc, l_acc = _block_attention(
+            q_blk, k_blk, v_blk,
+            scale=scale, q_start=q_start, kv_start=idx * k_blk.shape[0],
+            causal=causal,
+        )
+
+        def body(carry, r):
+            acc, m_acc, l_acc, k_cur, v_cur, kv_owner = carry
+            # Rotate: device i's block moves to device i+1, so after
+            # r rotations device i holds the block originally on i - r.
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+            kv_owner = (kv_owner - 1) % sp
+            out, m_new, l_new = _block_attention(
+                q_blk, k_cur, v_cur,
+                scale=scale, q_start=q_start,
+                kv_start=kv_owner * k_cur.shape[0],
+                causal=causal,
+            )
+            acc, m_acc, l_acc = _merge(acc, out, m_acc, m_new, l_acc, l_new)
+            return (acc, m_acc, l_acc, k_cur, v_cur, kv_owner), None
+
+        if sp > 1:
+            (acc, m_acc, l_acc, _, _, _), _ = jax.lax.scan(
+                body,
+                (acc, m_acc, l_acc, k_blk, v_blk, idx),
+                jnp.arange(sp - 1),
+            )
+        denom = jnp.where(l_acc > 0, l_acc, 1.0)
+        return (acc / denom[:, :, None]).astype(q_blk.dtype)
+
+    spec = P(axis, None, None)
+    return jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

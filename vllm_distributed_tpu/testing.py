@@ -98,6 +98,25 @@ def write_mixtral_config(
     return dirname
 
 
+def full_attention_reference(q, k, v, scale, causal=True):
+    """Dense (non-paged) attention oracle [T, H, D] with GQA repeat —
+    the reference for ring attention (tests + multichip dryrun)."""
+    import jax
+    import jax.numpy as jnp
+
+    t, hq, _ = q.shape
+    hkv = k.shape[1]
+    if hkv != hq:
+        k = jnp.repeat(k, hq // hkv, axis=1)
+        v = jnp.repeat(v, hq // hkv, axis=1)
+    logits = jnp.einsum("qhd,khd->hqk", q, k) * scale
+    if causal:
+        mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+        logits = jnp.where(mask[None], logits, -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("hqk,khd->qhd", p, v)
+
+
 # Shapes of real family members, for dummy-weight perf runs.
 LLAMA_1B = dict(
     vocab_size=32000, hidden=2048, intermediate=8192, layers=16,
