@@ -293,22 +293,26 @@ def _measure(engine, build, free_engine, *, batch, k_steps, quant,
     if warm_engine_probe:
         # Warm TTFT: a fresh engine on the same shapes hits the
         # persistent compile cache — the restart-to-first-token story
-        # (§5.4).  Free the first engine's HBM before the rebuild.
+        # (§5.4).  Free the first engine's HBM before the rebuild.  A
+        # probe failure must not discard the config's measurement.
         free_engine(engine)
-        engine2 = build()
         try:
-            engine2.add_request(
-                "warm",
-                prompt_token_ids=[3] * prompt_len,
-                sampling_params=SamplingParams(
-                    temperature=0.0, max_tokens=2, ignore_eos=True
-                ),
-            )
-            t0 = time.perf_counter()
-            engine2.step()
-            detail["ttft_warm_s"] = round(time.perf_counter() - t0, 2)
-        finally:
-            free_engine(engine2)
+            engine2 = build()
+            try:
+                engine2.add_request(
+                    "warm",
+                    prompt_token_ids=[3] * prompt_len,
+                    sampling_params=SamplingParams(
+                        temperature=0.0, max_tokens=2, ignore_eos=True
+                    ),
+                )
+                t0 = time.perf_counter()
+                engine2.step()
+                detail["ttft_warm_s"] = round(time.perf_counter() - t0, 2)
+            finally:
+                free_engine(engine2)
+        except Exception as e:  # noqa: BLE001
+            detail["ttft_warm_error"] = f"{type(e).__name__}: {e}"
     return detail
 
 

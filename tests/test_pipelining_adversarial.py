@@ -1,0 +1,149 @@
+"""Adversarial pipelining coverage (VERDICT r2 weak #7): abort while a
+fused dispatch is in flight, page-pressure preemption racing the device
+carry, and a request exhausting its budget mid-pipeline.  Uses the
+production-kernel interpret path so the in-place writer + carry are the
+code under test."""
+
+import os
+from unittest import mock
+
+import pytest
+
+from tests.utils import make_tiny_llama
+from vllm_distributed_tpu.config import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+def _engine(model_dir, **kw):
+    defaults = dict(
+        model=model_dir,
+        skip_tokenizer_init=True,
+        num_kv_pages=64,
+        max_model_len=256,
+        max_num_seqs=8,
+        num_decode_steps=8,
+    )
+    defaults.update(kw)
+    return LLMEngine.from_engine_args(EngineArgs(**defaults))
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    return make_tiny_llama(str(tmp_path_factory.mktemp("adv")))
+
+
+def _sp(max_tokens=64):
+    return SamplingParams(
+        temperature=0.0, max_tokens=max_tokens, ignore_eos=True
+    )
+
+
+def _drive_until_pipelined(engine):
+    """Step until a fused dispatch is in flight (pending non-empty)."""
+    for _ in range(20):
+        engine.step()
+        if engine._pending:
+            return
+    raise AssertionError("pipelining never engaged")
+
+
+def test_abort_mid_flight(model_dir):
+    """Aborting a request whose tokens are still on the device must not
+    corrupt the survivors: they finish with exact lengths and match an
+    undisturbed run's prefix behavior."""
+    with mock.patch.dict(os.environ, {"VDT_USE_PALLAS": "pallas_interpret"}):
+        engine = _engine(model_dir)
+        for i in range(3):
+            engine.add_request(
+                f"r{i}", prompt_token_ids=[3 + i, 7, 11], sampling_params=_sp(40)
+            )
+        _drive_until_pipelined(engine)
+        engine.abort_request("r1")
+        done = {}
+        for _ in range(200):
+            for out in engine.step():
+                if out.finished:
+                    done[out.request_id] = out.outputs[0].token_ids
+            if not engine.has_unfinished_requests():
+                break
+        assert set(done) == {"r0", "r2"}
+        assert all(len(t) == 40 for t in done.values())
+
+        # Oracle: same prompts, no abort — survivors' tokens unchanged.
+        engine2 = _engine(model_dir)
+        for i in range(3):
+            engine2.add_request(
+                f"r{i}", prompt_token_ids=[3 + i, 7, 11], sampling_params=_sp(40)
+            )
+        ref = {}
+        while engine2.has_unfinished_requests():
+            for out in engine2.step():
+                if out.finished:
+                    ref[out.request_id] = out.outputs[0].token_ids
+        assert done["r0"] == ref["r0"]
+        assert done["r2"] == ref["r2"]
+
+
+def test_late_arrival_mid_flight(model_dir):
+    """A request added while a fused dispatch is in flight (waiting
+    non-empty breaks _pipeline_safe) must drain cleanly and everyone
+    finishes with exact lengths."""
+    with mock.patch.dict(os.environ, {"VDT_USE_PALLAS": "pallas_interpret"}):
+        engine = _engine(model_dir)
+        for i in range(2):
+            engine.add_request(
+                f"a{i}", prompt_token_ids=[5, 9 + i], sampling_params=_sp(32)
+            )
+        _drive_until_pipelined(engine)
+        engine.add_request("late", prompt_token_ids=[42, 43, 44],
+                           sampling_params=_sp(16))
+        done = {}
+        while engine.has_unfinished_requests():
+            for out in engine.step():
+                if out.finished:
+                    done[out.request_id] = out.outputs[0].token_ids
+        assert len(done["a0"]) == 32 and len(done["a1"]) == 32
+        assert len(done["late"]) == 16
+
+
+def test_page_pressure_with_pipelining(model_dir):
+    """A page pool tight enough to force preemption while multi-step
+    decode is on: everything still completes with exact lengths (the
+    preempted request re-prefills and regenerates deterministically)."""
+    with mock.patch.dict(os.environ, {"VDT_USE_PALLAS": "pallas_interpret"}):
+        # 18 usable pages × 16 slots vs 4 requests × (8 prompt + 120
+        # output) ≈ 512 slots needed at peak — guaranteed preemption.
+        engine = _engine(model_dir, num_kv_pages=19, max_model_len=160)
+        for i in range(4):
+            engine.add_request(
+                f"p{i}",
+                prompt_token_ids=[2 + i] * 8,
+                sampling_params=_sp(120),
+            )
+        done = {}
+        for _ in range(2000):
+            for out in engine.step():
+                if out.finished:
+                    done[out.request_id] = out.outputs[0].token_ids
+            if not engine.has_unfinished_requests():
+                break
+        assert set(done) == {f"p{i}" for i in range(4)}
+        assert all(len(t) == 120 for t in done.values())
+        assert engine.scheduler.num_preemptions > 0, "test lost its teeth"
+
+
+def test_budget_exhaustion_mid_pipeline(model_dir):
+    """Requests whose remaining budget is smaller than the fused K while
+    a dispatch is in flight: the engine drains instead of overrunning
+    max_tokens."""
+    with mock.patch.dict(os.environ, {"VDT_USE_PALLAS": "pallas_interpret"}):
+        engine = _engine(model_dir)
+        engine.add_request("x", prompt_token_ids=[9, 8, 7],
+                           sampling_params=_sp(max_tokens=13))  # not ÷ 8
+        done = {}
+        while engine.has_unfinished_requests():
+            for out in engine.step():
+                if out.finished:
+                    done[out.request_id] = out.outputs[0].token_ids
+        assert len(done["x"]) == 13

@@ -15,6 +15,12 @@ This is the long-context building block (prefill attention for
 sequences larger than one device's HBM/compute appetite).  Decode stays
 on the paged kernel — a decode step touches one token per sequence, so
 sequence-sharding it has nothing to win.
+
+Known inefficiency (future work): with contiguous sequence placement,
+causal masking discards ~half the block computations across the ring
+(device 0 masks out every remote block).  The standard fix is zigzag /
+striped placement so each device holds an early and a late slice and
+per-step work balances.
 """
 
 from __future__ import annotations
@@ -35,14 +41,14 @@ def _block_attention(q, k, v, *, scale, q_start, kv_start, causal):
     [B, H]) for online-softmax accumulation.
     """
     bq, hq, d = q.shape
-    bk = k.shape[0]
-    # GQA: repeat kv heads to match q heads.
-    if k.shape[1] != hq:
-        rep = hq // k.shape[1]
-        k = jnp.repeat(k, rep, axis=1)
-        v = jnp.repeat(v, rep, axis=1)
-    logits = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
+    bk, hkv = k.shape[0], k.shape[1]
+    # GQA via grouped einsum (no materialized K/V repeat in the ring's
+    # hot loop — same formulation as paged_attention_reference).
+    g = hq // hkv
+    qg = q.reshape(bq, hkv, g, d).astype(jnp.float32)
+    logits = jnp.einsum(
+        "qhgd,khd->hgqk", qg, k.astype(jnp.float32)
+    ).reshape(hq, bq, bk) * scale
     if causal:
         q_pos = q_start + jnp.arange(bq)
         kv_pos = kv_start + jnp.arange(bk)
@@ -55,7 +61,10 @@ def _block_attention(q, k, v, *, scale, q_start, kv_start, causal):
     p = jnp.exp(logits - safe_m[..., None])
     p = jnp.where(jnp.isfinite(logits), p, 0.0)
     l = jnp.sum(p, axis=-1)  # [H, Q]
-    out = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+    pg = p.reshape(hkv, g, bq, bk)
+    out = jnp.einsum(
+        "hgqk,khd->qhgd", pg, v.astype(jnp.float32)
+    ).reshape(bq, hq, d)
     return out, jnp.swapaxes(m, 0, 1), jnp.swapaxes(l, 0, 1)
 
 
