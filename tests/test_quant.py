@@ -215,7 +215,10 @@ def test_int8_engine_pallas_interpret_path(tiny_llama):
     _, base = _greedy(tiny_llama, quantization="int8")
     with mock.patch.dict(os.environ, {"VDT_USE_PALLAS": "pallas_interpret"}):
         eng, via_kernel = _greedy(tiny_llama, quantization="int8")
-    # The loader stamps the backend on each tensor at load time.
+    # The loader stamps the backend on each tensor at load time; on the
+    # single-chip kernel path Q|K|V and gate|up fuse into one streaming
+    # call each (bit-identical: per-out-block computation independent).
     layer = eng.executor.worker.runner.params["layers"][0]
-    assert layer["wq"].matmul == "pallas_interpret"
+    assert layer["wqkv"].matmul == "pallas_interpret"
+    assert "wgu" in layer and "wq" not in layer and "gate" not in layer
     assert via_kernel == base

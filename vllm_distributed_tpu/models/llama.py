@@ -193,13 +193,83 @@ class LlamaForCausalLM(SupportsQuantization):
         """KV pages [P, page, Hkv, D]: shard kv heads over tp."""
         return P(None, None, "tp", None)
 
+    # ---- quantized projection fusion (single-chip fast path) ----
+    _QKV_FUSE = ("wq", "wk", "wv")
+    _GU_FUSE = ("gate", "up")
+
+    def fuse_quantized_projections(self, params: dict) -> dict:
+        """Concatenate the int8 Q|K|V and gate|up weights out-dim-wise
+        so each layer issues one Pallas weight-streaming call instead of
+        three/two (per-out-block computation is independent, so results
+        are bit-identical to the unfused calls).  Only applies where
+        every member is an eligible int8 kernel-mode tensor; called by
+        the runner on the single-chip path after load."""
+        from vllm_distributed_tpu.ops.quant import QuantizedTensor
+
+        def fusable(layer, names):
+            ws = [layer.get(n) for n in names]
+            if not all(
+                isinstance(w, QuantizedTensor)
+                and w.bits == 8
+                and w.q.ndim == 2
+                and w.matmul in ("pallas", "pallas_interpret")
+                for w in ws
+            ):
+                return None
+            if any(layer.get(f"b{n[-1]}") is not None for n in names
+                   if n.startswith("w")):
+                return None  # biased projections (qwen2) stay unfused
+            return ws
+
+        for layer in params.get("layers", []):
+            for names, fused_name in (
+                (self._QKV_FUSE, "wqkv"),
+                (self._GU_FUSE, "wgu"),
+            ):
+                ws = fusable(layer, names)
+                if ws is None:
+                    continue
+                layer[fused_name] = QuantizedTensor(
+                    q=jnp.concatenate([w.q for w in ws], axis=-1),
+                    scale=jnp.concatenate([w.scale for w in ws], axis=-1),
+                    bits=8,
+                    group=0,
+                    shape=(ws[0].shape[0], sum(w.shape[1] for w in ws)),
+                    dtype=ws[0].dtype,
+                    matmul=ws[0].matmul,
+                )
+                for n in names:
+                    del layer[n]
+        return params
+
     # ---- forward ----
+    def _qkv(self, h: jax.Array, layer: dict, t: int):
+        nh, nkv, d = self.num_heads, self.num_kv_heads, self.head_dim
+        wqkv = layer.get("wqkv")
+        if wqkv is not None:
+            qkv = linear(h, wqkv)
+            q = qkv[:, : nh * d]
+            k = qkv[:, nh * d : (nh + nkv) * d]
+            v = qkv[:, (nh + nkv) * d :]
+        else:
+            q = linear(h, layer["wq"], layer.get("bq"))
+            k = linear(h, layer["wk"], layer.get("bk"))
+            v = linear(h, layer["wv"], layer.get("bv"))
+        return (
+            q.reshape(t, nh, d),
+            k.reshape(t, nkv, d),
+            v.reshape(t, nkv, d),
+        )
+
     def _mlp(self, h: jax.Array, layer: dict) -> jax.Array:
         """Post-attention MLP for one layer (overridden by MoE models)."""
-        gated = jax.nn.silu(linear(h, layer["gate"])) * linear(
-            h, layer["up"]
-        )
-        return linear(gated, layer["down"])
+        wgu = layer.get("wgu")
+        if wgu is not None:
+            gu = linear(h, wgu)
+            gate, up = gu[:, : self.intermediate_size], gu[:, self.intermediate_size :]
+        else:
+            gate, up = linear(h, layer["gate"]), linear(h, layer["up"])
+        return linear(jax.nn.silu(gate) * up, layer["down"])
 
     def forward(
         self,
@@ -222,12 +292,7 @@ class LlamaForCausalLM(SupportsQuantization):
         t = token_ids.shape[0]
         for layer, (k_pages, v_pages) in zip(params["layers"], kv_caches):
             h = rms_norm(x, layer["input_ln"], self.rms_eps)
-            q = linear(h, layer["wq"], layer.get("bq"))
-            k = linear(h, layer["wk"], layer.get("bk"))
-            v = linear(h, layer["wv"], layer.get("bv"))
-            q = q.reshape(t, self.num_heads, self.head_dim)
-            k = k.reshape(t, self.num_kv_heads, self.head_dim)
-            v = v.reshape(t, self.num_kv_heads, self.head_dim)
+            q, k, v = self._qkv(h, layer, t)
             if self.qk_norm:
                 q = rms_norm(q, layer["q_norm"], self.rms_eps)
                 k = rms_norm(k, layer["k_norm"], self.rms_eps)

@@ -141,6 +141,13 @@ class ModelRunner:
         self.model, self.params = get_model(
             self.config.model_config, load_format=load_format, mesh=self.mesh
         )
+        # Single-chip fast path: fuse quantized Q|K|V and gate|up so
+        # each layer issues one weight-streaming kernel call instead of
+        # three/two (bit-identical results).  Self-gating: fusion only
+        # touches tensors the loader stamped with the kernel mode, which
+        # pick_matmul_mode only does when mesh is None.
+        if hasattr(self.model, "fuse_quantized_projections"):
+            self.params = self.model.fuse_quantized_projections(self.params)
         self._attn_fn = self._pick_attn_fn()
         # Two writers: prefill/mixed steps keep the functional XLA
         # scatter (batched, GSPMD-partitionable — the aliased Pallas
