@@ -266,7 +266,8 @@ class LlamaForCausalLM(SupportsQuantization):
         wgu = layer.get("wgu")
         if wgu is not None:
             gu = linear(h, wgu)
-            gate, up = gu[:, : self.intermediate_size], gu[:, self.intermediate_size :]
+            gate = gu[:, : self.intermediate_size]
+            up = gu[:, self.intermediate_size :]
         else:
             gate, up = linear(h, layer["gate"]), linear(h, layer["up"])
         return linear(jax.nn.silu(gate) * up, layer["down"])
