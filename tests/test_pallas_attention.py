@@ -220,3 +220,31 @@ def test_engine_e2e_with_pallas_backend(tmp_path):
         return paged_attention_reference
 
     assert run("pallas") == run("reference")
+
+
+def test_cross_seq_prefetch_multiblock_decode():
+    """Decode with contexts spanning MULTIPLE kv blocks (ctx > 1024
+    tokens at the default 512 KiB KV buffer with hkv=2/d=64/f32), which
+    flips on the cross-sequence block-0 prefetch path — including an
+    empty sequence between live ones and uneven final blocks."""
+    rng = np.random.default_rng(9)
+    _compare(
+        build_case(
+            rng,
+            seq_specs=[(1100, 1), (2047, 1), (1025, 1)],
+            num_pages=300,
+        )
+    )
+
+
+def test_cross_seq_prefetch_with_empty_seq():
+    rng = np.random.default_rng(10)
+    # A zero-length sequence in the middle: the prefetch chain must skip
+    # it without unbalancing DMA starts/waits.
+    _compare(
+        build_case(
+            rng,
+            seq_specs=[(1500, 1), (0, 0), (1100, 1)],
+            num_pages=300,
+        )
+    )

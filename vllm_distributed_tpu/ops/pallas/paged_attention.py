@@ -74,10 +74,12 @@ def _kernel(
     group_size: int,
     num_kv_heads: int,
     q_tokens_per_blk: int,
+    cross_seq_prefetch: bool,
 ):
     s = pl.program_id(0)
     qb = pl.program_id(1)
     kvb = pl.program_id(2)
+    num_seqs = pl.num_programs(0)
     num_kvb = pl.num_programs(2)
     blk = pages_per_blk * page_size
     seq_len = seq_lens_ref[s]
@@ -88,11 +90,12 @@ def _kernel(
     def is_active(b):
         return (b * blk < seq_len) & (b * blk <= q_pos_max)
 
-    def block_dma(block_idx, buf):
+    def block_dma(block_idx, buf, seq=None):
         """One DMA per page, each covering every head: [page, Hkv, D]."""
+        seq = s if seq is None else seq
         copies = []
         for i in range(pages_per_blk):
-            page = block_tables_ref[s, block_idx * pages_per_blk + i]
+            page = block_tables_ref[seq, block_idx * pages_per_blk + i]
             copies.append(
                 pltpu.make_async_copy(
                     k_pages_ref.at[page],
@@ -114,9 +117,15 @@ def _kernel(
         m_scr[:] = jnp.full_like(m_scr, _MASK)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
-        # First block of this (seq, q-block): synchronous prefetch start
-        # (block 0 is active whenever the sequence is non-empty).
-        @pl.when(seq_len > 0)
+        # First block of this (seq, q-block): start its DMA here unless a
+        # previous grid slice already prefetched it (cross-seq mode).
+        first_cond = (
+            (seq_len > 0) & (s == 0)
+            if cross_seq_prefetch
+            else (seq_len > 0)
+        )
+
+        @pl.when(first_cond)
         def _():
             for cp in block_dma(0, 0):
                 cp.start()
@@ -177,6 +186,23 @@ def _kernel(
             acc_scr[h] = acc_scr[h] * alpha + pv
             m_scr[h] = jnp.broadcast_to(m_new, m_scr[h].shape)
             l_scr[h] = jnp.broadcast_to(l_new, l_scr[h].shape)
+
+    if cross_seq_prefetch:
+        # Decode-shape fast path (one q block, >=2 kv blocks): start the
+        # NEXT sequence's block-0 DMA during this sequence's last kv
+        # step, hiding the per-sequence first-block latency that the
+        # sequential grid otherwise exposes.  Buffer-safety invariant:
+        # this block is emitted AFTER _compute in program order, so when
+        # the last active block index is even (buf 0 read in THIS step)
+        # the overwrite is ordered behind the read; num_qb == 1
+        # guarantees no later q block re-reads buf 0 for this sequence.
+        # Do NOT hoist above _compute.
+        @pl.when((kvb == num_kvb - 1) & (s + 1 < num_seqs))
+        def _prefetch_next_seq():
+            @pl.when(seq_lens_ref[s + 1] > 0)
+            def _():
+                for cp in block_dma(0, 0, seq=s + 1):
+                    cp.start()
 
     @pl.when(kvb == num_kvb - 1)
     def _finalize():
@@ -265,6 +291,10 @@ def paged_attention(
         group_size=g,
         num_kv_heads=hkv,
         q_tokens_per_blk=mq_blk,
+        # Cross-seq prefetch relies on intra-step ordering (the prefetch
+        # is emitted after _compute) plus single-q-block grids; >= 2 kv
+        # blocks so the same step never waits on the buffer it refills.
+        cross_seq_prefetch=(num_qb == 1 and num_kvb >= 2),
     )
     out_grouped = pl.pallas_call(
         kernel,
