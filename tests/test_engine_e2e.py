@@ -218,3 +218,25 @@ def test_kv_cache_dtype_honored(tiny_llama):
     assert runner.kv_caches[0][0].dtype == jnp.bfloat16
     toks = _run_greedy(engine, [[1, 5, 9, 23]], max_tokens=4)[0]
     assert len(toks) == 4
+
+
+def test_qwen2_greedy_matches_hf(tmp_path):
+    """Attention-bias variant (Qwen2) vs transformers."""
+    from tests.utils import make_tiny_qwen2
+
+    model_dir = make_tiny_qwen2(str(tmp_path / "q2"))
+    prompt = [1, 5, 9, 23, 77]
+    expected = hf_greedy_generate(model_dir, prompt, 8)
+    got = _run_greedy(_make_engine(model_dir), [prompt])[0]
+    assert got == expected
+
+
+def test_qwen3_greedy_matches_hf(tmp_path):
+    """Per-head QK RMS-norm variant (Qwen3 dense) vs transformers."""
+    from tests.utils import make_tiny_qwen3
+
+    model_dir = make_tiny_qwen3(str(tmp_path / "q3"))
+    prompt = [2, 4, 8, 16, 32]
+    expected = hf_greedy_generate(model_dir, prompt, 8)
+    got = _run_greedy(_make_engine(model_dir), [prompt])[0]
+    assert got == expected

@@ -31,11 +31,18 @@ def make_tiny_llama(
     max_pos: int = 512,
     tie_embeddings: bool = False,
     seed: int = 0,
+    arch: str = "LlamaForCausalLM",
+    model_type: str = "llama",
+    attn_bias: bool = False,
+    qk_norm: bool = False,
 ) -> str:
+    """One builder for the whole llama family: Qwen2 = + q/k/v biases,
+    Qwen3 dense = + per-head QK RMS-norm (the same flags the model code
+    derives from model_type, models/llama.py)."""
     head_dim = hidden // heads
     cfg = {
-        "architectures": ["LlamaForCausalLM"],
-        "model_type": "llama",
+        "architectures": [arch],
+        "model_type": model_type,
         "hidden_size": hidden,
         "intermediate_size": intermediate,
         "num_hidden_layers": layers,
@@ -78,6 +85,21 @@ def make_tiny_llama(
                 hidden, np.float32
             ),
         }
+        if attn_bias:
+            tensors |= {
+                p + "self_attn.q_proj.bias": w(heads * head_dim, scale=0.02),
+                p + "self_attn.k_proj.bias": w(
+                    kv_heads * head_dim, scale=0.02
+                ),
+                p + "self_attn.v_proj.bias": w(
+                    kv_heads * head_dim, scale=0.02
+                ),
+            }
+        if qk_norm:
+            tensors |= {
+                p + "self_attn.q_norm.weight": 1.0 + w(head_dim, scale=0.1),
+                p + "self_attn.k_norm.weight": 1.0 + w(head_dim, scale=0.1),
+            }
     os.makedirs(tmpdir, exist_ok=True)
     with open(os.path.join(tmpdir, "config.json"), "w") as f:
         json.dump(cfg, f)
@@ -369,3 +391,23 @@ def hf_logits(model_dir: str, prompt_ids: list[int]):
     with torch.no_grad():
         out = model(torch.tensor([prompt_ids]))
     return out.logits[0].numpy()
+
+
+def make_tiny_qwen2(tmpdir: str, **kw) -> str:
+    """Qwen2: the llama block plus q/k/v biases (attention_bias path)."""
+    kw.setdefault("seed", 11)
+    return make_tiny_llama(
+        tmpdir, arch="Qwen2ForCausalLM", model_type="qwen2",
+        attn_bias=True, **kw,
+    )
+
+
+def make_tiny_qwen3(tmpdir: str, **kw) -> str:
+    """Qwen3 dense: per-head QK RMS-norm, no attention biases."""
+    kw.setdefault("seed", 12)
+    return make_tiny_llama(
+        tmpdir, arch="Qwen3ForCausalLM", model_type="qwen3",
+        qk_norm=True, **kw,
+    )
+
+
