@@ -43,6 +43,7 @@ def _check_kernels() -> str:
 
     from vllm_distributed_tpu.ops.attention import (
         AttentionMetadata,
+        merge_kv_pages,
         paged_attention_reference,
         write_kv_pages,
     )
@@ -75,11 +76,16 @@ def _check_kernels() -> str:
         logits_indices=jnp.zeros(s_pad, jnp.int32),
         chunk_starts=jnp.asarray([36, 13, 0, 0], jnp.int32),
     )
+    kv_pages = merge_kv_pages(k_pages, v_pages)
     got = np.asarray(
-        paged_attention(q, k_pages, v_pages, meta, scale=0.125, max_q=8)
+        paged_attention(
+            q, kv_pages, meta, scale=0.125, num_kv_heads=hkv, max_q=8
+        )
     )
     want = np.asarray(
-        paged_attention_reference(q, k_pages, v_pages, meta, scale=0.125)
+        paged_attention_reference(
+            q, kv_pages, meta, scale=0.125, num_kv_heads=hkv
+        )
     )
     # TPU f32 dots truncate to bf16 on the MXU by default, and the two
     # paths round differently (flash online-softmax vs direct), so the
@@ -112,11 +118,16 @@ def _check_kernels() -> str:
         logits_indices=jnp.zeros(s2, jnp.int32),
         chunk_starts=jnp.asarray(np.maximum(lens2 - 1, 0)),
     )
+    kv2 = merge_kv_pages(k2, v2)
     got2 = np.asarray(
-        paged_attention(q2, k2, v2, meta2, scale=0.125, max_q=1)
+        paged_attention(
+            q2, kv2, meta2, scale=0.125, num_kv_heads=hkv, max_q=1
+        )
     )
     want2 = np.asarray(
-        paged_attention_reference(q2, k2, v2, meta2, scale=0.125)
+        paged_attention_reference(
+            q2, kv2, meta2, scale=0.125, num_kv_heads=hkv
+        )
     )
     live = np.array([0, 1, 3])
     err2 = float(np.max(np.abs(got2[live] - want2[live])))
@@ -131,13 +142,10 @@ def _check_kernels() -> str:
     kq = jnp.asarray(rng.normal(size=(t, hkv, d)), jnp.float32)
     vq = jnp.asarray(rng.normal(size=(t, hkv, d)), jnp.float32)
     slots = jnp.asarray(rng.permutation(pages * page)[:t], jnp.int32)
-    # Oracle first: kv_update aliases (donates) the pool buffers.
-    want_k, want_v = write_kv_pages(k_pages, v_pages, kq, vq, slots)
-    got_k, got_v = kv_update(k_pages, v_pages, kq, vq, slots)
-    kv_err = max(
-        float(np.max(np.abs(np.asarray(got_k) - np.asarray(want_k)))),
-        float(np.max(np.abs(np.asarray(got_v) - np.asarray(want_v)))),
-    )
+    # Oracle first: kv_update aliases (donates) the pool buffer.
+    want_kv = write_kv_pages(kv_pages, kq, vq, slots)
+    got_kv = kv_update(kv_pages, kq, vq, slots)
+    kv_err = float(np.max(np.abs(np.asarray(got_kv) - np.asarray(want_kv))))
     if kv_err > 0:
         raise AssertionError(f"kv_update mismatch on chip: max err {kv_err}")
 
@@ -288,14 +296,14 @@ def _measure(engine, build, free_engine, *, batch, k_steps, quant,
         pages_pad = runner._pages_bucket(
             -(-mean_ctx // runner.page_size)
         )
+        from vllm_distributed_tpu.ops.attention import kv_pool_width
+
         m = runner.model
-        d_pad = -(-m.head_dim // 128) * 128  # lane-padded head dim
         kv_read_bytes = (
             batch
             * pages_pad
             * runner.page_size
-            * m.num_kv_heads
-            * d_pad
+            * kv_pool_width(m.num_kv_heads, m.head_dim)
             * 2  # K and V
             * jax.numpy.dtype(runner.kv_cache_dtype()).itemsize
             * m.num_layers

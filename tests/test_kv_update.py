@@ -6,43 +6,46 @@ and real Mosaic can diverge, so the bench also re-checks on-chip)."""
 import jax.numpy as jnp
 import numpy as np
 
-from vllm_distributed_tpu.ops.attention import write_kv_pages
+from vllm_distributed_tpu.ops.attention import (
+    kv_pool_shape,
+    split_kv_pages,
+    write_kv_pages,
+)
 from vllm_distributed_tpu.ops.pallas.kv_update import kv_update
 
 
-def _case(rng, *, t, hkv, d_in, d_pool, num_pages=8, page_size=16, slots=None):
-    k_pages = jnp.asarray(
-        rng.standard_normal((num_pages, page_size, hkv, d_pool)), jnp.float32
+def _case(rng, *, t, hkv, d, num_pages=8, page_size=16, slots=None):
+    kv_pages = jnp.asarray(
+        rng.standard_normal(kv_pool_shape(num_pages, page_size, hkv, d)),
+        jnp.float32,
     )
-    v_pages = jnp.asarray(
-        rng.standard_normal((num_pages, page_size, hkv, d_pool)), jnp.float32
-    )
-    k = jnp.asarray(rng.standard_normal((t, hkv, d_in)), jnp.float32)
-    v = jnp.asarray(rng.standard_normal((t, hkv, d_in)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((t, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((t, hkv, d)), jnp.float32)
     if slots is None:
         slots = rng.choice(num_pages * page_size, size=t, replace=False)
     slots = jnp.asarray(np.asarray(slots, np.int32))
-    return k_pages, v_pages, k, v, slots
+    return kv_pages, k, v, slots
 
 
 def _compare(case):
-    k_pages, v_pages, k, v, slots = case
-    ref_k, ref_v = write_kv_pages(k_pages, v_pages, k, v, slots)
-    got_k, got_v = kv_update(k_pages, v_pages, k, v, slots, interpret=True)
-    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(ref_k))
-    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(ref_v))
+    kv_pages, k, v, slots = case
+    ref = write_kv_pages(kv_pages, k, v, slots)
+    got = kv_update(kv_pages, k, v, slots, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
 
 def test_basic_scatter():
     rng = np.random.default_rng(0)
-    _compare(_case(rng, t=16, hkv=2, d_in=64, d_pool=64))
+    _compare(_case(rng, t=16, hkv=2, d=64))
 
 
-def test_lane_padded_pool():
-    # Pool head dim lane-padded to 128 while the model head dim is 64:
-    # the writer must zero-pad incoming rows (model_runner layout).
+def test_sub_tile_width_pool():
+    # hkv*d = 64 < the 128-lane tile: the unpadded flat pool still
+    # round-trips through the writer (tiny-model / per-shard shapes).
     rng = np.random.default_rng(1)
-    _compare(_case(rng, t=8, hkv=4, d_in=64, d_pool=128))
+    kv_pages, k, v, slots = _case(rng, t=8, hkv=1, d=64)
+    assert kv_pages.shape[-1] == 64
+    _compare((kv_pages, k, v, slots))
 
 
 def test_duplicate_slots():
@@ -53,12 +56,12 @@ def test_duplicate_slots():
     # of its candidate rows.
     rng = np.random.default_rng(2)
     slots = [5, 5, 5, 17, 17, 3, 0, 0]
-    k_pages, v_pages, k, v, slots_j = _case(
-        rng, t=8, hkv=2, d_in=64, d_pool=64, slots=slots
-    )
-    page_size = k_pages.shape[1]
-    ref_k, _ = write_kv_pages(k_pages, v_pages, k, v, slots_j)
-    got_k, got_v = kv_update(k_pages, v_pages, k, v, slots_j, interpret=True)
+    kv_pages, k, v, slots_j = _case(rng, t=8, hkv=2, d=64, slots=slots)
+    page_size = kv_pages.shape[2]
+    ref = write_kv_pages(kv_pages, k, v, slots_j)
+    got = kv_update(kv_pages, k, v, slots_j, interpret=True)
+    ref_k, _ = split_kv_pages(ref, 2, 64)
+    got_k, got_v = split_kv_pages(got, 2, 64)
     got_k, got_v = np.asarray(got_k), np.asarray(got_v)
     k_np, v_np = np.asarray(k), np.asarray(v)
     for slot in set(slots):
@@ -79,19 +82,15 @@ def test_duplicate_slots():
 
 def test_single_token_decode_shape():
     rng = np.random.default_rng(3)
-    _compare(_case(rng, t=1, hkv=8, d_in=128, d_pool=128))
+    _compare(_case(rng, t=1, hkv=8, d=128))
 
 
 def test_bfloat16_pool_casts_inputs():
     rng = np.random.default_rng(4)
-    k_pages, v_pages, k, v, slots = _case(rng, t=4, hkv=2, d_in=64, d_pool=64)
-    k_pages = k_pages.astype(jnp.bfloat16)
-    v_pages = v_pages.astype(jnp.bfloat16)
-    ref_k, ref_v = write_kv_pages(k_pages, v_pages, k, v, slots)
-    got_k, got_v = kv_update(k_pages, v_pages, k, v, slots, interpret=True)
+    kv_pages, k, v, slots = _case(rng, t=4, hkv=2, d=64)
+    kv_pages = kv_pages.astype(jnp.bfloat16)
+    ref = write_kv_pages(kv_pages, k, v, slots)
+    got = kv_update(kv_pages, k, v, slots, interpret=True)
     np.testing.assert_array_equal(
-        np.asarray(got_k, np.float32), np.asarray(ref_k, np.float32)
-    )
-    np.testing.assert_array_equal(
-        np.asarray(got_v, np.float32), np.asarray(ref_v, np.float32)
+        np.asarray(got, np.float32), np.asarray(ref, np.float32)
     )

@@ -9,6 +9,7 @@ import pytest
 
 from vllm_distributed_tpu.ops.attention import (
     AttentionMetadata,
+    merge_kv_pages,
     paged_attention_reference,
 )
 from vllm_distributed_tpu.ops.pallas.paged_attention import paged_attention
@@ -42,6 +43,7 @@ def build_case(
     v_pages = jnp.asarray(
         rng.standard_normal((num_pages, page_size, hkv, d)), dtype
     )
+    kv_pages = merge_kv_pages(k_pages, v_pages)
     q = jnp.asarray(rng.standard_normal((t_pad, hq, d)), dtype)
 
     seq_ids = np.full(t_pad, s_pad, np.int32)
@@ -76,14 +78,17 @@ def build_case(
     )
     max_q = max(c for _, c in seq_specs)
     max_q = 1 << (max_q - 1).bit_length() if max_q > 1 else 1
-    return q, k_pages, v_pages, meta, max_q, cursor
+    return q, kv_pages, meta, max_q, cursor, hkv
 
 
 def _compare(case, scale=0.125, atol=2e-5):
-    q, k_pages, v_pages, meta, max_q, t_real = case
-    ref = paged_attention_reference(q, k_pages, v_pages, meta, scale=scale)
+    q, kv_pages, meta, max_q, t_real, hkv = case
+    ref = paged_attention_reference(
+        q, kv_pages, meta, scale=scale, num_kv_heads=hkv
+    )
     got = paged_attention(
-        q, k_pages, v_pages, meta, scale=scale, max_q=max_q, interpret=True
+        q, kv_pages, meta, scale=scale, num_kv_heads=hkv,
+        max_q=max_q, interpret=True,
     )
     np.testing.assert_allclose(
         np.asarray(got[:t_real]),
@@ -158,12 +163,15 @@ def test_long_context_multiblock():
 
 def test_bfloat16_cache():
     rng = np.random.default_rng(9)
-    q, k, v, meta, max_q, t_real = build_case(
+    q, kv, meta, max_q, t_real, hkv = build_case(
         rng, seq_specs=[(40, 4), (21, 1)], dtype=jnp.bfloat16
     )
-    ref = paged_attention_reference(q, k, v, meta, scale=0.125)
+    ref = paged_attention_reference(
+        q, kv, meta, scale=0.125, num_kv_heads=hkv
+    )
     got = paged_attention(
-        q, k, v, meta, scale=0.125, max_q=max_q, interpret=True
+        q, kv, meta, scale=0.125, num_kv_heads=hkv,
+        max_q=max_q, interpret=True,
     )
     np.testing.assert_allclose(
         np.asarray(got[:t_real], np.float32),

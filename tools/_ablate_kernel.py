@@ -1,0 +1,360 @@
+"""Pallas ragged paged-attention kernel — the TPU hot path.
+
+The TPU-native replacement for the CUDA PagedAttention/FlashAttention
+kernels the reference inherits from the vLLM image (SURVEY.md §2.2 and
+BASELINE.json north_star: "PagedAttention is a Pallas kernel").  One
+kernel serves both decode (1 query token/seq) and chunked prefill
+(many): queries are grouped per sequence and attention runs flash-style
+(online softmax) over the sequence's paged KV.
+
+Design (tuned for DMA efficiency + VMEM budget on v5e):
+- grid = (S, q_blocks, kv_blocks): kv blocks iterate innermost so the
+  flash state (m, l, acc) lives in VMEM scratch across kv steps; q
+  blocks tile long prefill chunks so scratch fits VMEM.
+- All KV heads are processed inside one program, so each page is ONE
+  contiguous [page_size, Hkv, D] DMA from HBM instead of per-head
+  slivers.  KV pool layout is slot-major ``[P, page, Hkv, D]``
+  (ops/attention.py): `.at[page]` is a major-dim slice, and the same
+  layout lets the in-place Pallas writer (kv_update.py) target single
+  token rows.
+- Double buffering: program (s, qb, b) waits for the block prefetched
+  by (s, qb, b-1) and prefetches block b+1, overlapping DMA + compute.
+- Causal skip: kv blocks entirely above the q block's last position are
+  skipped (no DMA, no compute) — half the work on prefill.
+- Queries are pre-grouped to [S, Hkv, maxq × G, D] (GQA groups share
+  their KV head's program); q-block rows ≥ 8 (f32 sublane tile).
+
+Numerics: scores/softmax/accumulation in float32 regardless of cache
+dtype; output cast back to q.dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from vllm_distributed_tpu.ops.attention import AttentionMetadata
+from vllm_distributed_tpu.utils import cdiv, next_power_of_2
+
+import os
+SKIP_COMPUTE = os.environ.get("ABL_SKIP_COMPUTE") == "1"
+SKIP_DMA = os.environ.get("ABL_SKIP_DMA") == "1"
+
+
+_MASK = -0.7 * float(jnp.finfo(jnp.float32).max)
+_LANES = 128
+# Per-buffer VMEM budget for each of K and V (bytes).
+_KV_BUF_BYTES = 512 * 1024
+# Budget for the f32 flash state (m, l, acc across all heads).
+_STATE_BYTES = 6 * 1024 * 1024
+
+
+def _kernel(
+    # scalar prefetch
+    block_tables_ref,  # [S, max_pages] int32 (SMEM)
+    seq_lens_ref,  # [S] int32
+    chunk_starts_ref,  # [S] int32
+    # inputs
+    q_ref,  # [1, Hkv, QROWS, D] VMEM block
+    k_pages_ref,  # [P, page, Hkv, D] in HBM/ANY
+    v_pages_ref,
+    # outputs
+    out_ref,  # [1, Hkv, QROWS, D] VMEM block
+    # scratch
+    k_vmem,  # [2, BLK, Hkv, D]
+    v_vmem,  # [2, BLK, Hkv, D]
+    m_scr,  # [Hkv, QROWS, LANES] f32
+    l_scr,  # [Hkv, QROWS, LANES] f32
+    acc_scr,  # [Hkv, QROWS, D] f32
+    sems,  # DMA sems [2, 2]  (k/v × buffer)
+    *,
+    scale: float,
+    soft_cap: float | None,
+    page_size: int,
+    pages_per_blk: int,
+    group_size: int,
+    num_kv_heads: int,
+    q_tokens_per_blk: int,
+    cross_seq_prefetch: bool,
+):
+    s = pl.program_id(0)
+    qb = pl.program_id(1)
+    kvb = pl.program_id(2)
+    num_seqs = pl.num_programs(0)
+    num_kvb = pl.num_programs(2)
+    blk = pages_per_blk * page_size
+    seq_len = seq_lens_ref[s]
+    chunk_start = chunk_starts_ref[s]
+    # Last absolute position any query row of this q block can hold.
+    q_pos_max = chunk_start + (qb + 1) * q_tokens_per_blk - 1
+
+    def is_active(b):
+        return (b * blk < seq_len) & (b * blk <= q_pos_max)
+
+    def block_dma(block_idx, buf, seq=None):
+        """One DMA per page, each covering every head: [page, Hkv, D]."""
+        seq = s if seq is None else seq
+        copies = []
+        if SKIP_DMA:
+            return copies
+        for i in range(pages_per_blk):
+            page = block_tables_ref[seq, block_idx * pages_per_blk + i]
+            copies.append(
+                pltpu.make_async_copy(
+                    k_pages_ref.at[page],
+                    k_vmem.at[buf, pl.ds(i * page_size, page_size)],
+                    sems.at[0, buf],
+                )
+            )
+            copies.append(
+                pltpu.make_async_copy(
+                    v_pages_ref.at[page],
+                    v_vmem.at[buf, pl.ds(i * page_size, page_size)],
+                    sems.at[1, buf],
+                )
+            )
+        return copies
+
+    @pl.when(kvb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _MASK)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        # First block of this (seq, q-block): start its DMA here unless a
+        # previous grid slice already prefetched it (cross-seq mode).
+        first_cond = (
+            (seq_len > 0) & (s == 0)
+            if cross_seq_prefetch
+            else (seq_len > 0)
+        )
+
+        @pl.when(first_cond)
+        def _():
+            for cp in block_dma(0, 0):
+                cp.start()
+
+    block_start = kvb * blk
+    active = is_active(kvb) & (seq_len > 0)
+
+    # Prefetch the next block while this one computes.
+    @pl.when(active & (kvb + 1 < num_kvb) & is_active(kvb + 1))
+    def _prefetch():
+        for cp in block_dma(kvb + 1, (kvb + 1) % 2):
+            cp.start()
+
+    @pl.when(active)
+    def _compute():
+        buf = kvb % 2
+        for cp in block_dma(kvb, buf):
+            cp.wait()
+        if SKIP_COMPUTE:
+            return
+        rows = acc_scr.shape[1]
+        row_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, blk), 0)
+        col_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, blk), 1)
+        q_pos = (
+            chunk_start
+            + qb * q_tokens_per_blk
+            + row_ids // group_size
+        )
+        c_pos = block_start + col_ids
+        mask = (c_pos <= q_pos) & (c_pos < seq_len)
+
+        for h in range(num_kv_heads):
+            q = q_ref[0, h].astype(jnp.float32)  # [QROWS, D]
+            k = k_vmem[buf, :, h, :].astype(jnp.float32)  # [BLK, D]
+            v = v_vmem[buf, :, h, :].astype(jnp.float32)
+            scores = (
+                jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )  # [QROWS, BLK]
+            if soft_cap is not None:
+                scores = jnp.tanh(scores / soft_cap) * soft_cap
+            scores = jnp.where(mask, scores, _MASK)
+
+            m_prev = m_scr[h, :, 0:1]
+            m_cur = jnp.max(scores, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(scores - m_new)
+            p = jnp.where(mask, p, 0.0)
+            l_new = l_scr[h, :, 0:1] * alpha + jnp.sum(
+                p, axis=-1, keepdims=True
+            )
+            pv = jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_scr[h] = acc_scr[h] * alpha + pv
+            m_scr[h] = jnp.broadcast_to(m_new, m_scr[h].shape)
+            l_scr[h] = jnp.broadcast_to(l_new, l_scr[h].shape)
+
+    if cross_seq_prefetch:
+        # Decode-shape fast path (one q block, >=2 kv blocks): start the
+        # NEXT sequence's block-0 DMA during this sequence's last kv
+        # step, hiding the per-sequence first-block latency that the
+        # sequential grid otherwise exposes.  Buffer-safety invariant:
+        # this block is emitted AFTER _compute in program order, so when
+        # the last active block index is even (buf 0 read in THIS step)
+        # the overwrite is ordered behind the read; num_qb == 1
+        # guarantees no later q block re-reads buf 0 for this sequence.
+        # Do NOT hoist above _compute.
+        @pl.when((kvb == num_kvb - 1) & (s + 1 < num_seqs))
+        def _prefetch_next_seq():
+            @pl.when(seq_lens_ref[s + 1] > 0)
+            def _():
+                for cp in block_dma(0, 0, seq=s + 1):
+                    cp.start()
+
+    @pl.when(kvb == num_kvb - 1)
+    def _finalize():
+        for h in range(num_kv_heads):
+            denom = jnp.maximum(l_scr[h, :, 0:1], 1e-30)
+            out_ref[0, h] = (acc_scr[h] / denom).astype(out_ref.dtype)
+
+
+def _pow2_floor(x: int) -> int:
+    return 1 << (max(x, 1).bit_length() - 1)
+
+
+def paged_attention(
+    q: jax.Array,  # [T, Hq, D] flat
+    k_pages: jax.Array,  # [P, page, Hkv, D]
+    v_pages: jax.Array,
+    metadata: AttentionMetadata,
+    *,
+    scale: float,
+    soft_cap: float | None = None,
+    max_q: int = 1,
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in for paged_attention_reference (same contract), running the
+    flash kernel.  `max_q` is the static per-sequence query bound for this
+    step (the runner's padded max chunk length)."""
+    t, hq, d_q = q.shape
+    p_total, page_size, hkv, d = k_pages.shape
+    s, max_pages = metadata.block_tables.shape
+    g = hq // hkv
+    if d > d_q:
+        # Lane-padded pool (see write_kv_pages): pad q to match; padded
+        # lanes are zero on both sides so scores/outputs are unchanged.
+        q = jnp.pad(q, [(0, 0), (0, 0), (0, d - d_q)])
+
+    # maxq padded so total rows are at least the 8-row sublane tile.
+    maxq = next_power_of_2(max_q)
+    while maxq * g < 8:
+        maxq *= 2
+
+    # Tile q into blocks whose f32 flash state fits the VMEM budget.
+    state_per_row = hkv * (2 * _LANES + d) * 4
+    qrows_cap = max(_pow2_floor(_STATE_BYTES // state_per_row), 8)
+    mq_blk = maxq
+    while mq_blk * g > qrows_cap and (mq_blk // 2) * g >= 8:
+        mq_blk //= 2
+    num_qb = maxq // mq_blk
+    qrows = mq_blk * g
+
+    # ---- group flat queries per sequence ----
+    # Padding tokens carry q_seq_ids == S (one past the end); route their
+    # scatter to an out-of-bounds column so it is DROPPED instead of
+    # clobbering a real row (scatter drops OOB updates under jit).
+    valid = metadata.q_seq_ids < s
+    seq_idx = jnp.minimum(metadata.q_seq_ids, s - 1)
+    tok_in_chunk = metadata.q_positions - metadata.chunk_starts[seq_idx]
+    col = jnp.where(valid, tok_in_chunk, maxq)
+    q_grouped = jnp.zeros((s, maxq, hq, d), q.dtype)
+    q_grouped = q_grouped.at[seq_idx, col].set(q, mode="drop")
+    # [S, maxq, Hkv, G, D] -> [S, Hkv, maxq*G, D], row r = m*G + g.
+    q_grouped = q_grouped.reshape(s, maxq, hkv, g, d).transpose(0, 2, 1, 3, 4)
+    q_grouped = q_grouped.reshape(s, hkv, maxq * g, d)
+
+    # ---- kv blocking: size blocks to the VMEM budget ----
+    kv_bytes_per_token = hkv * d * jnp.dtype(k_pages.dtype).itemsize
+    blk_tokens = max(_KV_BUF_BYTES // kv_bytes_per_token, page_size)
+    blk_tokens = min(_pow2_floor(blk_tokens), max_pages * page_size)
+    pages_per_blk = max(blk_tokens // page_size, 1)
+    num_kvb = cdiv(max_pages, pages_per_blk)
+    blk = pages_per_blk * page_size
+    if max_pages % pages_per_blk:
+        # Pad the table so block_dma never reads a page id out of bounds
+        # (padding pages are id 0 — a real page, masked out of scores).
+        pad = pages_per_blk - max_pages % pages_per_blk
+        block_tables = jnp.pad(metadata.block_tables, ((0, 0), (0, pad)))
+    else:
+        block_tables = metadata.block_tables
+
+    grid = (s, num_qb, num_kvb)
+    kernel = functools.partial(
+        _kernel,
+        scale=scale,
+        soft_cap=soft_cap,
+        page_size=page_size,
+        pages_per_blk=pages_per_blk,
+        group_size=g,
+        num_kv_heads=hkv,
+        q_tokens_per_blk=mq_blk,
+        # Cross-seq prefetch relies on intra-step ordering (the prefetch
+        # is emitted after _compute) plus single-q-block grids; >= 2 kv
+        # blocks so the same step never waits on the buffer it refills.
+        cross_seq_prefetch=(num_qb == 1 and num_kvb >= 2),
+    )
+    out_grouped = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, hkv, qrows, d),
+                    # Scalar-prefetch refs ride along after grid indices.
+                    lambda s_, qb_, b_, *refs: (s_, 0, qb_, 0),
+                ),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, hkv, qrows, d),
+                lambda s_, qb_, b_, *refs: (s_, 0, qb_, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((2, blk, hkv, d), k_pages.dtype),
+                pltpu.VMEM((2, blk, hkv, d), v_pages.dtype),
+                pltpu.VMEM((hkv, qrows, _LANES), jnp.float32),
+                pltpu.VMEM((hkv, qrows, _LANES), jnp.float32),
+                pltpu.VMEM((hkv, qrows, d), jnp.float32),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((s, hkv, maxq * g, d), q.dtype),
+        interpret=interpret,
+    )(
+        block_tables,
+        metadata.seq_lens,
+        metadata.chunk_starts,
+        q_grouped,
+        k_pages,
+        v_pages,
+    )
+
+    # ---- back to the flat layout ----
+    out = out_grouped.reshape(s, hkv, maxq, g, d).transpose(0, 2, 1, 3, 4)
+    out = out.reshape(s, maxq, hq, d)
+    return out[seq_idx, jnp.clip(tok_in_chunk, 0, maxq - 1), :, :d_q]
+
+
+paged_attention.needs_max_q = True
+
+
+def paged_attention_cpu(*args, **kwargs):
+    """Interpret-mode entry for CPU tests."""
+    return paged_attention(*args, interpret=True, **kwargs)
+
+
+paged_attention_cpu.needs_max_q = True

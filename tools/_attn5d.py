@@ -24,11 +24,10 @@ queues idled.  v2 changes, in order of impact:
   stays at the 128-lane alignment minimum.  The off-diagonal lanes are
   zeros, so scores are exact; outputs are extracted by diagonal einsum
   outside the kernel.
-- **Combined flat KV pool** ``[2, P, page, HD]`` (ops/attention.py):
-  two descriptors per page (K plane + V plane) cover all heads'
-  contiguous lanes, and a 64-wide head dim is stored unpadded inside
-  HD (the r3 layout padded each head to 128 lanes — 2× wasted bytes on
-  Llama-1B-class models).
+- **Combined flat KV pool** ``[P, 2, page, HD]`` (ops/attention.py):
+  one descriptor per page covers K and V for all heads, and a 64-wide
+  head dim is stored unpadded inside HD (the r3 layout padded each head
+  to 128 lanes — 2× wasted bytes on Llama-1B-class models).
 - **Globally rotating triple buffer.**  Buffer index = (number of
   active blocks completed so far) % 3, tracked in SMEM — never resets
   per sequence, so the cross-sequence block-0 prefetch can never target
@@ -59,9 +58,8 @@ _LANES = 128
 # Per-buffer-slot VMEM budget for the combined K+V block (bytes).
 _KV_BUF_BYTES = 1024 * 1024
 _NBUF = 3
-# Budget for the f32 flash state (acc + m + l) across all fold groups:
-# acc is hkv*g*mq*f*d*4 bytes and m+l add hkv*g*mq*256*4 (f-independent).
-_STATE_BYTES = 6 * 1024 * 1024
+# Budget for the f32 flash accumulator across all fold groups.
+_ACC_BYTES = 4 * 1024 * 1024
 # Decode-shape fold target: grow F until a block's softmax chain has at
 # least this many rows (amortizes VPU op issue over more elements).
 _ROWS_TARGET = 32
@@ -74,11 +72,11 @@ def _kernel(
     chunk_starts_ref,  # [S] int32
     # inputs
     q_ref,  # [1, 1, NF, ROWS, FD] VMEM block (block-diagonal queries)
-    kv_pages_ref,  # [2, P, page, HD] in HBM/ANY
+    kv_pages_ref,  # [P, 2, page, HD1, LANES] in HBM/ANY
     # outputs
     out_ref,  # [1, 1, NF, ROWS, FD] VMEM block
     # scratch
-    kv_vmem,  # [NBUF, 2, BLK, HD]
+    kv_vmem,  # [NBUF, 2, BLK, HD1, LANES]
     m_scr,  # [NF, ROWS, LANES] f32
     l_scr,  # [NF, ROWS, LANES] f32
     acc_scr,  # [NF, ROWS, FD] f32
@@ -117,21 +115,17 @@ def _kernel(
         cnt[1] = 0
 
     def block_dma(seq, block_idx, buf):
-        """Two descriptors per page (K plane, V plane), each covering
-        every head's lanes contiguously."""
+        """One descriptor per page, covering K AND V for every head."""
         copies = []
         for i in range(pages_per_blk):
             page = block_tables_ref[seq, block_idx * pages_per_blk + i]
-            for kvi in range(2):
-                copies.append(
-                    pltpu.make_async_copy(
-                        kv_pages_ref.at[kvi, page],
-                        kv_vmem.at[
-                            buf, kvi, pl.ds(i * page_size, page_size)
-                        ],
-                        sems.at[buf],
-                    )
+            copies.append(
+                pltpu.make_async_copy(
+                    kv_pages_ref.at[page],
+                    kv_vmem.at[buf, :, pl.ds(i * page_size, page_size)],
+                    sems.at[buf],
                 )
+            )
         return copies
 
     @pl.when(kvb == 0)
@@ -182,18 +176,20 @@ def _kernel(
         c_pos = block_start + col_ids
         mask = (c_pos <= q_pos) & (c_pos < seq_len)
 
+        lanes = kv_vmem.shape[-1]
+        f1 = fold_width // lanes
         for nf in range(num_fold):
-            lo = nf * fold_width
             qn = q_ref[0, 0, nf].astype(jnp.float32)  # [ROWS, FD]
-            k = kv_vmem[buf, 0, :, lo : lo + fold_width].astype(jnp.float32)
-            v = kv_vmem[buf, 1, :, lo : lo + fold_width].astype(jnp.float32)
-            scores = (
-                jax.lax.dot_general(
-                    qn, k, (((1,), (1,)), ((), ())),
+            scores = None
+            for j in range(f1):
+                kj = kv_vmem[buf, 0, :, nf * f1 + j, :].astype(jnp.float32)
+                sj = jax.lax.dot_general(
+                    qn[:, j * lanes : (j + 1) * lanes], kj,
+                    (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
-                * scale
-            )  # [ROWS, BLK]
+                scores = sj if scores is None else scores + sj
+            scores = scores * scale  # [ROWS, BLK]
             if soft_cap is not None:
                 scores = jnp.tanh(scores / soft_cap) * soft_cap
             scores = jnp.where(mask, scores, _MASK)
@@ -207,11 +203,14 @@ def _kernel(
             l_new = l_scr[nf, :, 0:1] * alpha + jnp.sum(
                 p, axis=-1, keepdims=True
             )
-            pv = jax.lax.dot_general(
-                p, v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            acc_scr[nf] = acc_scr[nf] * alpha + pv
+            for j in range(f1):
+                vj = kv_vmem[buf, 1, :, nf * f1 + j, :].astype(jnp.float32)
+                pv = jax.lax.dot_general(
+                    p, vj, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                sl = slice(j * lanes, (j + 1) * lanes)
+                acc_scr[nf, :, sl] = acc_scr[nf, :, sl] * alpha + pv
             m_scr[nf] = jnp.broadcast_to(m_new, m_scr[nf].shape)
             l_scr[nf] = jnp.broadcast_to(l_new, l_scr[nf].shape)
         cnt[0] = cnt[0] + 1
@@ -228,39 +227,29 @@ def _pow2_floor(x: int) -> int:
     return 1 << (max(x, 1).bit_length() - 1)
 
 
-def _state_bytes(hkv: int, g: int, mq: int, f: int, d: int) -> int:
-    """f32 flash state for one grid step: acc [NF, ROWS, F*D] plus
-    m/l [NF, ROWS, 128] each — NF*ROWS = hkv*g*mq regardless of F."""
-    return hkv * g * mq * (f * d + 2 * _LANES) * 4
-
-
-def _fold_align(hkv: int, d: int, hd_pad: int) -> int:
-    """Smallest legal fold factor: F*D must be a 128-lane multiple (so
-    the in-kernel lane slice is tile-aligned).  Returns hkv (single
-    group over the whole padded width) when alignment inside the head
-    count is impossible."""
-    if (hkv * d) % _LANES or hd_pad != hkv * d:
-        return hkv
-    f = 1
-    while (f * d) % _LANES:
-        f *= 2
-    return f if hkv % f == 0 else hkv
-
-
 def _pick_fold(hkv: int, d: int, hd_pad: int, g: int, mq_blk: int):
     """Fold factor F (heads per matmul), fold width (lanes), NF groups.
 
-    Constraints: F divides hkv; F*D is a multiple of 128 lanes; the
-    whole f32 flash state stays under _STATE_BYTES.  When hkv*D itself
+    Constraints: F divides hkv; F*D is a multiple of 128 lanes (so the
+    in-kernel lane slice is tile-aligned); the f32 accumulator
+    (hkv*g*mq_blk*F*D*4 bytes) stays under budget.  When hkv*D itself
     is not 128-aligned the whole (padded) width is one fold group.
     """
-    f = _fold_align(hkv, d, hd_pad)
-    if f == hkv:
+    if (hkv * d) % _LANES or hd_pad != hkv * d:
         return hkv, hd_pad, 1
+    f = 1
+    while (f * d) % _LANES:
+        f *= 2
+    if hkv % f:  # cannot align within the head count: single group
+        return hkv, hd_pad, 1
+
+    def acc_bytes(f_):
+        return hkv * g * mq_blk * f_ * d * 4
+
     while (
         f * g * mq_blk < _ROWS_TARGET
         and hkv % (2 * f) == 0
-        and _state_bytes(hkv, g, mq_blk, 2 * f, d) <= _STATE_BYTES
+        and acc_bytes(2 * f) <= _ACC_BYTES
     ):
         f *= 2
     return f, f * d, hkv // f
@@ -268,7 +257,7 @@ def _pick_fold(hkv: int, d: int, hd_pad: int, g: int, mq_blk: int):
 
 def paged_attention(
     q: jax.Array,  # [T, Hq, D] flat
-    kv_pages: jax.Array,  # [2, P, page, HD]
+    kv_pages: jax.Array,  # [P, 2, page, HD]
     metadata: AttentionMetadata,
     *,
     scale: float,
@@ -281,7 +270,8 @@ def paged_attention(
     flash kernel.  `max_q` is the static per-sequence query bound for this
     step (the runner's padded max chunk length)."""
     t, hq, d = q.shape
-    _, p_total, page_size, hd_pad = kv_pages.shape
+    p_total, _, page_size, hd1, lanes = kv_pages.shape
+    hd_pad = hd1 * lanes
     s, max_pages = metadata.block_tables.shape
     hkv = num_kv_heads if num_kv_heads is not None else hq
     g = hq // hkv
@@ -291,14 +281,10 @@ def paged_attention(
     while maxq * g * hkv < 8:
         maxq *= 2
 
-    # Split maxq into q blocks whose full f32 flash state (acc + m + l,
-    # at the alignment-minimum fold factor) fits the budget, then pick
-    # the head fold factor.
-    f_min = _fold_align(hkv, d, hd_pad)
+    # Split maxq into q blocks whose accumulator fits the budget, then
+    # pick the head fold factor.
     mq_blk = maxq
-    while (
-        _state_bytes(hkv, g, mq_blk, f_min, d) > _STATE_BYTES and mq_blk > 1
-    ):
+    while hkv * g * mq_blk * d * 4 > _ACC_BYTES and mq_blk > 1:
         mq_blk //= 2
     f, fd, nf = _pick_fold(hkv, d, hd_pad, g, mq_blk)
     while f * g * mq_blk < 8:  # tiny-model corner: widen the q block
@@ -376,7 +362,7 @@ def paged_attention(
                 lambda s_, qb_, b_, *refs: (s_, qb_, 0, 0, 0),
             ),
             scratch_shapes=[
-                pltpu.VMEM((_NBUF, 2, blk, hd_pad), kv_pages.dtype),
+                pltpu.VMEM((_NBUF, 2, blk, hd1, lanes), kv_pages.dtype),
                 pltpu.VMEM((nf, rows, _LANES), jnp.float32),
                 pltpu.VMEM((nf, rows, _LANES), jnp.float32),
                 pltpu.VMEM((nf, rows, fd), jnp.float32),

@@ -190,8 +190,10 @@ class LlamaForCausalLM(SupportsQuantization):
         return specs
 
     def kv_cache_spec(self) -> P:
-        """KV pages [P, page, Hkv, D]: shard kv heads over tp."""
-        return P(None, None, "tp", None)
+        """Combined KV pool [2, P, page, HD]: shard the flat head×dim
+        lanes over tp (heads are contiguous in HD, so this is per-kv-head
+        sharding)."""
+        return P(None, None, None, "tp")
 
     # ---- quantized projection fusion (single-chip fast path) ----
     _QKV_FUSE = ("wq", "wk", "wv")
@@ -276,7 +278,7 @@ class LlamaForCausalLM(SupportsQuantization):
         self,
         params: dict,
         token_ids: jax.Array,  # [T]
-        kv_caches: list,  # per layer (k_pages, v_pages)
+        kv_caches: list,  # per layer combined kv_pages [2, P, page, HD]
         meta: AttentionMetadata,
         attn_fn: Callable = paged_attention_reference,
         kv_write_fn: Callable = write_kv_pages,
@@ -291,7 +293,7 @@ class LlamaForCausalLM(SupportsQuantization):
         )
         new_kv = []
         t = token_ids.shape[0]
-        for layer, (k_pages, v_pages) in zip(params["layers"], kv_caches):
+        for layer, kv_pages in zip(params["layers"], kv_caches):
             h = rms_norm(x, layer["input_ln"], self.rms_eps)
             q, k, v = self._qkv(h, layer, t)
             if self.qk_norm:
@@ -299,11 +301,12 @@ class LlamaForCausalLM(SupportsQuantization):
                 k = rms_norm(k, layer["k_norm"], self.rms_eps)
             q = apply_rope(q, meta.q_positions, inv_freq)
             k = apply_rope(k, meta.q_positions, inv_freq)
-            k_pages, v_pages = kv_write_fn(
-                k_pages, v_pages, k, v, meta.slot_mapping
+            kv_pages = kv_write_fn(kv_pages, k, v, meta.slot_mapping)
+            new_kv.append(kv_pages)
+            attn = attn_fn(
+                q, kv_pages, meta,
+                scale=self.scale, num_kv_heads=self.num_kv_heads,
             )
-            new_kv.append((k_pages, v_pages))
-            attn = attn_fn(q, k_pages, v_pages, meta, scale=self.scale)
             x = x + linear(attn.reshape(t, -1), layer["wo"])
 
             h = rms_norm(x, layer["post_attn_ln"], self.rms_eps)

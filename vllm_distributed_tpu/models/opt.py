@@ -172,8 +172,8 @@ class OPTForCausalLM(SupportsQuantization):
         return specs
 
     def kv_cache_spec(self) -> P:
-        """KV pages [P, page, Hkv, D]: shard kv heads over tp."""
-        return P(None, None, "tp", None)
+        """Combined KV pool [2, P, page, HD]: shard flat head lanes."""
+        return P(None, None, None, "tp")
 
     def forward(
         self,
@@ -194,7 +194,7 @@ class OPTForCausalLM(SupportsQuantization):
         )
         x = x + pos
         new_kv = []
-        for layer, (k_pages, v_pages) in zip(params["layers"], kv_caches):
+        for layer, kv_pages in zip(params["layers"], kv_caches):
             h = (
                 layer_norm(x, layer["attn_ln_w"], layer["attn_ln_b"], self.eps)
                 if self.do_layer_norm_before
@@ -209,11 +209,12 @@ class OPTForCausalLM(SupportsQuantization):
             v = linear(h, layer["wv"], layer["bv"]).reshape(
                 t, self.num_kv_heads, self.head_dim
             )
-            k_pages, v_pages = kv_write_fn(
-                k_pages, v_pages, k, v, meta.slot_mapping
+            kv_pages = kv_write_fn(kv_pages, k, v, meta.slot_mapping)
+            new_kv.append(kv_pages)
+            attn = attn_fn(
+                q, kv_pages, meta,
+                scale=self.scale, num_kv_heads=self.num_kv_heads,
             )
-            new_kv.append((k_pages, v_pages))
-            attn = attn_fn(q, k_pages, v_pages, meta, scale=self.scale)
             x = x + linear(attn.reshape(t, -1), layer["wo"], layer["bo"])
             if not self.do_layer_norm_before:
                 x = layer_norm(

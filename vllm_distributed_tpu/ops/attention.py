@@ -5,23 +5,33 @@ reference inherits from the vLLM image (SURVEY.md §2.2).  The layout
 contract shared by the allocator (engine/block_manager.py), the model
 runner's KV scatter, and the kernels:
 
-- KV pool: ``k_pages``/``v_pages`` of shape ``[num_pages, page_size,
-  num_kv_heads, head_dim]`` — slot-major so (a) one token's K/V row
-  ``[Hkv, D]`` is a tile-aligned single DMA target (the in-place Pallas
-  writer needs single-slot writes; Mosaic only allows full-tile slices
-  of the minor-two dims), and (b) a page is one contiguous
-  ``[page_size, Hkv, D]`` DMA for the attention kernel.  Token ``t`` of
-  a request lives at flat slot ``page_ids[t // page_size] * page_size +
-  t % page_size``.
+- KV pool: ONE combined array per layer of shape ``[2, num_pages,
+  page_size, HD]`` — dim 0 is K/V, dims 1-2 address the token slot,
+  and ``HD = num_kv_heads * head_dim`` is the flat head×dim lane axis.
+  Rationale (measured on v5e, see PERF.md):
+    * heads are stored unpadded and contiguous in HD, so the attention
+      kernel computes on ``[BLK, F*D]`` tiles with ONE matmul + softmax
+      chain per fold group instead of per-head slivers (the r3 kernel
+      was compute-bound on those), and a 64-wide head model (Llama-1B
+      class) no longer pays the 2× lane-padding tax of a per-head
+      ``[..., Hkv, 128]`` layout;
+    * a page is ``.at[kv, page]`` — a slice of the two MAJOR dims, so
+      the kernel fetches it as one contiguous ``[page, HD]`` DMA per
+      K/V plane.  (Mosaic cannot slice single rows of the tiled
+      (page_size, HD) minor pair, which is why the decode-path writer
+      uses XLA dynamic_update_slice instead of a DMA kernel —
+      ops/pallas/kv_update.py);
+    * token ``t`` of a request lives at page ``page_ids[t //
+      page_size]``, row ``t % page_size``.
 - A step's work is a flat token batch ``[T]`` spanning mixed prefill
-  chunks and decodes; ``q_seq_ids``/``q_positions`` say which sequence and
-  absolute position each query token has.
+  chunks and decodes; ``q_seq_ids``/``q_positions`` say which sequence
+  and absolute position each query token has.
 
 Everything is static-shape and jit-friendly: padding tokens carry
-``q_seq_ids`` pointing at padded sequence rows whose ``seq_lens`` is 0, so
-their attention rows are garbage that is never read.  The fast path is the
-Pallas kernel in ops/pallas/; this reference is the correctness oracle
-(tested against each other, SURVEY.md §4.2) and the CPU fallback.
+``q_seq_ids`` pointing at padded sequence rows whose ``seq_lens`` is 0,
+so their attention rows are garbage that is never read.  The fast path
+is the Pallas kernel in ops/pallas/; this reference is the correctness
+oracle (tested against each other, SURVEY.md §4.2) and the CPU fallback.
 """
 
 from __future__ import annotations
@@ -33,6 +43,55 @@ import jax
 import jax.numpy as jnp
 
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def kv_pool_width(num_kv_heads: int, head_dim: int) -> int:
+    """Flat lane width HD of the combined pool.
+
+    No padding: every production shape (heads × 64/128-wide dims) is
+    already a multiple of the 128-lane tile, and padding would both
+    waste bytes and break per-head TP sharding of the flat lane axis
+    for sub-128 test shapes (the pad would land in the last shard
+    instead of spreading per head).  The Pallas kernel's single-fold
+    fallback handles non-128-multiple widths.
+    """
+    return num_kv_heads * head_dim
+
+
+def kv_pool_shape(
+    num_pages: int, page_size: int, num_kv_heads: int, head_dim: int
+) -> tuple[int, int, int, int]:
+    return (
+        2,
+        num_pages,
+        page_size,
+        kv_pool_width(num_kv_heads, head_dim),
+    )
+
+
+def split_kv_pages(
+    kv_pages: jax.Array, num_kv_heads: int, head_dim: int
+) -> tuple[jax.Array, jax.Array]:
+    """Views of the combined pool as per-head [P, page, Hkv, D] K and V."""
+    _, p, page, hd = kv_pages.shape
+    shape = (p, page, num_kv_heads, head_dim)
+    return kv_pages[0].reshape(shape), kv_pages[1].reshape(shape)
+
+
+def merge_kv_pages(k_pages: jax.Array, v_pages: jax.Array) -> jax.Array:
+    """Inverse of split_kv_pages (test/bench helper)."""
+    p, page, hkv, d = k_pages.shape
+    return jnp.stack(
+        [
+            k_pages.reshape(p, page, hkv * d),
+            v_pages.reshape(p, page, hkv * d),
+        ],
+        axis=0,
+    )
 
 
 @jax.tree_util.register_dataclass
@@ -65,57 +124,53 @@ class AttentionMetadata:
 
 
 def write_kv_pages(
-    k_pages: jax.Array,
-    v_pages: jax.Array,
-    k: jax.Array,
+    kv_pages: jax.Array,  # [2, P, page, HD]
+    k: jax.Array,  # [T, Hkv, D]
     v: jax.Array,
     slot_mapping: jax.Array,
-) -> tuple[jax.Array, jax.Array]:
-    """Scatter this step's K/V ([T, Hkv, D]) into the paged pool.
+) -> jax.Array:
+    """Scatter this step's K/V into the combined paged pool.
 
-    Functional reference / CPU path.  The production TPU path is the
-    aliased Pallas writer (ops/pallas/kv_update.py) — XLA does not keep
-    this scatter in place inside the fused decode scan at large pool
-    sizes.
+    Functional reference / CPU / prefill path.  The production decode
+    path is the per-row dynamic_update_slice writer
+    (ops/pallas/kv_update.py) — XLA does not keep this scatter in place
+    inside the fused decode scan at large pool sizes.
     """
-    num_pages, page_size, hkv, d = k_pages.shape
-    if k.shape[-1] < d:
-        # Pool head dim is lane-padded (to 128) for the Pallas kernel's
-        # DMA alignment; zero-pad the incoming heads to match.
-        pad = [(0, 0), (0, 0), (0, d - k.shape[-1])]
+    _, _, page_size, hd = kv_pages.shape
+    t, hkv, d = k.shape
+    k = k.reshape(t, hkv * d).astype(kv_pages.dtype)
+    v = v.reshape(t, hkv * d).astype(kv_pages.dtype)
+    if hkv * d < hd:  # sub-tile pools pad HD (kv_update does the same)
+        pad = [(0, 0), (0, hd - hkv * d)]
         k = jnp.pad(k, pad)
         v = jnp.pad(v, pad)
-    flat_k = k_pages.reshape(num_pages * page_size, hkv, d)
-    flat_v = v_pages.reshape(num_pages * page_size, hkv, d)
-    flat_k = flat_k.at[slot_mapping].set(k.astype(flat_k.dtype))
-    flat_v = flat_v.at[slot_mapping].set(v.astype(flat_v.dtype))
-    return (
-        flat_k.reshape(num_pages, page_size, hkv, d),
-        flat_v.reshape(num_pages, page_size, hkv, d),
-    )
+    pages = slot_mapping // page_size
+    rows = slot_mapping % page_size
+    kv_pages = kv_pages.at[0, pages, rows].set(k)
+    kv_pages = kv_pages.at[1, pages, rows].set(v)
+    return kv_pages
 
 
-@partial(jax.jit, static_argnames=("scale", "soft_cap"))
+@partial(jax.jit, static_argnames=("scale", "soft_cap", "num_kv_heads"))
 def paged_attention_reference(
     q: jax.Array,  # [T, Hq, D]
-    k_pages: jax.Array,  # [P, page_size, Hkv, D]
-    v_pages: jax.Array,  # [P, page_size, Hkv, D]
+    kv_pages: jax.Array,  # [2, P, page, HD]
     metadata: AttentionMetadata,
     *,
     scale: float,
     soft_cap: float | None = None,
+    num_kv_heads: int | None = None,
 ) -> jax.Array:
     """Causal attention of flat query tokens against their sequences' paged
     KV history.  O(T × max_ctx) with full gathers — the oracle, not the
     fast path."""
     t, hq, d = q.shape
-    _, page_size, hkv, d_pool = k_pages.shape
+    hkv = num_kv_heads if num_kv_heads is not None else hq
+    k_pages, v_pages = split_kv_pages(kv_pages, hkv, d)
+    _, page_size, _, _ = k_pages.shape
     s, max_pages = metadata.block_tables.shape
     groups = hq // hkv
     max_ctx = max_pages * page_size
-    if d_pool > d:  # lane-padded pool (see write_kv_pages)
-        k_pages = k_pages[..., :d]
-        v_pages = v_pages[..., :d]
 
     # Gather each sequence's KV: [S, max_ctx, Hkv, D].
     k_all = k_pages[metadata.block_tables].reshape(s, max_ctx, hkv, d)

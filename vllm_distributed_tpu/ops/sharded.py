@@ -3,16 +3,19 @@
 GSPMD cannot partition a Pallas custom call: under a tp>1 mesh it either
 replicates the kernel (wrong memory/compute) or fails to lower.  The
 runner therefore wraps the production kernels in ``jax.shard_map`` so
-each device runs the kernel on its *local* head shard — q heads and KV
-heads both shard over the mesh "tp" axis (llama.py ``partition_specs`` /
-``kv_cache_spec``), and per-head attention is embarrassingly parallel, so
-sharded outputs are bit-identical to the unsharded kernel.  The matmuls
-around the kernels stay GSPMD-partitioned; the row-parallel ``wo``
-all-reduce is still inserted by XLA outside the shard_map region.
+each device runs the kernel on its *local* head shard — q heads shard
+over "tp", and the combined KV pool ``[2, P, page, HD]`` shards its
+flat head×dim lanes (dim 3) over "tp", which is exactly per-kv-head
+sharding because HD stores heads contiguously (``HD/tp = (Hkv/tp)*D``).
+Per-head attention is embarrassingly parallel, so sharded outputs are
+bit-identical to the unsharded kernel.  The matmuls around the kernels
+stay GSPMD-partitioned; the row-parallel ``wo`` all-reduce is still
+inserted by XLA outside the shard_map region.
 
-This is the TPU-native analog of the reference's per-rank attention: each
-NCCL rank runs CUDA attention on its head shard inside vLLM workers
-(SURVEY.md §2.2, §2.4 TP row; TP-group discipline launch.py:211-247).
+This is the TPU-native analog of the reference's per-rank attention:
+each NCCL rank runs CUDA attention on its head shard inside vLLM
+workers (SURVEY.md §2.2, §2.4 TP row; TP-group discipline
+launch.py:211-247).
 
 dp>1 is not supported on this path: the KV pool is replicated over "dp",
 and a manual per-shard write would diverge the replicas (each dp group
@@ -41,7 +44,8 @@ _META_SPECS = AttentionMetadata(
 )
 
 _Q_SPEC = P(None, "tp", None)  # [T, Hq, D] — heads sharded
-_KV_SPEC = P(None, None, "tp", None)  # [P, page, Hkv, D] — kv heads sharded
+# [2, P, page, HD] — flat head lanes sharded (== per-kv-head sharding).
+_KV_SPEC = P(None, None, None, "tp")
 
 
 def _check_divisible(mesh: Mesh, num_heads: int, num_kv_heads: int) -> None:
@@ -55,19 +59,22 @@ def _check_divisible(mesh: Mesh, num_heads: int, num_kv_heads: int) -> None:
 
 def shard_attention(attn_fn, mesh: Mesh):
     """Wrap a paged-attention kernel to run per-tp-shard under shard_map."""
+    tp = mesh.shape.get("tp", 1)
 
-    def wrapped(q, k_pages, v_pages, metadata, **kw):
-        def body(q_, k_, v_, m_):
-            return attn_fn(q_, k_, v_, m_, **kw)
+    def wrapped(q, kv_pages, metadata, *, num_kv_heads=None, **kw):
+        hkv = num_kv_heads if num_kv_heads is not None else q.shape[1]
+
+        def body(q_, kv_, m_):
+            return attn_fn(q_, kv_, m_, num_kv_heads=hkv // tp, **kw)
 
         f = jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(_Q_SPEC, _KV_SPEC, _KV_SPEC, _META_SPECS),
+            in_specs=(_Q_SPEC, _KV_SPEC, _META_SPECS),
             out_specs=_Q_SPEC,
             check_vma=False,
         )
-        return f(q, k_pages, v_pages, metadata)
+        return f(q, kv_pages, metadata)
 
     wrapped.needs_max_q = getattr(attn_fn, "needs_max_q", False)
     return wrapped
@@ -82,20 +89,19 @@ def shard_kv_write(write_fn, mesh: Mesh):
     each shard aliases its local buffer.
     """
 
-    def wrapped(k_pages, v_pages, k, v, slot_mapping):
+    def wrapped(kv_pages, k, v, slot_mapping):
         f = jax.shard_map(
             write_fn,
             mesh=mesh,
             in_specs=(
                 _KV_SPEC,
-                _KV_SPEC,
                 P(None, "tp", None),
                 P(None, "tp", None),
                 P(),
             ),
-            out_specs=(_KV_SPEC, _KV_SPEC),
+            out_specs=_KV_SPEC,
             check_vma=False,
         )
-        return f(k_pages, v_pages, k, v, slot_mapping)
+        return f(kv_pages, k, v, slot_mapping)
 
     return wrapped

@@ -41,7 +41,7 @@ from vllm_distributed_tpu.ops.attention import (
 from vllm_distributed_tpu.ops.sampling import SamplingMetadata, sample
 from vllm_distributed_tpu.outputs import ModelRunnerOutput
 from vllm_distributed_tpu.sampling_params import SamplingParams
-from vllm_distributed_tpu.utils import cdiv, next_power_of_2, round_up
+from vllm_distributed_tpu.utils import cdiv, next_power_of_2
 
 logger = init_logger(__name__)
 
@@ -166,6 +166,15 @@ class ModelRunner:
                     f"dp axis size must be a power of 2, got {self._dp} "
                     "(power-of-two shape buckets must stay divisible)"
                 )
+            tp = self.mesh.shape.get("tp", 1)
+            if tp > 1 and self.model.num_kv_heads % tp:
+                # The combined pool shards its flat head×dim lanes; a tp
+                # that does not divide the head count would silently
+                # split heads mid-lane instead of failing.
+                raise ValueError(
+                    f"tp={tp} must divide num_kv_heads="
+                    f"{self.model.num_kv_heads} to shard the KV cache"
+                )
             axis = "dp" if self._dp > 1 else None
             self._input_spec = NamedSharding(self.mesh, P(axis))
         self._shard_kernels()
@@ -272,14 +281,15 @@ class ModelRunner:
         return jnp.dtype(name)
 
     def kv_cache_bytes_per_page(self) -> int:
+        from vllm_distributed_tpu.ops.attention import kv_pool_width
+
         m = self.model
         dtype_size = jnp.dtype(self.kv_cache_dtype()).itemsize
         return (
             m.num_layers
             * 2
             * self.page_size
-            * m.num_kv_heads
-            * round_up(m.head_dim, 128)  # pool lane padding
+            * kv_pool_width(m.num_kv_heads, m.head_dim)
             * dtype_size
         )
 
@@ -351,14 +361,17 @@ class ModelRunner:
         return int(num_pages)
 
     def alloc_kv_pool(self, num_pages: int) -> list:
-        """Allocate a paged KV pool: slot-major [P, page, Hkv, D] per
-        layer (see ops/attention.py layout), head dim lane-padded to 128
-        for DMA-aligned Pallas page copies, sharded per the model's
+        """Allocate a paged KV pool: one combined [2, P, page, HD] array
+        per layer (see ops/attention.py layout — K/V fused so a page is
+        ONE DMA, flat head lanes unpadded), sharded per the model's
         kv_cache_spec.  Used for the serving cache and for aux-forward
         scratch pools — one definition of the layout."""
+        from vllm_distributed_tpu.ops.attention import kv_pool_shape
+
         m = self.model
-        d_pad = round_up(m.head_dim, 128)
-        shape = (num_pages, self.page_size, m.num_kv_heads, d_pad)
+        shape = kv_pool_shape(
+            num_pages, self.page_size, m.num_kv_heads, m.head_dim
+        )
         sharding = None
         if self.mesh is not None:
             sharding = NamedSharding(self.mesh, m.kv_cache_spec())
@@ -368,7 +381,7 @@ class ModelRunner:
             z = jnp.zeros(shape, dtype)
             return jax.device_put(z, sharding) if sharding is not None else z
 
-        return [(alloc(), alloc()) for _ in range(m.num_layers)]
+        return [alloc() for _ in range(m.num_layers)]
 
     def init_kv_cache(self, num_pages: int) -> None:
         self.num_pages = num_pages
