@@ -256,3 +256,105 @@ def test_cross_seq_prefetch_with_empty_seq():
             num_pages=300,
         )
     )
+
+
+def test_staged_side_buffer_decode():
+    """Kernel + reference with side_kv/side_len must equal the reference
+    over a pool where the staged rows were already flushed."""
+    rng = np.random.default_rng(11)
+    hq, hkv, d, page_size = 8, 2, 64, 16
+    k_steps, step_i = 16, 9  # micro-step 9 of a 16-step dispatch
+    bases = [37, 160, 0, 5]  # pool-resident lengths; row 2 = padding
+    s_pad = len(bases)
+    num_pages = 64
+
+    from vllm_distributed_tpu.ops.attention import (
+        kv_pool_shape,
+        write_kv_pages,
+    )
+
+    kv = jnp.asarray(
+        rng.standard_normal(kv_pool_shape(num_pages, page_size, hkv, d)),
+        jnp.float32,
+    )
+    side = jnp.asarray(
+        rng.standard_normal((s_pad, 2, k_steps, hkv * d)), jnp.float32
+    )
+    max_pages = 16
+    bt = np.zeros((s_pad, max_pages), np.int32)
+    nxt = 1
+    for i, b in enumerate(bases):
+        if b <= 0:
+            continue
+        need = -(-(b + k_steps) // page_size)
+        bt[i, :need] = np.arange(nxt, nxt + need)
+        nxt += need
+
+    # Queries: one decode token per live row at position base + step_i.
+    pos = np.asarray(
+        [b + step_i if b > 0 else 0 for b in bases], np.int32
+    )
+    sid = np.asarray(
+        [i if b > 0 else s_pad for i, b in enumerate(bases)], np.int32
+    )
+    q = jnp.asarray(rng.standard_normal((s_pad, hq, d)), jnp.float32)
+    meta_staged = AttentionMetadata(
+        q_seq_ids=jnp.asarray(sid),
+        q_positions=jnp.asarray(pos),
+        slot_mapping=jnp.zeros(s_pad, jnp.int32),
+        block_tables=jnp.asarray(bt),
+        seq_lens=jnp.asarray(np.asarray(bases, np.int32)),  # POOL lens
+        logits_indices=jnp.arange(s_pad, dtype=jnp.int32),
+        chunk_starts=jnp.asarray(pos),
+    )
+    side_len = jnp.asarray([step_i + 1], jnp.int32)
+
+    # Oracle: flush side rows 0..step_i into a copy of the pool and run
+    # the plain reference with full sequence lengths.
+    flushed = kv
+    for i, b in enumerate(bases):
+        if b <= 0:
+            continue
+        for j in range(step_i + 1):
+            p = b + j
+            slot = bt[i, p // page_size] * page_size + p % page_size
+            flushed = write_kv_pages(
+                flushed,
+                side[i, 0, j].reshape(1, hkv, d),
+                side[i, 1, j].reshape(1, hkv, d),
+                jnp.asarray([slot], jnp.int32),
+            )
+    meta_full = AttentionMetadata(
+        q_seq_ids=meta_staged.q_seq_ids,
+        q_positions=meta_staged.q_positions,
+        slot_mapping=meta_staged.slot_mapping,
+        block_tables=meta_staged.block_tables,
+        seq_lens=jnp.asarray(
+            np.asarray(
+                [b + step_i + 1 if b > 0 else 0 for b in bases], np.int32
+            )
+        ),
+        logits_indices=meta_staged.logits_indices,
+        chunk_starts=meta_staged.chunk_starts,
+    )
+    want = paged_attention_reference(
+        q, flushed, meta_full, scale=0.125, num_kv_heads=hkv
+    )
+
+    got_ref = paged_attention_reference(
+        q, kv, meta_staged, scale=0.125, num_kv_heads=hkv,
+        side_kv=side, side_len=side_len,
+    )
+    got_pl = paged_attention(
+        q, kv, meta_staged, scale=0.125, num_kv_heads=hkv,
+        max_q=1, side_kv=side, side_len=side_len, interpret=True,
+    )
+    live = np.asarray([i for i, b in enumerate(bases) if b > 0])
+    np.testing.assert_allclose(
+        np.asarray(got_ref)[live], np.asarray(want)[live],
+        rtol=1e-4, atol=2e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_pl)[live], np.asarray(want)[live],
+        rtol=1e-4, atol=2e-5,
+    )

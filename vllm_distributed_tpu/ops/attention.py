@@ -160,10 +160,17 @@ def paged_attention_reference(
     scale: float,
     soft_cap: float | None = None,
     num_kv_heads: int | None = None,
+    side_kv: jax.Array | None = None,  # [S, 2, K, HD] staged decode rows
+    side_len: jax.Array | None = None,  # [1] int32
 ) -> jax.Array:
     """Causal attention of flat query tokens against their sequences' paged
     KV history.  O(T × max_ctx) with full gathers — the oracle, not the
-    fast path."""
+    fast path.
+
+    ``side_kv``/``side_len``: staged decode rows holding positions
+    ``seq_lens[s] + j`` (seq_lens is the pool-resident length when
+    staging) — see the Pallas kernel's docstring.
+    """
     t, hq, d = q.shape
     hkv = num_kv_heads if num_kv_heads is not None else hq
     k_pages, v_pages = split_kv_pages(kv_pages, hkv, d)
@@ -176,7 +183,26 @@ def paged_attention_reference(
     k_all = k_pages[metadata.block_tables].reshape(s, max_ctx, hkv, d)
     v_all = v_pages[metadata.block_tables].reshape(s, max_ctx, hkv, d)
 
-    # Per query token, its sequence's KV: [T, max_ctx, Hkv, D].
+    seq_lens_tok = metadata.seq_lens[metadata.q_seq_ids]  # [T]
+    ctx_pos = jnp.arange(max_ctx, dtype=jnp.int32)
+    valid = ctx_pos[None, :] <= metadata.q_positions[:, None]  # causal
+    valid &= ctx_pos[None, :] < seq_lens_tok[:, None]
+
+    if side_kv is not None:
+        k_blk = side_kv.shape[2]
+        side = side_kv[..., : hkv * d].reshape(s, 2, k_blk, hkv, d)
+        k_all = jnp.concatenate([k_all, side[:, 0]], axis=1)
+        v_all = jnp.concatenate([v_all, side[:, 1]], axis=1)
+        j = jnp.arange(k_blk, dtype=jnp.int32)
+        side_pos = seq_lens_tok[:, None] + j[None, :]  # [T, K]
+        side_valid = (
+            (j[None, :] < side_len[0])
+            & (side_pos <= metadata.q_positions[:, None])
+            & (seq_lens_tok[:, None] > 0)
+        )
+        valid = jnp.concatenate([valid, side_valid], axis=1)
+
+    # Per query token, its sequence's KV: [T, C, Hkv, D].
     k_tok = k_all[metadata.q_seq_ids]
     v_tok = v_all[metadata.q_seq_ids]
 
@@ -187,9 +213,6 @@ def paged_attention_reference(
     if soft_cap is not None:
         scores = jnp.tanh(scores / soft_cap) * soft_cap
 
-    ctx_pos = jnp.arange(max_ctx, dtype=jnp.int32)
-    valid = ctx_pos[None, :] <= metadata.q_positions[:, None]  # causal
-    valid &= ctx_pos[None, :] < metadata.seq_lens[metadata.q_seq_ids][:, None]
     scores = jnp.where(valid[:, None, None, :], scores, DEFAULT_MASK_VALUE)
 
     scores = scores - jnp.max(scores, axis=-1, keepdims=True)

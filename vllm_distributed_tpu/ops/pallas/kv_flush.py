@@ -1,0 +1,201 @@
+"""Pallas flush of staged decode K/V rows into the paged pool.
+
+The fused decode scan stages each micro-step's K/V in a dense side
+buffer (one in-place dynamic_update_slice per layer per step) instead
+of scattering rows into the paged pool; after the scan, this kernel
+folds a dispatch's K rows per sequence back into the pool in one pass.
+
+Why a read-modify-write: Mosaic can only DMA tile-aligned slabs of the
+pool's (page_size, HD) minor pair — single token rows are not
+addressable (see ops/attention.py layout notes).  A sequence's K
+consecutive rows [base, base+K) touch at most ceil(K/page)+1 pages, so
+the kernel reads those page slabs, overlays the side rows with a
+vectorized roll + iota select, and writes the slabs back.  Per
+dispatch this is ~2 pages × 2 planes × r+w per sequence per layer —
+~1-2 % of the decode step's attention traffic — versus a per-row write
+EVERY micro-step on the old path (measured ~1.8 µs/row: several ms per
+micro-step at batch 64).
+
+Pipelining: the next sequence's slab reads are started before this
+sequence's modify/write-back, so the read latency is hidden; the
+write-back is waited in the same grid step (cheap — the slabs are
+tens of KB), which keeps semaphore accounting trivially balanced even
+when trailing padding rows are skipped.  Sequences' touched pages are
+disjoint by the allocator; padding rows (base 0) are skipped, and any
+over-read of the reserved dump page 0 via clamped table padding only
+rewrites garbage with garbage.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(
+    base_lens_ref,  # [S] int32 (pool-resident length; <=0 = skip row)
+    page0_ref,  # [S] int32: base // page_size (logical first page)
+    page_ids_ref,  # [S, NPT] int32: touched page ids (clamped, padded)
+    n_side_ref,  # [1] int32: rows to flush per sequence (<= K)
+    side_ref,  # [1, 2, K, HD] VMEM block (this sequence's staged rows)
+    pool_in,  # [2, P, page, HD] ANY (aliased with pool_out)
+    pool_out,
+    slab_vmem,  # [2(pipe), 2(kv), NPT*page, HD]
+    read_sems,  # [2]
+    write_sem,
+    *,
+    page_size: int,
+    npt: int,
+):
+    s = pl.program_id(0)
+    num_s = pl.num_programs(0)
+    buf = s % 2
+    live = base_lens_ref[s] > 0
+
+    def read_copies(seq, buf):
+        copies = []
+        for pt in range(npt):
+            page = page_ids_ref[seq, pt]
+            for kvi in range(2):
+                copies.append(
+                    pltpu.make_async_copy(
+                        pool_in.at[kvi, page],
+                        slab_vmem.at[
+                            buf, kvi, pl.ds(pt * page_size, page_size)
+                        ],
+                        read_sems.at[buf],
+                    )
+                )
+        return copies
+
+    def write_copies(seq, buf):
+        copies = []
+        for pt in range(npt):
+            page = page_ids_ref[seq, pt]
+            for kvi in range(2):
+                copies.append(
+                    pltpu.make_async_copy(
+                        slab_vmem.at[
+                            buf, kvi, pl.ds(pt * page_size, page_size)
+                        ],
+                        pool_out.at[kvi, page],
+                        write_sem,
+                    )
+                )
+        return copies
+
+    # Prologue: nobody prefetched row 0's slabs.
+    @pl.when((s == 0) & live)
+    def _first_reads():
+        for cp in read_copies(s, buf):
+            cp.start()
+
+    # Prefetch the next sequence's slabs while this one modifies/writes.
+    @pl.when(
+        (s + 1 < num_s)
+        & (base_lens_ref[jnp.minimum(s + 1, num_s - 1)] > 0)
+    )
+    def _next_reads():
+        for cp in read_copies(s + 1, (s + 1) % 2):
+            cp.start()
+
+    @pl.when(live)
+    def _modify_and_write():
+        for cp in read_copies(s, buf):
+            cp.wait()
+        n_side = n_side_ref[0]
+        rows = npt * page_size
+        base = base_lens_ref[s]
+        off = base - page0_ref[s] * page_size  # first row's slab offset
+        row_ids = jax.lax.broadcasted_iota(
+            jnp.int32, (rows, side_ref.shape[3]), 0
+        )
+        in_window = (row_ids >= off) & (row_ids < off + n_side)
+        for kvi in range(2):
+            # Side row j lands at slab row off + j: pad side to the slab
+            # height and roll it down by `off`.  Mosaic only rotates
+            # 32-bit lanes, so roll in f32 (exact for bf16/int8 values).
+            side = side_ref[0, kvi].astype(jnp.float32)  # [K, HD]
+            padded = jnp.pad(side, [(0, rows - side.shape[0]), (0, 0)])
+            shifted = pltpu.roll(padded, off, 0).astype(slab_vmem.dtype)
+            cur = slab_vmem[buf, kvi]
+            slab_vmem[buf, kvi] = jnp.where(in_window, shifted, cur)
+        write_backs = write_copies(s, buf)
+        for cp in write_backs:
+            cp.start()
+        for cp in write_backs:
+            cp.wait()
+
+
+def kv_flush(
+    kv_pages: jax.Array,  # [2, P, page, HD]
+    side_kv: jax.Array,  # [S, 2, K, HD]
+    block_tables: jax.Array,  # [S, max_pages] int32
+    base_lens: jax.Array,  # [S] int32 (0 = padding row, skipped)
+    n_side: jax.Array,  # [1] int32: rows written per sequence
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Write each live sequence's staged rows [base, base+n_side) into
+    the pool, in place (aliased)."""
+    _, p_total, page_size, hd = kv_pages.shape
+    s, _, k_blk, _ = side_kv.shape
+    npt = (k_blk + page_size - 1) // page_size + 1
+
+    page0 = base_lens // page_size
+    pts = page0[:, None] + jnp.arange(npt, dtype=jnp.int32)[None, :]
+    # The slab's slack column can step past the table: route it to the
+    # reserved dump page 0, NOT a clamped real page — a clamped
+    # duplicate would write a stale copy of the sequence's last page
+    # over the freshly flushed rows.  (In-table entries past a
+    # sequence's allocation are already 0 by table construction.)
+    in_table = pts < block_tables.shape[1]
+    gathered = jnp.take_along_axis(
+        block_tables, jnp.minimum(pts, block_tables.shape[1] - 1), axis=1
+    )
+    page_ids = jnp.where(in_table, gathered, 0)
+
+    kernel = functools.partial(_kernel, page_size=page_size, npt=npt)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(s,),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 2, k_blk, hd),
+                    lambda s_, *refs: (s_, 0, 0, 0),
+                ),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pltpu.VMEM(
+                    (2, 2, npt * page_size, hd), kv_pages.dtype
+                ),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA,
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(kv_pages.shape, kv_pages.dtype),
+        # Inputs: 0-3 scalar prefetch, 4 side, 5 pool → output 0.
+        input_output_aliases={5: 0},
+        interpret=interpret,
+    )(
+        base_lens.astype(jnp.int32),
+        page0.astype(jnp.int32),
+        page_ids.astype(jnp.int32),
+        n_side.astype(jnp.int32),
+        side_kv,
+        kv_pages,
+    )
+    return out
+
+
+def kv_flush_cpu(*args, **kwargs):
+    """Interpret-mode entry for CPU tests."""
+    return kv_flush(*args, interpret=True, **kwargs)

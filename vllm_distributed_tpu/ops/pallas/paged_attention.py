@@ -68,25 +68,17 @@ _ROWS_TARGET = 32
 
 
 def _kernel(
-    # scalar prefetch
+    # scalar prefetch (+ side_len_ref when has_side)
     block_tables_ref,  # [S, max_pages] int32 (SMEM)
     seq_lens_ref,  # [S] int32
     chunk_starts_ref,  # [S] int32
-    # inputs
-    q_ref,  # [1, 1, NF, ROWS, FD] VMEM block (block-diagonal queries)
-    kv_pages_ref,  # [2, P, page, HD] in HBM/ANY
-    # outputs
-    out_ref,  # [1, 1, NF, ROWS, FD] VMEM block
-    # scratch
-    kv_vmem,  # [NBUF, 2, BLK, HD]
-    m_scr,  # [NF, ROWS, LANES] f32
-    l_scr,  # [NF, ROWS, LANES] f32
-    acc_scr,  # [NF, ROWS, FD] f32
-    sems,  # DMA sems [NBUF]
-    cnt,  # SMEM [2] int32 — [active blocks completed (global; the
-    #                         buffer-rotation cursor), prefetch-pending
-    #                         flag for the next active step's block]
-    *,
+    # then inputs (q_ref [1,1,NF,ROWS,FD]; side_ref [1,2,K,HD] when
+    # has_side; kv_pages_ref [2,P,page,HD] ANY), the out block
+    # [1,1,NF,ROWS,FD], and scratch (kv_vmem [NBUF,2,BLK,HD], m/l
+    # [NF,ROWS,LANES] f32, acc [NF,ROWS,FD] f32, DMA sems [NBUF],
+    # cnt SMEM [2] = [completed active blocks (the buffer-rotation
+    # cursor), prefetch-pending flag]).
+    *rest,
     scale: float,
     soft_cap: float | None,
     page_size: int,
@@ -95,7 +87,19 @@ def _kernel(
     num_fold: int,
     fold_width: int,
     mq_blk: int,
+    has_side: bool,
 ):
+    if has_side:
+        (
+            side_len_ref, q_ref, side_ref, kv_pages_ref, out_ref,
+            kv_vmem, m_scr, l_scr, acc_scr, sems, cnt,
+        ) = rest
+    else:
+        side_len_ref = side_ref = None
+        (
+            q_ref, kv_pages_ref, out_ref,
+            kv_vmem, m_scr, l_scr, acc_scr, sems, cnt,
+        ) = rest
     s = pl.program_id(0)
     qb = pl.program_id(1)
     kvb = pl.program_id(2)
@@ -169,56 +173,88 @@ def _kernel(
 
     block_start = kvb * blk
 
+    def row_positions(ncols):
+        """Per-row query position / per-col iota for masking.  Row
+        layout: r = (hl*G + g)*mq + m → token index m = r % mq."""
+        rows = acc_scr.shape[1]
+        row_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, ncols), 0)
+        col_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, ncols), 1)
+        q_pos = chunk_start + qb * mq_blk + row_ids % mq_blk
+        return q_pos, col_ids
+
+    def flash_update(nf, k, v, mask):
+        """One online-softmax accumulation step for fold group nf."""
+        qn = q_ref[0, 0, nf].astype(jnp.float32)  # [ROWS, FD]
+        scores = (
+            jax.lax.dot_general(
+                qn, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [ROWS, ncols]
+        if soft_cap is not None:
+            scores = jnp.tanh(scores / soft_cap) * soft_cap
+        scores = jnp.where(mask, scores, _MASK)
+
+        m_prev = m_scr[nf, :, 0:1]
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)
+        p = jnp.where(mask, p, 0.0)
+        l_new = l_scr[nf, :, 0:1] * alpha + jnp.sum(
+            p, axis=-1, keepdims=True
+        )
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[nf] = acc_scr[nf] * alpha + pv
+        m_scr[nf] = jnp.broadcast_to(m_new, m_scr[nf].shape)
+        l_scr[nf] = jnp.broadcast_to(l_new, l_scr[nf].shape)
+
     @pl.when(active)
     def _compute():
         buf = cnt[0] % _NBUF
         for cp in block_dma(s, kvb, buf):
             cp.wait()
-        rows = acc_scr.shape[1]
-        row_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, blk), 0)
-        col_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, blk), 1)
-        # Row layout: r = (hl*G + g)*mq + m  →  token index m = r % mq.
-        q_pos = chunk_start + qb * mq_blk + row_ids % mq_blk
+        q_pos, col_ids = row_positions(blk)
         c_pos = block_start + col_ids
         mask = (c_pos <= q_pos) & (c_pos < seq_len)
-
         for nf in range(num_fold):
             lo = nf * fold_width
-            qn = q_ref[0, 0, nf].astype(jnp.float32)  # [ROWS, FD]
             k = kv_vmem[buf, 0, :, lo : lo + fold_width].astype(jnp.float32)
             v = kv_vmem[buf, 1, :, lo : lo + fold_width].astype(jnp.float32)
-            scores = (
-                jax.lax.dot_general(
-                    qn, k, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-                * scale
-            )  # [ROWS, BLK]
-            if soft_cap is not None:
-                scores = jnp.tanh(scores / soft_cap) * soft_cap
-            scores = jnp.where(mask, scores, _MASK)
-
-            m_prev = m_scr[nf, :, 0:1]
-            m_cur = jnp.max(scores, axis=-1, keepdims=True)
-            m_new = jnp.maximum(m_prev, m_cur)
-            alpha = jnp.exp(m_prev - m_new)
-            p = jnp.exp(scores - m_new)
-            p = jnp.where(mask, p, 0.0)
-            l_new = l_scr[nf, :, 0:1] * alpha + jnp.sum(
-                p, axis=-1, keepdims=True
-            )
-            pv = jax.lax.dot_general(
-                p, v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            acc_scr[nf] = acc_scr[nf] * alpha + pv
-            m_scr[nf] = jnp.broadcast_to(m_new, m_scr[nf].shape)
-            l_scr[nf] = jnp.broadcast_to(l_new, l_scr[nf].shape)
+            flash_update(nf, k, v, mask)
         cnt[0] = cnt[0] + 1
         cnt[1] = has_next.astype(jnp.int32)
 
     @pl.when(kvb == num_kvb - 1)
     def _finalize():
+        if has_side:
+            # Staged decode writes: this dispatch's K/V rows live in the
+            # dense side buffer (positions seq_len + j), not the pool.
+            # Fold them into the same online-softmax state before the
+            # division.  seq_len here is the POOL length (the runner
+            # passes base lengths when staging).
+            n_side = side_len_ref[0]
+            kblk = side_ref.shape[2]
+            q_pos, col_ids = row_positions(kblk)
+            side_pos = seq_len + col_ids
+            smask = (
+                (col_ids < n_side)
+                & (side_pos <= q_pos)
+                & (seq_len > 0)
+            )
+            for nf in range(num_fold):
+                lo = nf * fold_width
+                k = side_ref[0, 0, :, lo : lo + fold_width].astype(
+                    jnp.float32
+                )
+                v = side_ref[0, 1, :, lo : lo + fold_width].astype(
+                    jnp.float32
+                )
+                flash_update(nf, k, v, smask)
         for nf in range(num_fold):
             denom = jnp.maximum(l_scr[nf, :, 0:1], 1e-30)
             out_ref[0, 0, nf] = (acc_scr[nf] / denom).astype(out_ref.dtype)
@@ -275,11 +311,21 @@ def paged_attention(
     soft_cap: float | None = None,
     num_kv_heads: int | None = None,
     max_q: int = 1,
+    side_kv: jax.Array | None = None,  # [S, 2, K, HD] staged decode rows
+    side_len: jax.Array | None = None,  # [1] int32: valid side columns
     interpret: bool = False,
 ) -> jax.Array:
     """Drop-in for paged_attention_reference (same contract), running the
     flash kernel.  `max_q` is the static per-sequence query bound for this
-    step (the runner's padded max chunk length)."""
+    step (the runner's padded max chunk length).
+
+    ``side_kv``/``side_len``: staged decode writes — the fused decode
+    scan keeps each micro-step's K/V rows in a dense per-sequence side
+    buffer instead of scattering them into the paged pool every step
+    (the pool is flushed once per dispatch).  Row j of a sequence's side
+    buffer holds position ``metadata.seq_lens[s] + j`` (seq_lens is the
+    POOL-resident length when staging); columns ``>= side_len`` are not
+    yet written and are masked."""
     t, hq, d = q.shape
     _, p_total, page_size, hd_pad = kv_pages.shape
     s, max_pages = metadata.block_tables.shape
@@ -347,6 +393,7 @@ def paged_attention(
         block_tables = metadata.block_tables
 
     grid = (s, num_qb, num_kvb)
+    has_side = side_kv is not None
     kernel = functools.partial(
         _kernel,
         scale=scale,
@@ -357,20 +404,35 @@ def paged_attention(
         num_fold=nf,
         fold_width=fd,
         mq_blk=mq_blk,
+        has_side=has_side,
     )
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, nf, rows, fd),
+            # Scalar-prefetch refs ride along after grid indices.
+            lambda s_, qb_, b_, *refs: (s_, qb_, 0, 0, 0),
+        ),
+    ]
+    scalars = [block_tables, metadata.seq_lens, metadata.chunk_starts]
+    inputs = [q_bd]
+    if has_side:
+        scalars.append(side_len.astype(jnp.int32))
+        k_side = side_kv.shape[2]
+        in_specs.append(
+            pl.BlockSpec(
+                (1, 2, k_side, hd_pad),
+                lambda s_, qb_, b_, *refs: (s_, 0, 0, 0),
+            )
+        )
+        inputs.append(side_kv)
+    in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+    inputs.append(kv_pages)
     out_bd = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,
+            num_scalar_prefetch=len(scalars),
             grid=grid,
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, nf, rows, fd),
-                    # Scalar-prefetch refs ride along after grid indices.
-                    lambda s_, qb_, b_, *refs: (s_, qb_, 0, 0, 0),
-                ),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, nf, rows, fd),
                 lambda s_, qb_, b_, *refs: (s_, qb_, 0, 0, 0),
@@ -386,13 +448,7 @@ def paged_attention(
         ),
         out_shape=jax.ShapeDtypeStruct((s, num_qb, nf, rows, fd), q.dtype),
         interpret=interpret,
-    )(
-        block_tables,
-        metadata.seq_lens,
-        metadata.chunk_starts,
-        q_bd,
-        kv_pages,
-    )
+    )(*scalars, *inputs)
 
     # ---- extract the diagonal blocks back to the flat layout ----
     ob = out_bd[..., : f * d].reshape(s, num_qb, nf, f, g, mq_blk, f, d)

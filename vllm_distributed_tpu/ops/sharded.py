@@ -46,6 +46,8 @@ _META_SPECS = AttentionMetadata(
 _Q_SPEC = P(None, "tp", None)  # [T, Hq, D] — heads sharded
 # [2, P, page, HD] — flat head lanes sharded (== per-kv-head sharding).
 _KV_SPEC = P(None, None, None, "tp")
+# [S, 2, K, HD] staged decode side buffer — same lane sharding.
+_SIDE_SPEC = P(None, None, None, "tp")
 
 
 def _check_divisible(mesh: Mesh, num_heads: int, num_kv_heads: int) -> None:
@@ -61,22 +63,50 @@ def shard_attention(attn_fn, mesh: Mesh):
     """Wrap a paged-attention kernel to run per-tp-shard under shard_map."""
     tp = mesh.shape.get("tp", 1)
 
-    def wrapped(q, kv_pages, metadata, *, num_kv_heads=None, **kw):
+    def wrapped(
+        q, kv_pages, metadata, *,
+        num_kv_heads=None, side_kv=None, side_len=None, **kw,
+    ):
         hkv = num_kv_heads if num_kv_heads is not None else q.shape[1]
+        has_side = side_kv is not None
 
-        def body(q_, kv_, m_):
+        def body(q_, kv_, m_, *side_args):
+            if side_args:
+                kw.update(side_kv=side_args[0], side_len=side_args[1])
             return attn_fn(q_, kv_, m_, num_kv_heads=hkv // tp, **kw)
 
+        in_specs = [_Q_SPEC, _KV_SPEC, _META_SPECS]
+        operands = [q, kv_pages, metadata]
+        if has_side:
+            in_specs += [_SIDE_SPEC, P()]
+            operands += [side_kv, side_len]
         f = jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(_Q_SPEC, _KV_SPEC, _META_SPECS),
+            in_specs=tuple(in_specs),
             out_specs=_Q_SPEC,
             check_vma=False,
         )
-        return f(q, kv_pages, metadata)
+        return f(*operands)
 
     wrapped.needs_max_q = getattr(attn_fn, "needs_max_q", False)
+    return wrapped
+
+
+def shard_kv_flush(flush_fn, mesh: Mesh):
+    """Wrap the staged-decode flush kernel to run per-tp-shard: pool and
+    side buffer shard their flat head lanes; tables/lengths replicate."""
+
+    def wrapped(kv_pages, side_kv, block_tables, base_lens, n_side):
+        f = jax.shard_map(
+            flush_fn,
+            mesh=mesh,
+            in_specs=(_KV_SPEC, _SIDE_SPEC, P(), P(), P()),
+            out_specs=_KV_SPEC,
+            check_vma=False,
+        )
+        return f(kv_pages, side_kv, block_tables, base_lens, n_side)
+
     return wrapped
 
 

@@ -159,6 +159,13 @@ class ModelRunner:
 
         self._kv_write_fn = write_kv_pages
         self._kv_write_decode_fn = self._pick_kv_write_fn()
+        # Staged decode writes (side buffer + per-dispatch flush) ride
+        # the Pallas attention path; the XLA reference path keeps the
+        # in-loop functional scatter.
+        self._staged_decode = (
+            self._kv_write_decode_fn is not write_kv_pages
+        )
+        self._kv_flush_fn = self._pick_kv_flush_fn()
         if self.mesh is not None:
             self._dp = self.mesh.shape.get("dp", 1)
             if self._dp & (self._dp - 1):
@@ -221,6 +228,10 @@ class ModelRunner:
             self._kv_write_decode_fn = sharded.shard_kv_write(
                 self._kv_write_decode_fn, self.mesh
             )
+        if self._kv_flush_fn is not None:
+            self._kv_flush_fn = sharded.shard_kv_flush(
+                self._kv_flush_fn, self.mesh
+            )
 
     def _pick_attn_fn(self):
         backend = self.attn_backend
@@ -270,6 +281,26 @@ class ModelRunner:
         from vllm_distributed_tpu.ops.attention import write_kv_pages
 
         return write_kv_pages
+
+    def _pick_kv_flush_fn(self):
+        """Per-dispatch flush of the staged decode side buffers (only
+        used when _staged_decode)."""
+        backend = self.attn_backend
+        if backend == "auto":
+            backend = (
+                "pallas" if jax.default_backend() == "tpu" else "reference"
+            )
+        if backend == "pallas":
+            from vllm_distributed_tpu.ops.pallas.kv_flush import kv_flush
+
+            return kv_flush
+        if backend == "pallas_interpret":
+            from vllm_distributed_tpu.ops.pallas.kv_flush import (
+                kv_flush_cpu,
+            )
+
+            return kv_flush_cpu
+        return None
 
     def kv_cache_dtype(self):
         """Pool dtype: cache_config.cache_dtype, "auto" = model dtype.
@@ -994,9 +1025,47 @@ class ModelRunner:
         attn_fn = self._attn_fn
         if getattr(attn_fn, "needs_max_q", False):
             attn_fn = partial(attn_fn, max_q=1)
+        staged = self._staged_decode
+        if staged:
+            # Staged decode writes: micro-step K/V rows go to a dense
+            # per-layer side buffer (one in-place DUS per layer per
+            # step); attention reads pool (positions < base) + side
+            # (positions base..base+i); the pool is flushed once after
+            # the scan.  Removes the per-row pool writes (~1.8 µs each)
+            # from the micro-step path.
+            base_valid = jnp.where(valid > 0, base_lens, 0)
+
+            def make_entry(kv, side, i):
+                return (kv, side, i)
+
+            def staged_write(entry, k, v, slot_mapping):
+                kv, side, i = entry
+                t = k.shape[0]
+                hd = side.shape[-1]
+                rows_kv = jnp.stack(
+                    [k.reshape(t, -1), v.reshape(t, -1)], axis=1
+                ).astype(side.dtype)
+                if rows_kv.shape[-1] < hd:
+                    rows_kv = jnp.pad(
+                        rows_kv,
+                        [(0, 0), (0, 0), (0, hd - rows_kv.shape[-1])],
+                    )
+                side = jax.lax.dynamic_update_slice(
+                    side, rows_kv[:, :, None, :], (0, 0, i, 0)
+                )
+                return (kv, side, i)
+
+            def staged_attn(q, entry, meta, **kw):
+                kv, side, i = entry
+                return attn_fn(
+                    q, kv, meta,
+                    side_kv=side,
+                    side_len=jnp.reshape(i + 1, (1,)),
+                    **kw,
+                )
 
         def body(carry, i):
-            kv, tok, out_buf = carry
+            kv, sides, tok, out_buf = carry
             pos = base_lens + i
             meta = AttentionMetadata(
                 # Padding rows use the kernels' drop convention (id == S).
@@ -1009,7 +1078,13 @@ class ModelRunner:
                     + pos % page_size
                 ),
                 block_tables=block_tables,
-                seq_lens=jnp.where(valid > 0, pos + 1, 0),
+                # Staged: seq_lens is the POOL-resident length (base);
+                # this dispatch's rows are covered by the side buffer.
+                seq_lens=(
+                    base_valid
+                    if staged
+                    else jnp.where(valid > 0, pos + 1, 0)
+                ),
                 logits_indices=rows,
                 chunk_starts=pos,
             )
@@ -1036,14 +1111,30 @@ class ModelRunner:
             # bandwidth win.  With it, the int8 bytes stream per
             # micro-step and the dequant fuses into the matmuls.
             params_i = jax.lax.optimization_barrier(params)
-            logits, kv = self.model.forward(
-                params_i,
-                tok,
-                kv,
-                meta,
-                attn_fn=attn_fn,
-                kv_write_fn=self._kv_write_decode_fn,
-            )
+            if staged:
+                entries = [
+                    make_entry(kv_l, side_l, i)
+                    for kv_l, side_l in zip(kv, sides)
+                ]
+                logits, new_entries = self.model.forward(
+                    params_i,
+                    tok,
+                    entries,
+                    meta,
+                    attn_fn=staged_attn,
+                    kv_write_fn=staged_write,
+                )
+                kv = [e[0] for e in new_entries]
+                sides = [e[1] for e in new_entries]
+            else:
+                logits, kv = self.model.forward(
+                    params_i,
+                    tok,
+                    kv,
+                    meta,
+                    attn_fn=attn_fn,
+                    kv_write_fn=self._kv_write_decode_fn,
+                )
             new_tok, _ = sample(
                 logits,
                 smeta,
@@ -1055,11 +1146,28 @@ class ModelRunner:
                 out_buf = out_buf.at[rows, out_lens + i].set(
                     new_tok, mode="drop"
                 )
-            return (kv, new_tok, out_buf), new_tok
+            return (kv, sides, new_tok, out_buf), new_tok
 
-        (kv_caches, _, _), toks = jax.lax.scan(
+        if staged:
+            sides0 = [
+                jnp.zeros(
+                    (s_pad, 2, k_steps, kv_l.shape[-1]), kv_l.dtype
+                )
+                for kv_l in kv_caches
+            ]
+        else:
+            sides0 = [jnp.zeros((), jnp.int32) for _ in kv_caches]
+        (kv_caches, sides_out, _, _), toks = jax.lax.scan(
             body,
-            (kv_caches, tokens, out_toks),
+            (kv_caches, sides0, tokens, out_toks),
             jnp.arange(k_steps, dtype=jnp.int32),
         )
+        if staged:
+            n_side = jnp.full((1,), k_steps, jnp.int32)
+            kv_caches = [
+                self._kv_flush_fn(
+                    kv_l, side_l, block_tables, base_valid, n_side
+                )
+                for kv_l, side_l in zip(kv_caches, sides_out)
+            ]
         return toks, kv_caches
