@@ -215,6 +215,13 @@ class SchedulerConfig:
     # host round trip — the TPU answer to SURVEY.md §3.3's "push the
     # steady-state loop into a compiled while-loop".  1 disables.
     num_decode_steps: int = 8
+    # Fused-decode dispatches kept in flight before the engine blocks on
+    # results (the reference's max_concurrent_batches, launch.py:298-302,
+    # generalized).  The device carry makes dispatch N+1 independent of
+    # N's results, so depth only trades token-delivery latency for
+    # host/transport-latency hiding; raise it when the chip is reached
+    # over a high-RTT link.
+    max_concurrent_dispatches: int = 2
 
     def __post_init__(self) -> None:
         if self.max_num_batched_tokens < self.max_num_seqs:
@@ -224,6 +231,8 @@ class SchedulerConfig:
             )
         if self.num_decode_steps < 1:
             raise ValueError("num_decode_steps must be >= 1")
+        if self.max_concurrent_dispatches < 1:
+            raise ValueError("max_concurrent_dispatches must be >= 1")
 
 
 @dataclass
@@ -312,6 +321,7 @@ class EngineArgs:
     max_num_batched_tokens: int | None = None
     enable_chunked_prefill: bool = True
     num_decode_steps: int = 8
+    max_concurrent_dispatches: int = 2
 
     # JSON dict (or dict) configuring a KV connector (disaggregated
     # prefill hook, SURVEY.md §3.4); None = off.
@@ -374,6 +384,13 @@ class EngineArgs:
             type=int,
             default=8,
             help="decode steps fused into one device dispatch (1 disables)",
+        )
+        parser.add_argument(
+            "--max-concurrent-dispatches",
+            type=int,
+            default=2,
+            help="fused-decode dispatches kept in flight before the "
+            "engine blocks on results (raise over high-RTT links)",
         )
         parser.add_argument(
             "--no-enable-chunked-prefill",
@@ -441,6 +458,7 @@ class EngineArgs:
             enable_chunked_prefill=self.enable_chunked_prefill,
             max_model_len=model_config.max_model_len,
             num_decode_steps=self.num_decode_steps,
+            max_concurrent_dispatches=self.max_concurrent_dispatches,
         )
         kv_transfer = self.kv_transfer_config
         if isinstance(kv_transfer, str):

@@ -204,7 +204,8 @@ class LLMEngine:
                 scheduler_output, non_block=True
             )
             self._pending.append((scheduler_output, fut))
-            if len(self._pending) > 1:
+            depth = self.config.scheduler_config.max_concurrent_dispatches
+            while len(self._pending) > depth - 1:
                 outputs.extend(self._finalize_one())
             return outputs
         outputs.extend(self._drain_pending())

@@ -45,10 +45,6 @@ import jax.numpy as jnp
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
-def _round_up(x: int, m: int) -> int:
-    return -(-x // m) * m
-
-
 def kv_pool_width(num_kv_heads: int, head_dim: int) -> int:
     """Flat lane width HD of the combined pool.
 

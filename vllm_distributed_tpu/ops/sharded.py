@@ -108,30 +108,3 @@ def shard_kv_flush(flush_fn, mesh: Mesh):
         return f(kv_pages, side_kv, block_tables, base_lens, n_side)
 
     return wrapped
-
-
-def shard_kv_write(write_fn, mesh: Mesh):
-    """Wrap a KV-pool writer to run per-tp-shard under shard_map.
-
-    Every device writes the same token rows into its own kv-head shard of
-    the pool (slot mapping is replicated), so the sharded pool stays
-    consistent and the in-place aliasing of the Pallas writer survives —
-    each shard aliases its local buffer.
-    """
-
-    def wrapped(kv_pages, k, v, slot_mapping):
-        f = jax.shard_map(
-            write_fn,
-            mesh=mesh,
-            in_specs=(
-                _KV_SPEC,
-                P(None, "tp", None),
-                P(None, "tp", None),
-                P(),
-            ),
-            out_specs=_KV_SPEC,
-            check_vma=False,
-        )
-        return f(kv_pages, k, v, slot_mapping)
-
-    return wrapped

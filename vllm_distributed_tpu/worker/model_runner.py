@@ -158,14 +158,11 @@ class ModelRunner:
         from vllm_distributed_tpu.ops.attention import write_kv_pages
 
         self._kv_write_fn = write_kv_pages
-        self._kv_write_decode_fn = self._pick_kv_write_fn()
         # Staged decode writes (side buffer + per-dispatch flush) ride
-        # the Pallas attention path; the XLA reference path keeps the
-        # in-loop functional scatter.
-        self._staged_decode = (
-            self._kv_write_decode_fn is not write_kv_pages
-        )
+        # the Pallas attention path (the flush kernel is its writer);
+        # the XLA reference path keeps the in-loop functional scatter.
         self._kv_flush_fn = self._pick_kv_flush_fn()
+        self._staged_decode = self._kv_flush_fn is not None
         if self.mesh is not None:
             self._dp = self.mesh.shape.get("dp", 1)
             if self._dp & (self._dp - 1):
@@ -204,7 +201,7 @@ class ModelRunner:
 
         uses_pallas = (
             self._attn_fn is not paged_attention_reference
-            or self._kv_write_decode_fn is not write_kv_pages
+            or self._staged_decode
         )
         if not uses_pallas:
             return
@@ -224,10 +221,6 @@ class ModelRunner:
         )
         if self._attn_fn is not paged_attention_reference:
             self._attn_fn = sharded.shard_attention(self._attn_fn, self.mesh)
-        if self._kv_write_decode_fn is not write_kv_pages:
-            self._kv_write_decode_fn = sharded.shard_kv_write(
-                self._kv_write_decode_fn, self.mesh
-            )
         if self._kv_flush_fn is not None:
             self._kv_flush_fn = sharded.shard_kv_flush(
                 self._kv_flush_fn, self.mesh
@@ -255,32 +248,6 @@ class ModelRunner:
 
             return paged_attention_cpu
         return paged_attention_reference
-
-    def _pick_kv_write_fn(self):
-        """Writer for the fused decode scan ONLY: in-place Pallas KV
-        writer on TPU, functional scatter elsewhere.  XLA does not alias
-        the scatter inside the scan (it copies the whole pool per layer
-        per micro-step at large pool sizes), so the aliased kernel is
-        the production decode path.  Prefill/mixed dispatches always use
-        write_kv_pages (see load_model)."""
-        backend = self.attn_backend
-        if backend == "auto":
-            backend = (
-                "pallas" if jax.default_backend() == "tpu" else "reference"
-            )
-        if backend == "pallas":
-            from vllm_distributed_tpu.ops.pallas.kv_update import kv_update
-
-            return kv_update
-        if backend == "pallas_interpret":
-            from vllm_distributed_tpu.ops.pallas.kv_update import (
-                kv_update_cpu,
-            )
-
-            return kv_update_cpu
-        from vllm_distributed_tpu.ops.attention import write_kv_pages
-
-        return write_kv_pages
 
     def _pick_kv_flush_fn(self):
         """Per-dispatch flush of the staged decode side buffers (only
@@ -335,6 +302,28 @@ class ModelRunner:
         ("TPU v2", 16 * 2**30),
     )
 
+    def _pipeline_reserve_bytes(self) -> int:
+        """HBM held by in-flight fused-decode dispatches beyond the pool:
+        each concurrent dispatch's program keeps its staged side buffers
+        ([S, 2, K, HD] per layer) live for the program's duration.  At
+        7B/K=32/depth-6 this is ~3 GiB — unreserved, the allocator
+        thrashes mid-serve (measured: multi-second stalls)."""
+        if not getattr(self, "_staged_decode", False):
+            return 0
+        sc = self.config.scheduler_config
+        m = self.model
+        from vllm_distributed_tpu.ops.attention import kv_pool_width
+
+        side = (
+            sc.max_num_seqs
+            * 2
+            * sc.num_decode_steps
+            * kv_pool_width(m.num_kv_heads, m.head_dim)
+            * jnp.dtype(self.kv_cache_dtype()).itemsize
+            * m.num_layers
+        )
+        return side * max(sc.max_concurrent_dispatches, 1)
+
     def profile_num_pages(self) -> int:
         """Derive the KV pool size from free HBM (the analog of
         gpu_memory_utilization profiling in the inherited engine)."""
@@ -347,8 +336,9 @@ class ModelRunner:
             if jax.default_backend() != "tpu":
                 return 512  # CPU: small default for tests
             # Tunneled TPU runtimes return no stats; budget from the
-            # chip's known HBM minus resident params and a 1 GiB
-            # activation/XLA reserve.
+            # chip's known HBM minus resident params, the pipelined
+            # dispatches' side buffers, and a 1 GiB activation/XLA
+            # reserve.
             kind = getattr(dev, "device_kind", "")
             hbm = next(
                 (b for p, b in self._HBM_BYTES_BY_KIND if kind.startswith(p)),
@@ -361,7 +351,8 @@ class ModelRunner:
                 sum(x.nbytes for x in jax.tree.leaves(self.params)) // shards
             )
             limit = int(hbm * cc.hbm_utilization)
-            free = max(limit - param_bytes - (1 << 30), 0)
+            reserve = (1 << 30) + self._pipeline_reserve_bytes() // shards
+            free = max(limit - param_bytes - reserve, 0)
             per_device_page = self.kv_cache_bytes_per_page() // shards
             num_pages = max(free // max(per_device_page, 1), 16)
             logger.info(
@@ -376,10 +367,13 @@ class ModelRunner:
             return int(num_pages)
         limit = int(stats["bytes_limit"] * cc.hbm_utilization)
         in_use = int(stats.get("bytes_in_use", 0))
-        free = max(limit - in_use, 0)
         shards = 1
         if self.mesh is not None and "tp" in self.mesh.shape:
             shards = self.mesh.shape["tp"]
+        # Stats are per device: the side-buffer reserve shards with tp.
+        free = max(
+            limit - in_use - self._pipeline_reserve_bytes() // shards, 0
+        )
         per_device_page = self.kv_cache_bytes_per_page() // shards
         num_pages = max(free // max(per_device_page, 1), 16)
         logger.info(
@@ -1133,7 +1127,7 @@ class ModelRunner:
                     kv,
                     meta,
                     attn_fn=attn_fn,
-                    kv_write_fn=self._kv_write_decode_fn,
+                    kv_write_fn=self._kv_write_fn,
                 )
             new_tok, _ = sample(
                 logits,
