@@ -149,6 +149,22 @@ def _check_kernels() -> str:
     if kv_err > 0:
         raise AssertionError(f"kv_update mismatch on chip: max err {kv_err}")
 
+    # MoE ragged dispatch: the TPU ragged_dot lowering must be truly
+    # grouped (flops == 2*M*H*I), not masked-dense like the CPU one.
+    e_, h_, i_, m_ = 8, 256, 512, 64
+    xs_ = jnp.zeros((m_, h_), jnp.bfloat16)
+    wg_ = jnp.zeros((e_, h_, i_), jnp.bfloat16)
+    gs_ = jnp.full((e_,), m_ // e_, jnp.int32)
+    rd_flops = (
+        jax.jit(lambda a, b, g: jax.lax.ragged_dot(a, b, g))
+        .lower(xs_, wg_, gs_).compile().cost_analysis().get("flops", 0)
+    )
+    if rd_flops > 2 * m_ * h_ * i_ * 1.5:
+        raise AssertionError(
+            f"ragged_dot lowering is not sparse: {rd_flops} flops vs "
+            f"ideal {2 * m_ * h_ * i_}"
+        )
+
     # int8 weight-streaming matmul vs dequant-in-graph.
     from vllm_distributed_tpu.ops.pallas.quant_matmul import int8_matmul
     from vllm_distributed_tpu.ops.quant import dequantize, quantize
@@ -186,7 +202,7 @@ def _hbm_bw() -> tuple[str, float]:
 
 
 def _run_config(shapes, *, batch, k_steps, quant, timed_dispatches,
-                warm_engine_probe=False):
+                warm_engine_probe=False, timed_dispatches_cap=None):
     """One engine, one decode measurement.  Returns a detail dict."""
     import jax
 
@@ -195,6 +211,8 @@ def _run_config(shapes, *, batch, k_steps, quant, timed_dispatches,
     from vllm_distributed_tpu.sampling_params import SamplingParams
     from vllm_distributed_tpu.testing import write_llama_config
 
+    if timed_dispatches_cap is not None:
+        timed_dispatches = min(timed_dispatches, timed_dispatches_cap)
     warmup_dispatches = 2
     prompt_len = 32
     max_tokens = 1 + k_steps * (warmup_dispatches + timed_dispatches)
@@ -424,8 +442,13 @@ def main() -> None:
         ]
         if os.environ.get("VDT_BENCH_FAST") != "1":
             configs.append(
-                ("llama_7b_int8_b32", dict(
-                    shapes=LLAMA_7B, batch=32, k_steps=32, quant="int8"))
+                # 7B KV is ~1 MiB/token (MHA, 32 layers): the batch and
+                # decode length must FIT the ~6 GiB pool or the scheduler
+                # preempts in a loop mid-bench (r3's "12 s stalls" were
+                # exactly this thrash).  16 seqs x ~290 tokens ~= 4.6 GiB.
+                ("llama_7b_int8_b16", dict(
+                    shapes=LLAMA_7B, batch=16, k_steps=16, quant="int8",
+                    timed_dispatches_cap=16))
             )
 
     details = {}

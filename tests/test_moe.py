@@ -98,3 +98,84 @@ def test_ep_requires_divisible_experts(tiny_mixtral):
     # 4 experts cannot shard 8 ways.
     with pytest.raises(Exception, match="divisible"):
         _greedy(tiny_mixtral, tp=8, ep=True)
+
+
+def _greedy_env(model_dir, env, **kw):
+    import os
+    from unittest import mock
+
+    with mock.patch.dict(os.environ, env):
+        return _greedy(model_dir, **kw)
+
+
+@pytest.fixture(scope="module")
+def tiny_mixtral_16e(tmp_path_factory):
+    # The VERDICT r3 #4 shape: 16 experts, top-2 — sparse dispatch must
+    # do ~k/E of the dense expert FLOPs.
+    return make_tiny_mixtral(
+        str(tmp_path_factory.mktemp("mixtral16")),
+        num_experts=16,
+        top_k=2,
+        heads=8,
+        kv_heads=4,
+    )
+
+
+def test_ragged_matches_dense_16_experts(tiny_mixtral_16e):
+    dense = _greedy_env(tiny_mixtral_16e, {"VDT_MOE_IMPL": "dense"})
+    ragged = _greedy_env(tiny_mixtral_16e, {"VDT_MOE_IMPL": "ragged"})
+    assert ragged == dense
+
+
+def test_ragged_matches_dense_under_ep(tiny_mixtral_16e):
+    dense = _greedy_env(tiny_mixtral_16e, {"VDT_MOE_IMPL": "dense"})
+    ragged_ep = _greedy_env(
+        tiny_mixtral_16e, {"VDT_MOE_IMPL": "ragged"}, tp=4, ep=True
+    )
+    assert ragged_ep == dense
+
+
+def test_ragged_dispatch_is_sparse(tiny_mixtral_16e):
+    """The ragged MLP must dispatch T*k rows through grouped matmuls —
+    not T*E token-expert pairs like the dense path.  Asserted on the
+    jaxpr (op shapes): CPU's ragged_dot lowering is masked-dense, so
+    FLOP counts only reflect sparsity on TPU, where the real lowering
+    was verified at exactly 2*M*H*I flops (bench _check_kernels asserts
+    this on-chip every run)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from vllm_distributed_tpu.config import EngineArgs
+    from vllm_distributed_tpu.models.registry import get_model_class
+
+    config = EngineArgs(
+        model=tiny_mixtral_16e, skip_tokenizer_init=True
+    ).create_engine_config()
+    model = get_model_class(config.model_config.architecture)(
+        config.model_config
+    )
+    params = jax.jit(model.init_params)(jax.random.PRNGKey(0))
+    layer = params["layers"][0]
+    t = 64
+    h = jnp.asarray(np.random.default_rng(0).standard_normal((t, 64)),
+                    jnp.float32)
+
+    jaxpr = jax.make_jaxpr(lambda x: model._mlp_ragged(x, layer))(h)
+
+    def all_eqns(jxp):
+        for eqn in jxp.eqns:
+            yield eqn
+            for v in eqn.params.values():
+                inner = getattr(v, "jaxpr", None)
+                if inner is not None:
+                    yield from all_eqns(inner)
+
+    ragged_eqns = [
+        e for e in all_eqns(jaxpr.jaxpr)
+        if e.primitive.name.startswith("ragged_dot")
+    ]
+    assert len(ragged_eqns) == 3, jaxpr  # w1, w3, w2
+    for eqn in ragged_eqns:
+        m = eqn.invars[0].aval.shape[0]
+        assert m == t * model.top_k, (m, t, model.top_k)

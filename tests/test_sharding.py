@@ -19,15 +19,15 @@ def tiny_llama(tmp_path_factory):
     )
 
 
-def _greedy(model_dir, tp=1, dp=1, env=None):
+def _greedy(model_dir, tp=1, dp=1, env=None, quantization=None):
     import os
     from unittest import mock
 
     with mock.patch.dict(os.environ, env or {}):
-        return _greedy_inner(model_dir, tp, dp)
+        return _greedy_inner(model_dir, tp, dp, quantization)
 
 
-def _greedy_inner(model_dir, tp=1, dp=1):
+def _greedy_inner(model_dir, tp=1, dp=1, quantization=None):
     engine = LLMEngine.from_engine_args(
         EngineArgs(
             model=model_dir,
@@ -36,6 +36,7 @@ def _greedy_inner(model_dir, tp=1, dp=1):
             max_model_len=256,
             tensor_parallel_size=tp,
             data_parallel_size=dp,
+            quantization=quantization,
         )
     )
     for i, p in enumerate(PROMPTS):
@@ -110,3 +111,15 @@ def test_tp8_rejected_when_kv_heads_insufficient(tiny_llama):
     # equals the device count, so this documents the boundary.)
     with pytest.raises(Exception):
         _greedy(tiny_llama, tp=8)
+
+
+def test_tp4_int8_pallas_matches_single_device(tiny_llama):
+    """Sharded int8 weight streaming (VERDICT r3 #5): the Pallas int8
+    matmul under shard_map at tp=4 must be bit-identical to the
+    single-device int8 Pallas path (per-shard streaming changes neither
+    quantization grouping nor accumulation order per output column)."""
+    env = {"VDT_USE_PALLAS": "pallas_interpret"}
+    single = _greedy(tiny_llama, tp=1, env=env, quantization="int8")
+    assert (
+        _greedy(tiny_llama, tp=4, env=env, quantization="int8") == single
+    )

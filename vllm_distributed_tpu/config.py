@@ -170,7 +170,6 @@ class ParallelConfig:
     tensor_parallel_size: int = 1
     pipeline_parallel_size: int = 1
     data_parallel_size: int = 1
-    expert_parallel_size: int = 1
     enable_expert_parallel: bool = False
     # Pluggable executor class or name — the injection point the reference
     # uses for CustomExecutor (launch.py:400-405).
@@ -200,8 +199,6 @@ class ParallelConfig:
                 "data plane was NCCL over a LAN, launch.py:211-314).  Use "
                 "-tp across chips/hosts instead; see README.md."
             )
-        if self.enable_expert_parallel and self.expert_parallel_size == 1:
-            self.expert_parallel_size = self.tensor_parallel_size
 
 
 @dataclass

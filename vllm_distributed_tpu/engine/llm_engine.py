@@ -238,9 +238,23 @@ class LLMEngine:
                 if req is None:
                     continue
             new_tokens = runner_output.sampled_token_ids.get(req_id, [])
+            # Prompt tokens count as PROCESSED (per prefill step), not on
+            # first-token arrival — aborted/preempted prefills contribute
+            # like vLLM's accounting.
+            n_prefill = runner_output.num_prompt_tokens_processed.get(
+                req_id, 0
+            )
+            if n_prefill:
+                req.metrics.prompt_tokens_counted += n_prefill
+                self.metrics.record_prompt_tokens(n_prefill)
             if new_tokens and req.metrics.first_token_time is None:
                 req.metrics.first_token_time = now
-                self.metrics.record_prompt_tokens(req.num_prompt_tokens)
+                # The final prefill chunk samples a token and reports no
+                # num_prompt_tokens_processed: count the remainder here.
+                rest = req.num_prompt_tokens - req.metrics.prompt_tokens_counted
+                if rest > 0:
+                    req.metrics.prompt_tokens_counted += rest
+                    self.metrics.record_prompt_tokens(rest)
             self.metrics.record_new_tokens(
                 req.metrics, len(new_tokens), now
             )

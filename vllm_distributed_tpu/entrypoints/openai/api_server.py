@@ -12,6 +12,7 @@ VLLM_HTTP_TIMEOUT_KEEP_ALIVE, launch.py:445), and the tool-parser hook
 from __future__ import annotations
 
 import asyncio
+import hmac
 import json
 import time
 from dataclasses import dataclass, field
@@ -80,7 +81,9 @@ async def auth_middleware(request: web.Request, handler):
     state: ServerState = request.app["state"]
     if state.api_key and request.path not in _UNAUTHENTICATED:
         header = request.headers.get("Authorization", "")
-        if header != f"Bearer {state.api_key}":
+        expect = f"Bearer {state.api_key}".encode()
+        got = header.encode("utf-8", "surrogateescape")
+        if not hmac.compare_digest(got, expect):
             return _error("invalid or missing API key", 401)
     return await handler(request)
 

@@ -41,6 +41,10 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # force the jax platform (cpu for tests, tpu in prod)
     "VDT_PLATFORM": lambda: os.environ.get("VDT_PLATFORM", ""),
     "VDT_USE_PALLAS": lambda: os.environ.get("VDT_USE_PALLAS", "auto"),
+    # MoE expert dispatch: "ragged" (sorted jax.lax.ragged_dot, ~k/E of
+    # the dense FLOPs) or "dense" (every expert on every token — the
+    # correctness oracle).
+    "VDT_MOE_IMPL": lambda: os.environ.get("VDT_MOE_IMPL", "ragged"),
     # --- external, replicated for weight download ---
     "HF_TOKEN": lambda: os.environ.get("HF_TOKEN", ""),
     "HUGGING_FACE_HUB_TOKEN": lambda: os.environ.get("HUGGING_FACE_HUB_TOKEN", ""),

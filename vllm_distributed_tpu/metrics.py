@@ -146,6 +146,9 @@ class EngineMetrics:
                 req_metrics.first_token_time - req_metrics.arrival_time
             )
             n_after_first = n - 1
+            # A fused dispatch can deliver the first token WITH its
+            # successors: their intervals start at the first token.
+            last = req_metrics.first_token_time
         else:
             n_after_first = n
         if last is not None and n_after_first > 0:

@@ -111,7 +111,7 @@ def _quantize_and_place(model, tensor, spec: P, mesh: Mesh | None, dtype):
         bits,
         group,
         dtype=dtype,
-        matmul=pick_matmul_mode(mesh, model.quant_method),
+        matmul=pick_matmul_mode(model.quant_method),
     )
     if mesh is not None:
         qt = place_quantized(qt, spec, mesh)

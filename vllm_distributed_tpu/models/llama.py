@@ -215,6 +215,10 @@ class LlamaForCausalLM(SupportsQuantization):
                 and w.bits == 8
                 and w.q.ndim == 2
                 and w.matmul in ("pallas", "pallas_interpret")
+                # A tp-sharded concat along the out dim would interleave
+                # shards of q|k|v instead of sharding the fused tensor:
+                # fusion is a single-chip optimization only.
+                and w.mesh is None
                 for w in ws
             ):
                 return None

@@ -54,6 +54,11 @@ class QuantizedTensor:
     shape: tuple  # logical (dequantized) shape
     dtype: Any  # logical dtype
     matmul: str = "dequant"
+    # Stamped at device placement under a mesh: the weight's logical
+    # partition entries for (input, output) dims plus the mesh itself,
+    # so quant_matmul can shard_map the streaming kernel per tp shard.
+    spec: Any = None
+    mesh: Any = None
 
     def tree_flatten(self):
         return (self.q, self.scale), (
@@ -62,6 +67,8 @@ class QuantizedTensor:
             self.shape,
             self.dtype,
             self.matmul,
+            self.spec,
+            self.mesh,
         )
 
     @classmethod
@@ -151,12 +158,13 @@ def maybe_dequantize(w, dtype) -> jax.Array:
     return w.astype(dtype)
 
 
-def pick_matmul_mode(mesh, quant_method: str | None) -> str:
+def pick_matmul_mode(quant_method: str | None) -> str:
     """Execution backend for quantized matmuls, decided at load time:
-    "dequant" composes with GSPMD (tp/dp>1 — a custom call would break
-    its partitioning); "pallas" streams int8 tiles through the Pallas
-    kernel on the single-chip TPU path."""
-    if mesh is not None or quant_method != "int8":
+    "pallas" streams int8 tiles through the Pallas kernel — single-chip
+    directly, tp>1 per shard under shard_map (quant_matmul wraps it;
+    GSPMD cannot partition the custom call itself).  int4 and
+    non-quantized stay "dequant"."""
+    if quant_method != "int8":
         return "dequant"
     from vllm_distributed_tpu import envs
 
@@ -181,34 +189,96 @@ def _pick_block(out_dim: int, in_dim: int, x_nbytes: int) -> int | None:
     return None
 
 
+def _sharded_int8_matmul(x, w: QuantizedTensor, interpret: bool):
+    """Per-tp-shard streaming matmul under shard_map (GSPMD cannot
+    partition the Pallas custom call).  Column-parallel weights
+    (out dim sharded) keep the output sharded with no collective;
+    row-parallel weights (in dim sharded) psum the partial products
+    inside the region.  Returns None when the layout is unsupported
+    (caller falls back to dequant-in-graph)."""
+    from vllm_distributed_tpu.ops.pallas.quant_matmul import int8_matmul
+
+    in_ax, out_ax = w.spec
+    mesh = w.mesh
+    if in_ax is not None and out_ax is not None:
+        return None
+    axis = out_ax if out_ax is not None else in_ax
+    shards = axis_shards(axis, mesh) if axis is not None else 1
+    # Keep the batch dim data-parallel inside the region (dp=1 makes
+    # this a no-op; dp>1 must not all-gather the activations).
+    dp_ax = "dp" if mesh.shape.get("dp", 1) > 1 else None
+    dp = mesh.shape.get("dp", 1) if dp_ax else 1
+    if x.shape[0] % dp:
+        return None
+    if out_ax is not None:
+        out_local = w.q.shape[-1] // shards
+        blk = _pick_block(out_local, w.q.shape[0], x.nbytes // dp)
+        if blk is None:
+            return None
+
+        def body(x_, q_, s_):
+            return int8_matmul(
+                x_, q_, s_, block_out=blk, interpret=interpret
+            )
+
+        in_specs = (P(dp_ax), P(None, out_ax), P(out_ax))
+        out_specs = P(dp_ax, out_ax)
+    else:
+        in_local = w.q.shape[0] // shards
+        # Each shard's kernel sees x already split over the in dim.
+        blk = _pick_block(
+            w.q.shape[-1], in_local, x.nbytes // (shards * dp)
+        )
+        if blk is None:
+            return None
+
+        def body(x_, q_, s_):
+            part = int8_matmul(
+                x_, q_, s_, block_out=blk, interpret=interpret
+            )
+            if in_ax is not None:
+                part = jax.lax.psum(part, in_ax)
+            return part
+
+        in_specs = (P(dp_ax, in_ax), P(in_ax, None), P())
+        out_specs = P(dp_ax)
+    f = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    return f(x, w.q, w.scale)
+
+
 def quant_matmul(x: jax.Array, w, bias=None) -> jax.Array:
     """x @ w for plain or QuantizedTensor weights.  On the Pallas path
     eligible int8 2D weights stream through ops/pallas/quant_matmul (the
-    only HBM traffic is the int8 bytes); everything else dequantizes
+    only HBM traffic is the int8 bytes) — per tp shard under shard_map
+    when the weight was placed on a mesh; everything else dequantizes
     in-graph."""
     if isinstance(w, QuantizedTensor):
         from vllm_distributed_tpu.ops.pallas.quant_matmul import int8_matmul
 
-        blk = (
-            _pick_block(w.q.shape[-1], w.q.shape[0], x.nbytes)
-            if w.q.ndim == 2 and x.ndim == 2
-            else None
-        )
+        interpret = w.matmul == "pallas_interpret"
         eligible = (
             w.matmul != "dequant"
             and w.bits == 8
-            and blk is not None
+            and w.q.ndim == 2
+            and x.ndim == 2
             and x.shape[0] <= 256
         )
-        if eligible:
-            out = int8_matmul(
-                x,
-                w.q,
-                w.scale,
-                block_out=blk,
-                interpret=w.matmul == "pallas_interpret",
-            )
-        else:
+        out = None
+        if eligible and w.mesh is not None and w.spec is not None:
+            out = _sharded_int8_matmul(x, w, interpret)
+        elif eligible and w.mesh is None:
+            blk = _pick_block(w.q.shape[-1], w.q.shape[0], x.nbytes)
+            if blk is not None:
+                out = int8_matmul(
+                    x, w.q, w.scale, block_out=blk, interpret=interpret
+                )
+        if out is None:
             out = x @ dequantize(w, x.dtype)
     else:
         out = x @ w.astype(x.dtype)
@@ -244,10 +314,9 @@ def place_quantized(qt: QuantizedTensor, wspec: P, mesh) -> QuantizedTensor:
     from jax.sharding import NamedSharding
 
     qs = quant_spec(wspec, qt.bits)
+    q_spec = aligned_spec(qs.q, qt.q.shape, mesh)
     return QuantizedTensor(
-        jax.device_put(
-            qt.q, NamedSharding(mesh, aligned_spec(qs.q, qt.q.shape, mesh))
-        ),
+        jax.device_put(qt.q, NamedSharding(mesh, q_spec)),
         jax.device_put(
             qt.scale,
             NamedSharding(mesh, aligned_spec(qs.scale, qt.scale.shape, mesh)),
@@ -257,6 +326,8 @@ def place_quantized(qt: QuantizedTensor, wspec: P, mesh) -> QuantizedTensor:
         qt.shape,
         qt.dtype,
         qt.matmul,
+        spec=tuple(q_spec) if qt.q.ndim == 2 else None,
+        mesh=mesh,
     )
 
 

@@ -65,6 +65,9 @@ class RequestMetrics:
     finished_time: float | None = None
     # Wall time of the most recent token delivery (ITL instrumentation).
     last_token_time: float | None = None
+    # Prompt tokens already reported to vllm:prompt_tokens (prefill
+    # progress is counted per processed step, remainder at first token).
+    prompt_tokens_counted: int = 0
 
     @property
     def ttft(self) -> float | None:
