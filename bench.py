@@ -397,6 +397,67 @@ def _measure(engine, build, free_engine, *, batch, k_steps, quant,
     return detail
 
 
+def _serve_probe() -> dict:
+    """HTTP-path serving metrics (BASELINE.md's TTFT/ITL are SERVING
+    numbers): boot the OpenAI server on the 1B dummy model and drive it
+    with concurrent SSE completions via `vdt bench serve`'s client."""
+    import argparse
+    import asyncio
+    import socket
+
+    from aiohttp.test_utils import TestServer
+
+    from vllm_distributed_tpu.config import EngineArgs
+    from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+    from vllm_distributed_tpu.entrypoints.cli import _bench_serve_async
+    from vllm_distributed_tpu.entrypoints.openai.api_server import (
+        build_app,
+        init_app_state,
+    )
+    from vllm_distributed_tpu.testing import LLAMA_1B, write_llama_config
+
+    model_dir = write_llama_config(**LLAMA_1B)
+    engine = AsyncLLM.from_engine_args(
+        EngineArgs(
+            model=model_dir,
+            skip_tokenizer_init=True,
+            load_format="dummy",
+            quantization="int8",
+            max_num_seqs=16,
+            max_model_len=512,
+            num_decode_steps=16,
+            max_concurrent_dispatches=6,
+            warmup_decode=True,
+        )
+    )
+    state = init_app_state(engine, served_model_name="bench-1b")
+    loop = asyncio.new_event_loop()
+    try:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        server = TestServer(build_app(state), port=port)
+        loop.run_until_complete(server.start_server())
+        args = argparse.Namespace(
+            url=f"http://127.0.0.1:{port}",
+            model="bench-1b",
+            num_prompts=16,
+            concurrency=8,
+            input_len=32,
+            output_len=128,
+        )
+        # Warmup pass (compiles), then the measured pass.  Same prompt
+        # count/concurrency so the ramp hits the same batch buckets.
+        warm = argparse.Namespace(**{**vars(args), "output_len": 16})
+        loop.run_until_complete(_bench_serve_async(warm))
+        result = loop.run_until_complete(_bench_serve_async(args))
+        loop.run_until_complete(server.close())
+        return result
+    finally:
+        engine.shutdown()
+        loop.close()
+
+
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     # Persistent XLA compile cache: makes the warm-TTFT probe measure
@@ -474,6 +535,13 @@ def main() -> None:
     if best is None:
         raise RuntimeError(f"every bench config failed: {details}")
 
+    serve_detail = None
+    if not on_cpu and os.environ.get("VDT_BENCH_SERVE", "1") == "1":
+        try:
+            serve_detail = _serve_probe()
+        except Exception as e:  # noqa: BLE001
+            serve_detail = {"error": f"{type(e).__name__}: {e}"}
+
     n_chips = jax.local_device_count()
     result = {
         # p50-dispatch-derived steady state (see tokens_per_sec_p50 note
@@ -492,6 +560,7 @@ def main() -> None:
                 "llama_1b_bf16_b32", {}
             ).get("tokens_per_sec"),
             "pallas_kernel_check": kernel_check,
+            "serve_http": serve_detail,
             "configs": details,
         },
     }

@@ -1,0 +1,81 @@
+"""`vdt bench serve` — HTTP/SSE serving benchmark against a live server
+(the reference wires `vllm bench serve`, launch.py:21-25; BASELINE.md's
+tracked TTFT/ITL are SERVING metrics, so they must be measurable through
+the API, not just the engine loop)."""
+
+import argparse
+import asyncio
+import socket
+
+import pytest
+from aiohttp.test_utils import TestServer
+
+from tests.utils import add_tiny_tokenizer, make_tiny_llama
+from vllm_distributed_tpu.config import EngineArgs
+from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+from vllm_distributed_tpu.entrypoints.cli import _bench_serve_async
+from vllm_distributed_tpu.entrypoints.openai.api_server import (
+    build_app,
+    init_app_state,
+)
+
+
+@pytest.fixture(scope="module")
+def live_server(tmp_path_factory):
+    model_dir = make_tiny_llama(str(tmp_path_factory.mktemp("bsrv")))
+    add_tiny_tokenizer(model_dir)
+    engine = AsyncLLM.from_engine_args(
+        EngineArgs(
+            model=model_dir,
+            num_kv_pages=128,
+            max_model_len=128,
+            max_num_seqs=8,
+        )
+    )
+    state = init_app_state(engine, served_model_name="tiny")
+
+    loop = asyncio.new_event_loop()
+    port = None
+    server = None
+
+    async def start():
+        nonlocal server, port
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        server = TestServer(build_app(state), port=port)
+        await server.start_server()
+
+    loop.run_until_complete(start())
+    yield loop, f"http://127.0.0.1:{port}"
+    loop.run_until_complete(server.close())
+    engine.shutdown()
+    loop.close()
+
+
+def test_bench_serve_reports_http_path_metrics(live_server):
+    loop, url = live_server
+    args = argparse.Namespace(
+        url=url,
+        model="tiny",
+        num_prompts=6,
+        concurrency=3,
+        input_len=8,
+        output_len=12,
+    )
+    result = loop.run_until_complete(_bench_serve_async(args))
+
+    assert result["mode"] == "serve"
+    assert result["output_tokens_per_s"] > 0
+    assert result["requests_per_s"] > 0
+    # Client-side latency distributions through the SSE stream.
+    assert result["ttft_s"]["p50"] > 0
+    assert result["itl_ms"]["p50"] >= 0
+    assert result["ttft_s"]["p99"] >= result["ttft_s"]["p50"]
+    # Server-side cross-check from /metrics deltas over the run.
+    sm = result["server_metrics"]
+    assert sm["generation_tokens"] == 6 * 12
+    assert sm["ttft_mean_s"] > 0
+    # The two views of TTFT must be the same order of magnitude (client
+    # adds only HTTP overhead on loopback).
+    assert sm["ttft_mean_s"] < result["ttft_s"]["p99"] * 3 + 1.0

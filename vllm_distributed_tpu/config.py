@@ -219,6 +219,9 @@ class SchedulerConfig:
     # host/transport-latency hiding; raise it when the chip is reached
     # over a high-RTT link.
     max_concurrent_dispatches: int = 2
+    # Pre-compile the fused-decode programs for every batch bucket at
+    # boot (adds startup time; removes mid-serve recompile stalls).
+    warmup_decode: bool = False
 
     def __post_init__(self) -> None:
         if self.max_num_batched_tokens < self.max_num_seqs:
@@ -319,6 +322,7 @@ class EngineArgs:
     enable_chunked_prefill: bool = True
     num_decode_steps: int = 8
     max_concurrent_dispatches: int = 2
+    warmup_decode: bool = False
 
     # JSON dict (or dict) configuring a KV connector (disaggregated
     # prefill hook, SURVEY.md §3.4); None = off.
@@ -390,6 +394,12 @@ class EngineArgs:
             "engine blocks on results (raise over high-RTT links)",
         )
         parser.add_argument(
+            "--warmup-decode",
+            action="store_true",
+            help="pre-compile fused-decode programs for every batch "
+            "bucket at boot (no mid-serve recompile stalls)",
+        )
+        parser.add_argument(
             "--no-enable-chunked-prefill",
             dest="enable_chunked_prefill",
             action="store_false",
@@ -456,6 +466,7 @@ class EngineArgs:
             max_model_len=model_config.max_model_len,
             num_decode_steps=self.num_decode_steps,
             max_concurrent_dispatches=self.max_concurrent_dispatches,
+            warmup_decode=self.warmup_decode,
         )
         kv_transfer = self.kv_transfer_config
         if isinstance(kv_transfer, str):

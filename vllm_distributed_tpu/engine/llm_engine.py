@@ -42,6 +42,8 @@ class LLMEngine:
 
         num_pages = self.executor.determine_num_pages()
         self.executor.initialize_cache(num_pages)
+        if config.scheduler_config.warmup_decode:
+            self.executor.warmup_decode()
         self.scheduler = Scheduler(
             config.scheduler_config, config.cache_config, num_pages
         )

@@ -15,6 +15,7 @@ import asyncio
 import json
 import os
 import sys
+import time
 
 from vllm_distributed_tpu.config import EngineArgs
 from vllm_distributed_tpu.logger import init_logger
@@ -67,14 +68,21 @@ def make_parser() -> argparse.ArgumentParser:
     remote.add_argument("server_ip", type=str)
     remote.add_argument("--server-port", type=int, default=None)
 
-    bench = sub.add_parser("bench", help="offline latency/throughput bench")
+    bench = sub.add_parser(
+        "bench",
+        help="latency/throughput bench (offline) or serve (live HTTP)",
+    )
     bench.add_argument(
-        "mode", choices=["latency", "throughput"], default="throughput",
-        nargs="?",
+        "mode", choices=["latency", "throughput", "serve"],
+        default="throughput", nargs="?",
     )
     bench.add_argument("--input-len", type=int, default=32)
     bench.add_argument("--output-len", type=int, default=64)
     bench.add_argument("--num-prompts", type=int, default=32)
+    # serve mode: drives a LIVE server over HTTP/SSE (the reference's
+    # `vllm bench serve`, launch.py:21-25) — engine args unused.
+    bench.add_argument("--url", default="http://localhost:8000")
+    bench.add_argument("--concurrency", type=int, default=8)
     EngineArgs.add_cli_args(bench)
 
     sub.add_parser("collect-env", help="print environment diagnostics")
@@ -160,11 +168,155 @@ def cmd_remote(args: argparse.Namespace) -> None:
 
 
 # ---- bench ----
+def _percentiles(xs: list[float]) -> dict:
+    xs = sorted(xs)
+
+    def pct(p):
+        return round(xs[min(int(len(xs) * p), len(xs) - 1)], 4)
+
+    return {"p50": pct(0.5), "p90": pct(0.9), "p99": pct(0.99)}
+
+
+async def _bench_serve_async(args: argparse.Namespace) -> dict:
+    """Drive a LIVE server with concurrent streaming completions and
+    measure TTFT/ITL/throughput as the CLIENT sees them over SSE, then
+    cross-check against the server's own /metrics histograms (the
+    serving metrics BASELINE.md tracks are HTTP-path numbers, not
+    engine-loop numbers)."""
+    import aiohttp
+
+    url = args.url.rstrip("/")
+    sem = asyncio.Semaphore(args.concurrency)
+    ttfts: list[float] = []
+    itls: list[float] = []
+    out_tokens = 0
+
+    async def scrape_metrics(session) -> dict:
+        try:
+            async with session.get(f"{url}/metrics") as r:
+                text = await r.text()
+        except Exception:  # noqa: BLE001 — metrics are optional
+            return {}
+        want = {
+            "vllm:time_to_first_token_seconds_sum",
+            "vllm:time_to_first_token_seconds_count",
+            "vllm:time_per_output_token_seconds_sum",
+            "vllm:time_per_output_token_seconds_count",
+            "vllm:generation_tokens_total",
+        }
+        out = {}
+        for line in text.splitlines():
+            if line.startswith("#"):
+                continue
+            parts = line.split()
+            if len(parts) == 2 and parts[0].split("{")[0] in want:
+                key = parts[0].split("{")[0]
+                out[key] = out.get(key, 0.0) + float(parts[1])
+        return out
+
+    async def one(session, i: int) -> None:
+        nonlocal out_tokens
+        prompt = [(13 * i + j) % 900 + 1 for j in range(args.input_len)]
+        body = {
+            "model": args.model or "bench",
+            "prompt": prompt,
+            "max_tokens": args.output_len,
+            "temperature": 0.0,
+            "ignore_eos": True,
+            "stream": True,
+        }
+        async with sem:
+            t0 = time.perf_counter()
+            chunk_times: list[float] = []
+            async with session.post(
+                f"{url}/v1/completions", json=body
+            ) as resp:
+                resp.raise_for_status()
+                async for raw in resp.content:
+                    line = raw.decode().strip()
+                    if not line.startswith("data:"):
+                        continue
+                    payload = line[5:].strip()
+                    if payload == "[DONE]":
+                        break
+                    chunk = json.loads(payload)
+                    choice = chunk.get("choices", [{}])[0]
+                    # Token-bearing chunks: anything before the finish
+                    # marker ("text" may be empty when the server runs
+                    # without a tokenizer, e.g. dummy-weight benches).
+                    if not choice.get("finish_reason"):
+                        chunk_times.append(time.perf_counter())
+        if chunk_times:
+            ttfts.append(chunk_times[0] - t0)
+            out_tokens += args.output_len
+            if args.output_len > 1:
+                # Client-side per-token interval: tokens arrive in fused
+                # bursts, so spread the span over the tokens after the
+                # first (the serving ITL definition).
+                span = chunk_times[-1] - chunk_times[0]
+                itls.append(span / (args.output_len - 1))
+
+    timeout = aiohttp.ClientTimeout(total=None, sock_read=600)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        before = await scrape_metrics(session)
+        t0 = time.perf_counter()
+        await asyncio.gather(
+            *(one(session, i) for i in range(args.num_prompts))
+        )
+        elapsed = time.perf_counter() - t0
+        after = await scrape_metrics(session)
+
+    result = {
+        "mode": "serve",
+        "url": url,
+        "num_prompts": args.num_prompts,
+        "concurrency": args.concurrency,
+        "input_len": args.input_len,
+        "output_len": args.output_len,
+        "elapsed_s": round(elapsed, 3),
+        "output_tokens_per_s": round(out_tokens / elapsed, 1),
+        "requests_per_s": round(args.num_prompts / elapsed, 3),
+        "ttft_s": _percentiles(ttfts) if ttfts else None,
+        "itl_ms": (
+            {k: round(v * 1e3, 3) for k, v in _percentiles(itls).items()}
+            if itls
+            else None
+        ),
+    }
+    if after:
+        # Server-side cross-check: deltas of the Prometheus histograms
+        # over the run window.
+        def delta(key):
+            return after.get(key, 0.0) - before.get(key, 0.0)
+
+        ttft_n = delta("vllm:time_to_first_token_seconds_count")
+        itl_n = delta("vllm:time_per_output_token_seconds_count")
+        result["server_metrics"] = {
+            "ttft_mean_s": round(
+                delta("vllm:time_to_first_token_seconds_sum")
+                / max(ttft_n, 1),
+                4,
+            ),
+            "itl_mean_ms": round(
+                delta("vllm:time_per_output_token_seconds_sum")
+                / max(itl_n, 1)
+                * 1e3,
+                3,
+            ),
+            "generation_tokens": delta("vllm:generation_tokens_total"),
+        }
+    return result
+
+
 def cmd_bench(args: argparse.Namespace) -> None:
     import time
 
     from vllm_distributed_tpu.engine.llm_engine import LLMEngine
     from vllm_distributed_tpu.sampling_params import SamplingParams
+
+    if args.mode == "serve":
+        print(json.dumps(asyncio.run(_bench_serve_async(args))))
+        return
 
     engine_args = EngineArgs.from_cli_args(args)
     engine = LLMEngine.from_engine_args(engine_args)

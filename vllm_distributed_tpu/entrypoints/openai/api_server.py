@@ -492,8 +492,11 @@ async def _stream_completion(
     async def send_json(payload: str) -> None:
         await response.write(f"data: {payload}\n\n".encode())
 
+    no_tokenizer = state.engine.tokenizer is None
+
     async def stream_one(choice_idx: int, text, ids) -> None:
         sent = 0
+        sent_toks = 0
         async for out in state.engine.generate(
             f"{request_id}-{choice_idx}",
             prompt=text,
@@ -503,7 +506,12 @@ async def _stream_completion(
             comp = out.outputs[0]
             delta = comp.text[sent:]
             sent = len(comp.text)
-            if delta or comp.finished:
+            new_toks = len(comp.token_ids) - sent_toks
+            sent_toks = len(comp.token_ids)
+            # Without a tokenizer (dummy-weight serving/benches) there is
+            # no text to delta — stream empty chunks on token arrival so
+            # SSE timing still reflects token delivery.
+            if delta or comp.finished or (no_tokenizer and new_toks):
                 chunk = CompletionResponse(
                     id=request_id,
                     model=state.model_name,

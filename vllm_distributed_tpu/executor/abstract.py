@@ -136,6 +136,11 @@ class Executor:
     def initialize_cache(self, num_pages: int) -> None:
         self.collective_rpc("initialize_cache", (num_pages,))
 
+    def warmup_decode(self) -> None:
+        # Pre-compile the fused-decode programs for every batch
+        # bucket (boot-time; keeps serving recompile-free).
+        self.collective_rpc("warmup_decode")
+
     def register_failure_callback(self, callback: FailureCallback) -> None:
         """Engine asks to be told about worker loss (launch.py:316-320)."""
         if self.is_failed:

@@ -143,9 +143,10 @@ class ModelRunner:
         )
         # Single-chip fast path: fuse quantized Q|K|V and gate|up so
         # each layer issues one weight-streaming kernel call instead of
-        # three/two (bit-identical results).  Self-gating: fusion only
-        # touches tensors the loader stamped with the kernel mode, which
-        # pick_matmul_mode only does when mesh is None.
+        # three/two (bit-identical results).  Self-gating: fusable()
+        # requires w.mesh is None — a tp-sharded out-dim concat would
+        # interleave shards of q|k|v instead of sharding the fused
+        # tensor (llama.py fuse_quantized_projections).
         if hasattr(self.model, "fuse_quantized_projections"):
             self.params = self.model.fuse_quantized_projections(self.params)
         self._attn_fn = self._pick_attn_fn()
@@ -411,6 +412,89 @@ class ModelRunner:
     def init_kv_cache(self, num_pages: int) -> None:
         self.num_pages = num_pages
         self.kv_caches = self.alloc_kv_pool(num_pages)
+
+    def warmup_decode(self) -> int:
+        """Pre-compile the fused-decode programs for every batch bucket
+        (and both pipelining variants) so serving never recompiles
+        mid-stream when the running set grows — the source of the
+        multi-second mid-serve stalls VERDICT r3 #3 flagged.  Returns
+        the number of dispatches issued.  Synthetic requests write into
+        reserved page 0 (garbage by contract) and are removed after."""
+        import time as _time
+
+        from vllm_distributed_tpu.engine.scheduler import (
+            CachedRequestData,
+            SchedulerOutput,
+        )
+
+        sc = self.config.scheduler_config
+        k = sc.num_decode_steps
+        if k <= 1 or self.kv_caches is None:
+            return 0
+        t0 = _time.monotonic()
+        buckets: list[int] = []
+        b = max(_MIN_SEQ_BUCKET, self._dp)
+        while b < sc.max_num_seqs:
+            buckets.append(b)
+            b *= 2
+        buckets.append(
+            max(next_power_of_2(sc.max_num_seqs), _MIN_SEQ_BUCKET, self._dp)
+        )
+        pages_pad = self._pages_bucket(cdiv(2 + 2 * k, self.page_size))
+        n = 0
+        for s_pad in buckets:
+            ids = [f"__warm-{i}" for i in range(s_pad)]
+            for i, rid in enumerate(ids):
+                self.requests[rid] = CachedReqState(
+                    req_id=rid,
+                    token_ids=[1, 1],
+                    sampling_params=SamplingParams(
+                        temperature=0.0, max_tokens=2 * k + 2
+                    ),
+                    page_ids=[0] * pages_pad,
+                    num_computed=1,
+                    prefill_target=1,
+                    num_prompt=1,
+                )
+
+            def so(step):
+                return SchedulerOutput(
+                    step_id=step,
+                    cached_requests=[
+                        CachedRequestData(
+                            req_id=rid,
+                            new_page_ids=[],
+                            num_computed_tokens=1 + step * k,
+                            num_new_tokens=k,
+                        )
+                        for rid in ids
+                    ],
+                    num_scheduled_tokens={rid: k for rid in ids},
+                    total_num_scheduled_tokens=s_pad * k,
+                    decode_steps=k,
+                )
+
+            # Two back-to-back dispatches without resolving compile both
+            # pipelining variants.  The scheduler deltas for the second
+            # dispatch must land first — they advance num_computed past
+            # the host token list, which is what flips use_carry=True.
+            r1 = self._execute_decode_steps(so(0))
+            self._apply_scheduler_deltas(so(1))
+            assert self._decode_carry is not None
+            r2 = self._execute_decode_steps(so(1))
+            r1()
+            r2()
+            n += 2
+            for rid in ids:
+                self.requests.pop(rid, None)
+            self._decode_carry = None
+        logger.info(
+            "decode warmup: %d dispatches over %s seq buckets in %.1fs",
+            n,
+            buckets,
+            _time.monotonic() - t0,
+        )
+        return n
 
     # ---- auxiliary (non-scheduled) forwards: embeddings & scoring ----
     @partial(jax.jit, static_argnames=("self",))

@@ -109,6 +109,9 @@ class Worker:
     def initialize_cache(self, num_pages: int) -> None:
         self.runner.init_kv_cache(num_pages)
 
+    def warmup_decode(self) -> int:
+        return self.runner.warmup_decode()
+
     def execute_model(
         self, scheduler_output: SchedulerOutput, defer: bool = False
     ) -> ModelRunnerOutput | None:
