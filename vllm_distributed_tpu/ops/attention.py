@@ -69,10 +69,51 @@ def kv_pool_shape(
     )
 
 
+# Lane width of the per-(plane, token) scale rows of a quantized KV
+# pool.  One f32 scalar replicated over a few lanes so a page's scales
+# are a clean [page, 8] 2-D slab for DMA (sub-lane 1-wide arrays are
+# not tileable); 8 lanes keep the overhead at 32 B/token (~6 % of a
+# 512-lane int8 page row).
+KV_SCALE_LANES = 8
+
+
+def kv_scales_shape(num_pages: int, page_size: int) -> tuple:
+    return (2, num_pages, page_size, KV_SCALE_LANES)
+
+
+def quantize_kv_rows(
+    k: jax.Array, v: jax.Array, hd: int
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-token symmetric int8 quantization of K/V rows.
+
+    Returns (q_k [T, HD] int8, q_v, s_k [T] f32, s_v) where
+    row = q * s exactly reconstructs up to rounding."""
+    t = k.shape[0]
+    kf = k.reshape(t, -1).astype(jnp.float32)
+    vf = v.reshape(t, -1).astype(jnp.float32)
+    if kf.shape[-1] < hd:
+        pad = [(0, 0), (0, hd - kf.shape[-1])]
+        kf = jnp.pad(kf, pad)
+        vf = jnp.pad(vf, pad)
+    s_k = jnp.maximum(jnp.max(jnp.abs(kf), axis=-1), 1e-8) / 127.0
+    s_v = jnp.maximum(jnp.max(jnp.abs(vf), axis=-1), 1e-8) / 127.0
+    q_k = jnp.clip(jnp.round(kf / s_k[:, None]), -127, 127).astype(jnp.int8)
+    q_v = jnp.clip(jnp.round(vf / s_v[:, None]), -127, 127).astype(jnp.int8)
+    return q_k, q_v, s_k, s_v
+
+
 def split_kv_pages(
-    kv_pages: jax.Array, num_kv_heads: int, head_dim: int
+    kv_pages, num_kv_heads: int, head_dim: int
 ) -> tuple[jax.Array, jax.Array]:
-    """Views of the combined pool as per-head [P, page, Hkv, D] K and V."""
+    """Views of the combined pool as per-head [P, page, Hkv, D] K and V.
+
+    A quantized pool ((int8 data, scales) tuple) dequantizes to f32."""
+    if isinstance(kv_pages, tuple):
+        data, scales = kv_pages
+        deq = data.astype(jnp.float32) * scales[..., 0:1]
+        _, p, page, hd = data.shape
+        shape = (p, page, num_kv_heads, head_dim)
+        return deq[0].reshape(shape), deq[1].reshape(shape)
     _, p, page, hd = kv_pages.shape
     shape = (p, page, num_kv_heads, head_dim)
     return kv_pages[0].reshape(shape), kv_pages[1].reshape(shape)
@@ -120,18 +161,35 @@ class AttentionMetadata:
 
 
 def write_kv_pages(
-    kv_pages: jax.Array,  # [2, P, page, HD]
+    kv_pages,  # [2, P, page, HD] or (int8 pool, scales) tuple
     k: jax.Array,  # [T, Hkv, D]
     v: jax.Array,
     slot_mapping: jax.Array,
-) -> jax.Array:
-    """Scatter this step's K/V into the combined paged pool.
+):
+    """Scatter this step's K/V into the combined paged pool (quantizing
+    on write when the pool is int8).
 
     Functional reference / CPU / prefill path.  The production decode
     path is the per-row dynamic_update_slice writer
     (ops/pallas/kv_update.py) — XLA does not keep this scatter in place
     inside the fused decode scan at large pool sizes.
     """
+    if isinstance(kv_pages, tuple):
+        data, scales = kv_pages
+        _, _, page_size, hd = data.shape
+        q_k, q_v, s_k, s_v = quantize_kv_rows(k, v, hd)
+        pages = slot_mapping // page_size
+        rows = slot_mapping % page_size
+        data = data.at[0, pages, rows].set(q_k)
+        data = data.at[1, pages, rows].set(q_v)
+        lanes = scales.shape[-1]
+        scales = scales.at[0, pages, rows].set(
+            jnp.broadcast_to(s_k[:, None], (s_k.shape[0], lanes))
+        )
+        scales = scales.at[1, pages, rows].set(
+            jnp.broadcast_to(s_v[:, None], (s_v.shape[0], lanes))
+        )
+        return (data, scales)
     _, _, page_size, hd = kv_pages.shape
     t, hkv, d = k.shape
     k = k.reshape(t, hkv * d).astype(kv_pages.dtype)
