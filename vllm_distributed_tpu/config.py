@@ -223,6 +223,18 @@ class SchedulerConfig:
     # boot (adds startup time; removes mid-serve recompile stalls).
     warmup_decode: bool = False
 
+    def fused_decode_steps(self) -> int:
+        """The uniform fused-scan length K the scheduler emits: the
+        configured num_decode_steps clamped by the token budget at the
+        FULL batch size (so K never varies with batch growth — every
+        distinct K compiles its own scan) and floored to a power of 2.
+        The single source of truth for schedule() and warmup_decode."""
+        k = min(
+            self.num_decode_steps,
+            max(self.max_num_batched_tokens // self.max_num_seqs, 1),
+        )
+        return 1 << (k.bit_length() - 1)
+
     def __post_init__(self) -> None:
         if self.max_num_batched_tokens < self.max_num_seqs:
             raise ValueError(
@@ -233,6 +245,19 @@ class SchedulerConfig:
             raise ValueError("num_decode_steps must be >= 1")
         if self.max_concurrent_dispatches < 1:
             raise ValueError("max_concurrent_dispatches must be >= 1")
+        if 1 < self.num_decode_steps and (
+            self.fused_decode_steps() < self.num_decode_steps
+        ):
+            logger.warning(
+                "num_decode_steps=%d is clamped to %d by the token "
+                "budget at full batch (max_num_batched_tokens=%d / "
+                "max_num_seqs=%d); raise the budget to keep the "
+                "configured fusion depth",
+                self.num_decode_steps,
+                self.fused_decode_steps(),
+                self.max_num_batched_tokens,
+                self.max_num_seqs,
+            )
 
 
 @dataclass

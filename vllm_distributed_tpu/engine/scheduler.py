@@ -163,9 +163,14 @@ class Scheduler:
 
         # Multi-step decode: when the whole batch is decoding and nothing
         # is waiting to be admitted, fuse K decode steps into one device
-        # dispatch.  K is clamped so no request can overrun its length
-        # limit mid-scan, and floored to a power of two to bound the
-        # number of distinct compiled scan lengths.  Logprobs force K=1
+        # dispatch.  K is UNIFORM (the configured value, clamped only by
+        # the shared token budget): a request whose remaining length
+        # budget is under K is scheduled with num_new < K and the worker
+        # masks its trailing micro-steps on device.  This keeps ONE
+        # compiled scan length per config — the r4 design derived K from
+        # min(remaining room), so every request tail walked K down
+        # through 8/4/2/1 and compiled a fresh multi-second program
+        # mid-serve (measured 14-23 s each on v5e).  Logprobs force K=1
         # (per-step [S, V] logprob fetches don't amortize).
         k = 1
         if (
@@ -177,21 +182,7 @@ class Scheduler:
                 r.sampling_params.logprobs is None for r in self.running
             )
         ):
-            rooms = [
-                min(r.max_total_tokens, self.config.max_model_len)
-                - r.num_tokens
-                - r.num_inflight_tokens
-                for r in self.running
-            ]
-            positive = [x for x in rooms if x > 0]
-            if positive:
-                k = max(min(self.config.num_decode_steps, min(positive)), 1)
-                # Clamp by the token budget so len(running)*k never
-                # exceeds max_num_batched_tokens: without this, large
-                # batches would exhaust the budget on the first
-                # budget//k requests and starve the tail every step.
-                k = min(k, max(token_budget // len(self.running), 1))
-                k = 1 << (k.bit_length() - 1)  # power-of-2 floor
+            k = self.config.fused_decode_steps()
         out.decode_steps = k
 
         # 1) decodes + in-flight chunked prefills, in arrival order.
@@ -219,7 +210,9 @@ class Scheduler:
                 )
                 if room <= 0:
                     continue
-                num_new = k
+                # Under-K tails are masked on device, not given their
+                # own scan length (see the K comment above).
+                num_new = min(k, room)
             got = self._allocate_or_preempt(
                 req,
                 req.num_inflight_tokens + num_new,

@@ -40,7 +40,7 @@ def _kernel(
     base_lens_ref,  # [S] int32 (pool-resident length; <=0 = skip row)
     page0_ref,  # [S] int32: base // page_size (logical first page)
     page_ids_ref,  # [S, NPT] int32: touched page ids (clamped, padded)
-    n_side_ref,  # [1] int32: rows to flush per sequence (<= K)
+    n_side_ref,  # [S] int32: rows to flush per sequence (<= K)
     side_ref,  # [1, 2, K, HD] VMEM block (this sequence's staged rows)
     pool_in,  # [2, P, page, HD] ANY (aliased with pool_out)
     pool_out,
@@ -54,60 +54,69 @@ def _kernel(
     s = pl.program_id(0)
     num_s = pl.num_programs(0)
     buf = s % 2
-    live = base_lens_ref[s] > 0
+    live = (base_lens_ref[s] > 0) & (n_side_ref[s] > 0)
 
-    def read_copies(seq, buf):
-        copies = []
+    # Page id 0 is the reserved dump page (slack slab columns route
+    # there): skip its copies entirely so the dump-page contract stays
+    # read-only for this kernel — an unskipped write-back would race the
+    # next sequence's prefetched read of the same page.  Start and wait
+    # predicates read the same page_ids entries, so DMA semaphore
+    # accounting stays balanced.
+    def _for_each(seq, buf, action, direction):
         for pt in range(npt):
             page = page_ids_ref[seq, pt]
-            for kvi in range(2):
-                copies.append(
-                    pltpu.make_async_copy(
-                        pool_in.at[kvi, page],
-                        slab_vmem.at[
-                            buf, kvi, pl.ds(pt * page_size, page_size)
-                        ],
-                        read_sems.at[buf],
-                    )
-                )
-        return copies
 
-    def write_copies(seq, buf):
-        copies = []
-        for pt in range(npt):
-            page = page_ids_ref[seq, pt]
-            for kvi in range(2):
-                copies.append(
-                    pltpu.make_async_copy(
-                        slab_vmem.at[
-                            buf, kvi, pl.ds(pt * page_size, page_size)
-                        ],
-                        pool_out.at[kvi, page],
-                        write_sem,
-                    )
-                )
-        return copies
+            @pl.when(page != 0)
+            def _go(page=page, pt=pt):
+                for kvi in range(2):
+                    slab = slab_vmem.at[
+                        buf, kvi, pl.ds(pt * page_size, page_size)
+                    ]
+                    if direction == "read":
+                        cp = pltpu.make_async_copy(
+                            pool_in.at[kvi, page], slab, read_sems.at[buf]
+                        )
+                    else:
+                        cp = pltpu.make_async_copy(
+                            slab, pool_out.at[kvi, page], write_sem
+                        )
+                    getattr(cp, action)()
+
+    def start_reads(seq, buf):
+        _for_each(seq, buf, "start", "read")
+
+    def wait_reads(seq, buf):
+        _for_each(seq, buf, "wait", "read")
+
+    def start_writes(seq, buf):
+        _for_each(seq, buf, "start", "write")
+
+    def wait_writes(seq, buf):
+        _for_each(seq, buf, "wait", "write")
 
     # Prologue: nobody prefetched row 0's slabs.
     @pl.when((s == 0) & live)
     def _first_reads():
-        for cp in read_copies(s, buf):
-            cp.start()
+        start_reads(s, buf)
 
     # Prefetch the next sequence's slabs while this one modifies/writes.
+    # The predicate must MATCH the next grid step's `live` exactly: a
+    # started copy whose wait is skipped would leave its semaphore
+    # signaled for a later sequence on the same buffer parity.
+    nxt = jnp.minimum(s + 1, num_s - 1)
+
     @pl.when(
         (s + 1 < num_s)
-        & (base_lens_ref[jnp.minimum(s + 1, num_s - 1)] > 0)
+        & (base_lens_ref[nxt] > 0)
+        & (n_side_ref[nxt] > 0)
     )
     def _next_reads():
-        for cp in read_copies(s + 1, (s + 1) % 2):
-            cp.start()
+        start_reads(nxt, (s + 1) % 2)
 
     @pl.when(live)
     def _modify_and_write():
-        for cp in read_copies(s, buf):
-            cp.wait()
-        n_side = n_side_ref[0]
+        wait_reads(s, buf)
+        n_side = n_side_ref[s]
         rows = npt * page_size
         base = base_lens_ref[s]
         off = base - page0_ref[s] * page_size  # first row's slab offset
@@ -124,11 +133,8 @@ def _kernel(
             shifted = pltpu.roll(padded, off, 0).astype(slab_vmem.dtype)
             cur = slab_vmem[buf, kvi]
             slab_vmem[buf, kvi] = jnp.where(in_window, shifted, cur)
-        write_backs = write_copies(s, buf)
-        for cp in write_backs:
-            cp.start()
-        for cp in write_backs:
-            cp.wait()
+        start_writes(s, buf)
+        wait_writes(s, buf)
 
 
 def kv_flush(
@@ -136,15 +142,18 @@ def kv_flush(
     side_kv: jax.Array,  # [S, 2, K, HD]
     block_tables: jax.Array,  # [S, max_pages] int32
     base_lens: jax.Array,  # [S] int32 (0 = padding row, skipped)
-    n_side: jax.Array,  # [1] int32: rows written per sequence
+    n_side: jax.Array,  # [S] (or [1], broadcast) int32: rows per sequence
     *,
     interpret: bool = False,
 ) -> jax.Array:
-    """Write each live sequence's staged rows [base, base+n_side) into
-    the pool, in place (aliased)."""
+    """Write each live sequence's staged rows [base, base+n_side[s])
+    into the pool, in place (aliased).  Per-sequence lengths let the
+    fused decode scan mask under-K request tails (model_runner)."""
     _, p_total, page_size, hd = kv_pages.shape
     s, _, k_blk, _ = side_kv.shape
     npt = (k_blk + page_size - 1) // page_size + 1
+    if n_side.shape[0] != s:
+        n_side = jnp.broadcast_to(n_side, (s,))
 
     page0 = base_lens // page_size
     pts = page0[:, None] + jnp.arange(npt, dtype=jnp.int32)[None, :]
