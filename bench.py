@@ -202,7 +202,8 @@ def _hbm_bw() -> tuple[str, float]:
 
 
 def _run_config(shapes, *, batch, k_steps, quant, timed_dispatches,
-                warm_engine_probe=False, timed_dispatches_cap=None):
+                kv_dtype="auto", warm_engine_probe=False,
+                timed_dispatches_cap=None):
     """One engine, one decode measurement.  Returns a detail dict."""
     import jax
 
@@ -232,6 +233,7 @@ def _run_config(shapes, *, batch, k_steps, quant, timed_dispatches,
                     os.environ.get("VDT_BENCH_PIPELINE", "6")
                 ),
                 quantization=quant,
+                kv_cache_dtype=kv_dtype,
             )
         )
 
@@ -492,6 +494,7 @@ def main() -> None:
             batch=int(os.environ.get("VDT_BENCH_BATCH", "32")),
             k_steps=int(os.environ.get("VDT_BENCH_STEPS", "16")),
             quant=os.environ.get("VDT_BENCH_QUANT") or None,
+            kv_dtype=os.environ.get("VDT_BENCH_KV", "auto"),
         )
         configs = [(explicit or "tiny", cfg)]
     else:

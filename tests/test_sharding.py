@@ -19,15 +19,19 @@ def tiny_llama(tmp_path_factory):
     )
 
 
-def _greedy(model_dir, tp=1, dp=1, env=None, quantization=None):
+def _greedy(
+    model_dir, tp=1, dp=1, env=None, quantization=None, kv_cache_dtype="auto"
+):
     import os
     from unittest import mock
 
     with mock.patch.dict(os.environ, env or {}):
-        return _greedy_inner(model_dir, tp, dp, quantization)
+        return _greedy_inner(model_dir, tp, dp, quantization, kv_cache_dtype)
 
 
-def _greedy_inner(model_dir, tp=1, dp=1, quantization=None):
+def _greedy_inner(
+    model_dir, tp=1, dp=1, quantization=None, kv_cache_dtype="auto"
+):
     engine = LLMEngine.from_engine_args(
         EngineArgs(
             model=model_dir,
@@ -37,6 +41,7 @@ def _greedy_inner(model_dir, tp=1, dp=1, quantization=None):
             tensor_parallel_size=tp,
             data_parallel_size=dp,
             quantization=quantization,
+            kv_cache_dtype=kv_cache_dtype,
         )
     )
     for i, p in enumerate(PROMPTS):
@@ -111,6 +116,19 @@ def test_tp8_rejected_when_kv_heads_insufficient(tiny_llama):
     # equals the device count, so this documents the boundary.)
     with pytest.raises(Exception):
         _greedy(tiny_llama, tp=8)
+
+
+def test_tp4_int8_kv_cache_matches_single_device(tiny_llama):
+    """Quantized KV pool under tp=4 shard_map: per-shard per-head
+    quantization at flush is the SAME reduction as single-device
+    (scales are per kv head and heads shard whole), so greedy tokens
+    must be bit-identical to the single-device int8-KV run."""
+    env = {"VDT_USE_PALLAS": "pallas_interpret"}
+    single = _greedy(tiny_llama, tp=1, env=env, kv_cache_dtype="int8")
+    assert (
+        _greedy(tiny_llama, tp=4, env=env, kv_cache_dtype="int8")
+        == single
+    )
 
 
 def test_tp4_int8_pallas_matches_single_device(tiny_llama):

@@ -142,19 +142,25 @@ class CacheConfig:
     page_size: int = 16
     num_pages: int | None = None
     hbm_utilization: float = 0.9
-    cache_dtype: str = "auto"  # "auto" follows model dtype
+    # "auto" follows model dtype; "int8" quantizes the pool per (token,
+    # kv head) — ~2x capacity, ~2x less attention HBM traffic; staged
+    # decode rows quantize at flush, numerics run f32 in-kernel.
+    cache_dtype: str = "auto"
 
-    _CACHE_DTYPES = ("auto", "bfloat16", "float16", "float32")
+    _CACHE_DTYPES = ("auto", "bfloat16", "float16", "float32", "int8")
 
     def __post_init__(self) -> None:
         if self.page_size & (self.page_size - 1):
             raise ValueError(f"page_size must be a power of 2, got {self.page_size}")
+        if self.cache_dtype == "fp8":
+            raise ValueError(
+                "fp8 KV cache is not supported on TPU (no fp8 VPU "
+                "path on v5e) — use --kv-cache-dtype int8"
+            )
         if self.cache_dtype not in self._CACHE_DTYPES:
             raise ValueError(
                 f"unsupported kv-cache dtype {self.cache_dtype!r}; "
-                f"supported: {self._CACHE_DTYPES} (quantized KV caches "
-                "are not implemented — weights quantize via "
-                "--quantization)"
+                f"supported: {self._CACHE_DTYPES}"
             )
 
 

@@ -69,37 +69,32 @@ def kv_pool_shape(
     )
 
 
-# Lane width of the per-(plane, token) scale rows of a quantized KV
-# pool.  One f32 scalar replicated over a few lanes so a page's scales
-# are a clean [page, 8] 2-D slab for DMA (sub-lane 1-wide arrays are
-# not tileable); 8 lanes keep the overhead at 32 B/token (~6 % of a
-# 512-lane int8 page row).
-KV_SCALE_LANES = 8
+def kv_scales_shape(
+    num_pages: int, page_size: int, num_kv_heads: int
+) -> tuple:
+    """Scale plane of an int8 KV pool: one f32 scale PER (token, kv
+    head), lane axis = kv heads.  Per-head (not per-token-row) scales
+    are what make the quantized pool TP-shardable: the flat HD lane
+    axis shards per head, so each shard's local absmax over its own
+    heads' lanes IS the per-head scale — bit-identical to the
+    unsharded computation, with the scale array sharding over the same
+    lane axis (``tp`` must divide num_kv_heads, enforced at load)."""
+    return (2, num_pages, page_size, num_kv_heads)
 
 
-def kv_scales_shape(num_pages: int, page_size: int) -> tuple:
-    return (2, num_pages, page_size, KV_SCALE_LANES)
+def quantize_kv_heads(
+    k: jax.Array,  # [..., Hkv * D] flat rows (model dtype)
+    num_kv_heads: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-(row, kv-head) int8 quantization.
 
-
-def quantize_kv_rows(
-    k: jax.Array, v: jax.Array, hd: int
-) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Per-token symmetric int8 quantization of K/V rows.
-
-    Returns (q_k [T, HD] int8, q_v, s_k [T] f32, s_v) where
-    row = q * s exactly reconstructs up to rounding."""
-    t = k.shape[0]
-    kf = k.reshape(t, -1).astype(jnp.float32)
-    vf = v.reshape(t, -1).astype(jnp.float32)
-    if kf.shape[-1] < hd:
-        pad = [(0, 0), (0, hd - kf.shape[-1])]
-        kf = jnp.pad(kf, pad)
-        vf = jnp.pad(vf, pad)
-    s_k = jnp.maximum(jnp.max(jnp.abs(kf), axis=-1), 1e-8) / 127.0
-    s_v = jnp.maximum(jnp.max(jnp.abs(vf), axis=-1), 1e-8) / 127.0
-    q_k = jnp.clip(jnp.round(kf / s_k[:, None]), -127, 127).astype(jnp.int8)
-    q_v = jnp.clip(jnp.round(vf / s_v[:, None]), -127, 127).astype(jnp.int8)
-    return q_k, q_v, s_k, s_v
+    Returns (q [..., Hkv*D] int8, s [..., Hkv] f32) with
+    row ≈ q * s[..., head_of_lane]."""
+    d = k.shape[-1] // num_kv_heads
+    kf = k.astype(jnp.float32).reshape(*k.shape[:-1], num_kv_heads, d)
+    s = jnp.maximum(jnp.max(jnp.abs(kf), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(kf / s[..., None]), -127, 127).astype(jnp.int8)
+    return q.reshape(k.shape), s
 
 
 def split_kv_pages(
@@ -107,13 +102,17 @@ def split_kv_pages(
 ) -> tuple[jax.Array, jax.Array]:
     """Views of the combined pool as per-head [P, page, Hkv, D] K and V.
 
-    A quantized pool ((int8 data, scales) tuple) dequantizes to f32."""
+    A quantized pool ((int8 data, per-head scales) tuple) dequantizes
+    to f32."""
     if isinstance(kv_pages, tuple):
         data, scales = kv_pages
-        deq = data.astype(jnp.float32) * scales[..., 0:1]
         _, p, page, hd = data.shape
         shape = (p, page, num_kv_heads, head_dim)
-        return deq[0].reshape(shape), deq[1].reshape(shape)
+        deq = (
+            data.astype(jnp.float32).reshape(2, *shape)
+            * scales[..., None]
+        )
+        return deq[0], deq[1]
     _, p, page, hd = kv_pages.shape
     shape = (p, page, num_kv_heads, head_dim)
     return kv_pages[0].reshape(shape), kv_pages[1].reshape(shape)
@@ -177,18 +176,20 @@ def write_kv_pages(
     if isinstance(kv_pages, tuple):
         data, scales = kv_pages
         _, _, page_size, hd = data.shape
-        q_k, q_v, s_k, s_v = quantize_kv_rows(k, v, hd)
+        hkv = scales.shape[-1]
+        t = k.shape[0]
+        q_k, s_k = quantize_kv_heads(k.reshape(t, -1), hkv)
+        q_v, s_v = quantize_kv_heads(v.reshape(t, -1), hkv)
+        if q_k.shape[-1] < hd:  # sub-tile pools pad HD (like below)
+            pad = [(0, 0), (0, hd - q_k.shape[-1])]
+            q_k = jnp.pad(q_k, pad)
+            q_v = jnp.pad(q_v, pad)
         pages = slot_mapping // page_size
         rows = slot_mapping % page_size
         data = data.at[0, pages, rows].set(q_k)
         data = data.at[1, pages, rows].set(q_v)
-        lanes = scales.shape[-1]
-        scales = scales.at[0, pages, rows].set(
-            jnp.broadcast_to(s_k[:, None], (s_k.shape[0], lanes))
-        )
-        scales = scales.at[1, pages, rows].set(
-            jnp.broadcast_to(s_v[:, None], (s_v.shape[0], lanes))
-        )
+        scales = scales.at[0, pages, rows].set(s_k)
+        scales = scales.at[1, pages, rows].set(s_v)
         return (data, scales)
     _, _, page_size, hd = kv_pages.shape
     t, hkv, d = k.shape

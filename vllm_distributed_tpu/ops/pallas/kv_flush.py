@@ -138,17 +138,56 @@ def _kernel(
 
 
 def kv_flush(
-    kv_pages: jax.Array,  # [2, P, page, HD]
-    side_kv: jax.Array,  # [S, 2, K, HD]
+    kv_pages,  # [2, P, page, HD] — or (int8 data, per-head scales)
+    side_kv: jax.Array,  # [S, 2, K, HD] (model dtype)
     block_tables: jax.Array,  # [S, max_pages] int32
     base_lens: jax.Array,  # [S] int32 (0 = padding row, skipped)
     n_side: jax.Array,  # [S] (or [1], broadcast) int32: rows per sequence
     *,
     interpret: bool = False,
-) -> jax.Array:
+):
     """Write each live sequence's staged rows [base, base+n_side[s])
     into the pool, in place (aliased).  Per-sequence lengths let the
-    fused decode scan mask under-K request tails (model_runner)."""
+    fused decode scan mask under-K request tails (model_runner).
+
+    For an int8 pool the staged rows are quantized per kv head here
+    (plain XLA — a [S, 2, K] reduction, off the micro-step path); the
+    int8 data planes go through the overlay kernel, while the f32
+    scale planes — ~HD/(4·Hkv)× smaller, and too narrow for Mosaic's
+    128-lane DMA slice alignment — are written by a functional XLA
+    scatter on the donated buffer (in place; worst case one small copy
+    per dispatch).  Under shard_map each shard quantizes its own
+    heads' lanes, which is bit-identical to the global per-head
+    computation."""
+    if isinstance(kv_pages, tuple):
+        from vllm_distributed_tpu.ops.attention import quantize_kv_heads
+
+        data, scales = kv_pages
+        hkv = scales.shape[-1]
+        side_q, side_s = quantize_kv_heads(side_kv, hkv)
+        data = kv_flush(
+            data, side_q, block_tables, base_lens, n_side,
+            interpret=interpret,
+        )
+        s, _, k_blk, _ = side_kv.shape
+        page_size = data.shape[2]
+        if n_side.shape[0] != s:
+            n_side = jnp.broadcast_to(n_side, (s,))
+        # Row j of sequence s lands at pool position base+j; rows past
+        # n_side[s] (and dead sequences) scatter into dump page 0.
+        j = jnp.arange(k_blk, dtype=jnp.int32)[None, :]
+        pos = base_lens[:, None] + j  # [S, K]
+        live = (base_lens[:, None] > 0) & (j < n_side[:, None])
+        page_idx = jnp.where(live, pos // page_size, 0)
+        pages = jnp.take_along_axis(
+            block_tables, jnp.minimum(page_idx, block_tables.shape[1] - 1),
+            axis=1,
+        )
+        pages = jnp.where(live, pages, 0)
+        rows = jnp.where(live, pos % page_size, 0)
+        scales = scales.at[0, pages, rows].set(side_s[:, 0])
+        scales = scales.at[1, pages, rows].set(side_s[:, 1])
+        return (data, scales)
     _, p_total, page_size, hd = kv_pages.shape
     s, _, k_blk, _ = side_kv.shape
     npt = (k_blk + page_size - 1) // page_size + 1

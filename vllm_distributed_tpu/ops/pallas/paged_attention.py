@@ -73,9 +73,12 @@ def _kernel(
     seq_lens_ref,  # [S] int32
     chunk_starts_ref,  # [S] int32
     # then inputs (q_ref [1,1,NF,ROWS,FD]; side_ref [1,2,K,HD] when
-    # has_side; kv_pages_ref [2,P,page,HD] ANY), the out block
-    # [1,1,NF,ROWS,FD], and scratch (kv_vmem [NBUF,2,BLK,HD], m/l
-    # [NF,ROWS,LANES] f32, acc [NF,ROWS,FD] f32, DMA sems [NBUF],
+    # has_side; kv_pages_ref [2,P,page,HD] ANY; scale_blk_ref
+    # [1,2,Hkv,BLK] f32 when has_quant — a regular pipelined block of
+    # the per-sequence TRANSPOSED scale matrix the wrapper gathers in
+    # XLA, so the kernel never DMAs sub-128-lane scale slabs), the out
+    # block [1,1,NF,ROWS,FD], and scratch (kv_vmem [NBUF,2,BLK,HD],
+    # m/l [NF,ROWS,LANES] f32, acc [NF,ROWS,FD] f32, DMA sems [NBUF],
     # cnt SMEM [2] = [completed active blocks (the buffer-rotation
     # cursor), prefetch-pending flag]).
     *rest,
@@ -88,18 +91,16 @@ def _kernel(
     fold_width: int,
     mq_blk: int,
     has_side: bool,
+    has_quant: bool,
 ):
-    if has_side:
-        (
-            side_len_ref, q_ref, side_ref, kv_pages_ref, out_ref,
-            kv_vmem, m_scr, l_scr, acc_scr, sems, cnt,
-        ) = rest
-    else:
-        side_len_ref = side_ref = None
-        (
-            q_ref, kv_pages_ref, out_ref,
-            kv_vmem, m_scr, l_scr, acc_scr, sems, cnt,
-        ) = rest
+    rest = list(rest)
+    side_len_ref = rest.pop(0) if has_side else None
+    q_ref = rest.pop(0)
+    side_ref = rest.pop(0) if has_side else None
+    kv_pages_ref = rest.pop(0)
+    scale_blk_ref = rest.pop(0) if has_quant else None
+    out_ref, kv_vmem = rest.pop(0), rest.pop(0)
+    m_scr, l_scr, acc_scr, sems, cnt = rest
     s = pl.program_id(0)
     qb = pl.program_id(1)
     kvb = pl.program_id(2)
@@ -122,7 +123,9 @@ def _kernel(
 
     def block_dma(seq, block_idx, buf):
         """Two descriptors per page (K plane, V plane), each covering
-        every head's lanes contiguously."""
+        every head's lanes contiguously.  (Quantized pools: the scale
+        block arrives through the regular BlockSpec pipeline, not here.)
+        """
         copies = []
         for i in range(pages_per_blk):
             page = block_tables_ref[seq, block_idx * pages_per_blk + i]
@@ -182,16 +185,33 @@ def _kernel(
         q_pos = chunk_start + qb * mq_blk + row_ids % mq_blk
         return q_pos, col_ids
 
-    def flash_update(nf, k, v, mask):
-        """One online-softmax accumulation step for fold group nf."""
+    def scale_mat(st, nf, ncols):
+        """[ROWS, ncols] dequant factors for fold nf from transposed
+        per-head scales st [Hkv, ncols]: row r of the fold covers head
+        nf*F + r // (G*mq) (block-diagonal row layout), so each head's
+        scale row broadcasts over its G*mq query rows.  Off-diagonal
+        lanes get the ROW's head scale (not the lane's) — harmless,
+        they are discarded by the diagonal extraction outside."""
+        f = acc_scr.shape[1] // (group_size * mq_blk)
+        sub = st[nf * f : nf * f + f]  # [F, ncols] (static slice)
+        return jnp.broadcast_to(
+            sub[:, None, :], (f, group_size * mq_blk, ncols)
+        ).reshape(f * group_size * mq_blk, ncols)
+
+    def flash_update(nf, k, v, mask, sk=None, sv=None):
+        """One online-softmax accumulation step for fold group nf.
+        ``sk``/``sv`` are [ROWS, ncols] dequant factors (int8 pool);
+        the K factor folds into the scores, the V factor into p before
+        the PV matmul — both cheaper than lane-expanding the scale to
+        dequantize the [ncols, FD] tiles themselves."""
         qn = q_ref[0, 0, nf].astype(jnp.float32)  # [ROWS, FD]
-        scores = (
-            jax.lax.dot_general(
-                qn, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            * scale
+        scores = jax.lax.dot_general(
+            qn, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )  # [ROWS, ncols]
+        if sk is not None:
+            scores = scores * sk
+        scores = scores * scale
         if soft_cap is not None:
             scores = jnp.tanh(scores / soft_cap) * soft_cap
         scores = jnp.where(mask, scores, _MASK)
@@ -205,6 +225,8 @@ def _kernel(
         l_new = l_scr[nf, :, 0:1] * alpha + jnp.sum(
             p, axis=-1, keepdims=True
         )
+        if sv is not None:
+            p = p * sv
         pv = jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -221,11 +243,23 @@ def _kernel(
         q_pos, col_ids = row_positions(blk)
         c_pos = block_start + col_ids
         mask = (c_pos <= q_pos) & (c_pos < seq_len)
+        if has_quant:
+            # Pre-transposed by the wrapper: [Hkv, BLK] — fold slices
+            # are contiguous sublane rows.
+            st_k = scale_blk_ref[0, 0]
+            st_v = scale_blk_ref[0, 1]
         for nf in range(num_fold):
             lo = nf * fold_width
             k = kv_vmem[buf, 0, :, lo : lo + fold_width].astype(jnp.float32)
             v = kv_vmem[buf, 1, :, lo : lo + fold_width].astype(jnp.float32)
-            flash_update(nf, k, v, mask)
+            if has_quant:
+                flash_update(
+                    nf, k, v, mask,
+                    sk=scale_mat(st_k, nf, blk),
+                    sv=scale_mat(st_v, nf, blk),
+                )
+            else:
+                flash_update(nf, k, v, mask)
         cnt[0] = cnt[0] + 1
         cnt[1] = has_next.astype(jnp.int32)
 
@@ -325,8 +359,18 @@ def paged_attention(
     (the pool is flushed once per dispatch).  Row j of a sequence's side
     buffer holds position ``metadata.seq_lens[s] + j`` (seq_lens is the
     POOL-resident length when staging); columns ``>= side_len`` are not
-    yet written and are masked."""
+    yet written and are masked.
+
+    An int8 pool arrives as a ``(data, per-head scales)`` tuple
+    (ops/attention.py kv_scales_shape); the kernel DMAs the tiny scale
+    slabs alongside the data pages and folds the dequant factors into
+    the score/probability matrices (the side buffer stays in model
+    dtype — only pool history is quantized)."""
     t, hq, d = q.shape
+    has_quant = isinstance(kv_pages, tuple)
+    kv_scales = None
+    if has_quant:
+        kv_pages, kv_scales = kv_pages
     _, p_total, page_size, hd_pad = kv_pages.shape
     s, max_pages = metadata.block_tables.shape
     hkv = num_kv_heads if num_kv_heads is not None else hq
@@ -379,8 +423,14 @@ def paged_attention(
 
     # ---- kv blocking: size blocks to the VMEM budget ----
     kv_bytes_per_token = 2 * hd_pad * jnp.dtype(kv_pages.dtype).itemsize
+    if has_quant:
+        kv_bytes_per_token += 2 * hkv * 4  # f32 scale rows
     blk_tokens = max(_KV_BUF_BYTES // kv_bytes_per_token, page_size)
     blk_tokens = min(_pow2_floor(blk_tokens), max_pages * page_size)
+    if has_quant and blk_tokens < 128 and blk_tokens < max_pages * page_size:
+        # The scale block's lane dim is BLK: it must be a 128 multiple
+        # (or cover the whole context) for Mosaic's tiling.
+        blk_tokens = min(128, max_pages * page_size)
     pages_per_blk = max(blk_tokens // page_size, 1)
     num_kvb = cdiv(max_pages, pages_per_blk)
     blk = pages_per_blk * page_size
@@ -405,6 +455,7 @@ def paged_attention(
         fold_width=fd,
         mq_blk=mq_blk,
         has_side=has_side,
+        has_quant=has_quant,
     )
     in_specs = [
         pl.BlockSpec(
@@ -427,6 +478,35 @@ def paged_attention(
         inputs.append(side_kv)
     in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
     inputs.append(kv_pages)
+    if has_quant:
+        # Per-sequence transposed scale matrix [S, 2, Hkv, CTX_PAD],
+        # gathered in XLA (loop-invariant per fused dispatch, so XLA
+        # hoists it out of the decode scan).  The kernel consumes
+        # lane-aligned [1, 2, Hkv, BLK] blocks via the regular
+        # pipeline — a manual [page, Hkv] DMA slab would violate
+        # Mosaic's 128-lane slice alignment.  Known cost: prefill/mixed
+        # steps pay the gather each step, sized by the pages_pad bucket
+        # (Hkv/ (4*D) of the data bytes — ~3% at D=64/f32 scales);
+        # acceptable next to the chunk's matmul work, and shrinkable
+        # with bf16 scales if it ever shows up in a profile.
+        ctx_pad = num_kvb * blk
+        sc = kv_scales[:, block_tables]  # [2, S, PAD_PAGES, page, Hkv]
+        sc = sc.transpose(1, 0, 4, 2, 3).reshape(s, 2, hkv, ctx_pad)
+        in_specs.append(
+            pl.BlockSpec(
+                (1, 2, hkv, blk),
+                lambda s_, qb_, b_, *refs: (s_, 0, 0, b_),
+            )
+        )
+        inputs.append(sc)
+    scratch = [pltpu.VMEM((_NBUF, 2, blk, hd_pad), kv_pages.dtype)]
+    scratch += [
+        pltpu.VMEM((nf, rows, _LANES), jnp.float32),
+        pltpu.VMEM((nf, rows, _LANES), jnp.float32),
+        pltpu.VMEM((nf, rows, fd), jnp.float32),
+        pltpu.SemaphoreType.DMA((_NBUF,)),
+        pltpu.SMEM((2,), jnp.int32),
+    ]
     out_bd = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -437,14 +517,7 @@ def paged_attention(
                 (1, 1, nf, rows, fd),
                 lambda s_, qb_, b_, *refs: (s_, qb_, 0, 0, 0),
             ),
-            scratch_shapes=[
-                pltpu.VMEM((_NBUF, 2, blk, hd_pad), kv_pages.dtype),
-                pltpu.VMEM((nf, rows, _LANES), jnp.float32),
-                pltpu.VMEM((nf, rows, _LANES), jnp.float32),
-                pltpu.VMEM((nf, rows, fd), jnp.float32),
-                pltpu.SemaphoreType.DMA((_NBUF,)),
-                pltpu.SMEM((2,), jnp.int32),
-            ],
+            scratch_shapes=scratch,
         ),
         out_shape=jax.ShapeDtypeStruct((s, num_qb, nf, rows, fd), q.dtype),
         interpret=interpret,

@@ -59,6 +59,15 @@ def _check_divisible(mesh: Mesh, num_heads: int, num_kv_heads: int) -> None:
         )
 
 
+def _pool_spec(kv_pages):
+    """Sharding spec(s) for a pool operand: an int8 pool is a (data,
+    scales) tuple — the scale plane's lane axis is kv heads, which
+    shards over tp exactly like the data plane's per-head HD lanes."""
+    if isinstance(kv_pages, tuple):
+        return (_KV_SPEC, _KV_SPEC)
+    return _KV_SPEC
+
+
 def shard_attention(attn_fn, mesh: Mesh):
     """Wrap a paged-attention kernel to run per-tp-shard under shard_map."""
     tp = mesh.shape.get("tp", 1)
@@ -75,7 +84,7 @@ def shard_attention(attn_fn, mesh: Mesh):
                 kw.update(side_kv=side_args[0], side_len=side_args[1])
             return attn_fn(q_, kv_, m_, num_kv_heads=hkv // tp, **kw)
 
-        in_specs = [_Q_SPEC, _KV_SPEC, _META_SPECS]
+        in_specs = [_Q_SPEC, _pool_spec(kv_pages), _META_SPECS]
         operands = [q, kv_pages, metadata]
         if has_side:
             in_specs += [_SIDE_SPEC, P()]
@@ -98,11 +107,12 @@ def shard_kv_flush(flush_fn, mesh: Mesh):
     side buffer shard their flat head lanes; tables/lengths replicate."""
 
     def wrapped(kv_pages, side_kv, block_tables, base_lens, n_side):
+        spec = _pool_spec(kv_pages)
         f = jax.shard_map(
             flush_fn,
             mesh=mesh,
-            in_specs=(_KV_SPEC, _SIDE_SPEC, P(), P(), P()),
-            out_specs=_KV_SPEC,
+            in_specs=(spec, _SIDE_SPEC, P(), P(), P()),
+            out_specs=spec,
             check_vma=False,
         )
         return f(kv_pages, side_kv, block_tables, base_lens, n_side)

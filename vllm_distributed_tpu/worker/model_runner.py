@@ -270,6 +270,13 @@ class ModelRunner:
             return kv_flush_cpu
         return None
 
+    @property
+    def kv_cache_quantized(self) -> bool:
+        """--kv-cache-dtype int8: pool stores int8 rows + per-(token,
+        kv-head) f32 scales; staged side buffers stay in model dtype
+        (quantized once per dispatch at flush, not per micro-step)."""
+        return self.config.cache_config.cache_dtype == "int8"
+
     def kv_cache_dtype(self):
         """Pool dtype: cache_config.cache_dtype, "auto" = model dtype.
         A narrower cache (e.g. bfloat16 under a float32 model) doubles
@@ -284,13 +291,10 @@ class ModelRunner:
 
         m = self.model
         dtype_size = jnp.dtype(self.kv_cache_dtype()).itemsize
-        return (
-            m.num_layers
-            * 2
-            * self.page_size
-            * kv_pool_width(m.num_kv_heads, m.head_dim)
-            * dtype_size
-        )
+        per_token = kv_pool_width(m.num_kv_heads, m.head_dim) * dtype_size
+        if self.kv_cache_quantized:
+            per_token += m.num_kv_heads * 4  # f32 scale row
+        return m.num_layers * 2 * self.page_size * per_token
 
     # Per-chip HBM by device-kind prefix, for runtimes that don't expose
     # memory_stats (e.g. tunneled/proxied devices).
@@ -315,12 +319,18 @@ class ModelRunner:
         m = self.model
         from vllm_distributed_tpu.ops.attention import kv_pool_width
 
+        # Side buffers stay in MODEL dtype even for an int8 pool.
+        side_dtype = (
+            self.model.dtype
+            if self.kv_cache_quantized
+            else self.kv_cache_dtype()
+        )
         side = (
             sc.max_num_seqs
             * 2
             * sc.num_decode_steps
             * kv_pool_width(m.num_kv_heads, m.head_dim)
-            * jnp.dtype(self.kv_cache_dtype()).itemsize
+            * jnp.dtype(side_dtype).itemsize
             * m.num_layers
         )
         return side * max(sc.max_concurrent_dispatches, 1)
@@ -392,7 +402,10 @@ class ModelRunner:
         ONE DMA, flat head lanes unpadded), sharded per the model's
         kv_cache_spec.  Used for the serving cache and for aux-forward
         scratch pools — one definition of the layout."""
-        from vllm_distributed_tpu.ops.attention import kv_pool_shape
+        from vllm_distributed_tpu.ops.attention import (
+            kv_pool_shape,
+            kv_scales_shape,
+        )
 
         m = self.model
         shape = kv_pool_shape(
@@ -403,9 +416,26 @@ class ModelRunner:
             sharding = NamedSharding(self.mesh, m.kv_cache_spec())
         dtype = self.kv_cache_dtype()
 
-        def alloc():
-            z = jnp.zeros(shape, dtype)
+        def put(z):
             return jax.device_put(z, sharding) if sharding is not None else z
+
+        if self.kv_cache_quantized:
+            # (int8 data, per-head f32 scales) — the scale plane's lane
+            # axis is kv heads, sharding like the data plane's HD lanes.
+            s_shape = kv_scales_shape(
+                num_pages, self.page_size, m.num_kv_heads
+            )
+
+            def alloc():
+                return (
+                    put(jnp.zeros(shape, jnp.int8)),
+                    put(jnp.zeros(s_shape, jnp.float32)),
+                )
+
+        else:
+
+            def alloc():
+                return put(jnp.zeros(shape, dtype))
 
         return [alloc() for _ in range(m.num_layers)]
 
@@ -1261,12 +1291,18 @@ class ModelRunner:
             return (kv, sides, new_tok, out_buf), new_tok
 
         if staged:
-            sides0 = [
-                jnp.zeros(
-                    (s_pad, 2, k_steps, kv_l.shape[-1]), kv_l.dtype
+            # Model dtype even for int8 pools: rows quantize ONCE per
+            # dispatch at flush, not per micro-step.
+            def side0(kv_l):
+                data = kv_l[0] if isinstance(kv_l, tuple) else kv_l
+                dt = (
+                    self.model.dtype
+                    if isinstance(kv_l, tuple)
+                    else data.dtype
                 )
-                for kv_l in kv_caches
-            ]
+                return jnp.zeros((s_pad, 2, k_steps, data.shape[-1]), dt)
+
+            sides0 = [side0(kv_l) for kv_l in kv_caches]
         else:
             sides0 = [jnp.zeros((), jnp.int32) for _ in kv_caches]
         (kv_caches, sides_out, _, _), toks = jax.lax.scan(
