@@ -228,6 +228,9 @@ class SchedulerConfig:
     # Pre-compile the fused-decode programs for every batch bucket at
     # boot (adds startup time; removes mid-serve recompile stalls).
     warmup_decode: bool = False
+    # Pre-compile the prefill/mixed single-step program per token
+    # bucket at boot (first-request TTFT becomes execution time).
+    warmup_prefill: bool = False
 
     def fused_decode_steps(self) -> int:
         """The uniform fused-scan length K the scheduler emits: the
@@ -254,15 +257,23 @@ class SchedulerConfig:
         if 1 < self.num_decode_steps and (
             self.fused_decode_steps() < self.num_decode_steps
         ):
+            budget_k = max(
+                self.max_num_batched_tokens // self.max_num_seqs, 1
+            )
+            if budget_k < self.num_decode_steps:
+                hint = (
+                    "raise max_num_batched_tokens "
+                    f"(budget allows only {budget_k} steps at full "
+                    f"batch max_num_seqs={self.max_num_seqs})"
+                )
+            else:
+                hint = "use a power-of-2 num_decode_steps"
             logger.warning(
-                "num_decode_steps=%d is clamped to %d by the token "
-                "budget at full batch (max_num_batched_tokens=%d / "
-                "max_num_seqs=%d); raise the budget to keep the "
-                "configured fusion depth",
+                "num_decode_steps=%d runs as %d (uniform fused scan "
+                "length); %s to keep the configured depth",
                 self.num_decode_steps,
                 self.fused_decode_steps(),
-                self.max_num_batched_tokens,
-                self.max_num_seqs,
+                hint,
             )
 
 
@@ -354,6 +365,7 @@ class EngineArgs:
     num_decode_steps: int = 8
     max_concurrent_dispatches: int = 2
     warmup_decode: bool = False
+    warmup_prefill: bool = False
 
     # JSON dict (or dict) configuring a KV connector (disaggregated
     # prefill hook, SURVEY.md §3.4); None = off.
@@ -431,6 +443,12 @@ class EngineArgs:
             "bucket at boot (no mid-serve recompile stalls)",
         )
         parser.add_argument(
+            "--warmup-prefill",
+            action="store_true",
+            help="pre-compile the prefill program per token bucket at "
+            "boot (first-request TTFT becomes execution time)",
+        )
+        parser.add_argument(
             "--no-enable-chunked-prefill",
             dest="enable_chunked_prefill",
             action="store_false",
@@ -498,6 +516,7 @@ class EngineArgs:
             num_decode_steps=self.num_decode_steps,
             max_concurrent_dispatches=self.max_concurrent_dispatches,
             warmup_decode=self.warmup_decode,
+            warmup_prefill=self.warmup_prefill,
         )
         kv_transfer = self.kv_transfer_config
         if isinstance(kv_transfer, str):

@@ -44,6 +44,8 @@ class LLMEngine:
         self.executor.initialize_cache(num_pages)
         if config.scheduler_config.warmup_decode:
             self.executor.warmup_decode()
+        if config.scheduler_config.warmup_prefill:
+            self.executor.warmup_prefill()
         self.scheduler = Scheduler(
             config.scheduler_config, config.cache_config, num_pages
         )

@@ -32,6 +32,11 @@ environment_variables: dict[str, Callable[[], Any]] = {
     "VDT_COMPILE_CACHE_DIR": lambda: os.environ.get(
         "VDT_COMPILE_CACHE_DIR", os.path.expanduser("~/.cache/vdt/jax_cache")
     ),
+    # Persistent jax.export artifact cache for warm restarts (skips
+    # trace+lower, not just XLA compile): "auto" = on for TPU,
+    # "1" = always, "0" = off.  Artifacts live under
+    # $VDT_COMPILE_CACHE_DIR/aot.
+    "VDT_AOT_CACHE": lambda: os.environ.get("VDT_AOT_CACHE", "auto"),
     "VDT_HBM_UTILIZATION": lambda: float(
         os.environ.get("VDT_HBM_UTILIZATION", "0.9")
     ),
@@ -41,10 +46,12 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # force the jax platform (cpu for tests, tpu in prod)
     "VDT_PLATFORM": lambda: os.environ.get("VDT_PLATFORM", ""),
     "VDT_USE_PALLAS": lambda: os.environ.get("VDT_USE_PALLAS", "auto"),
-    # MoE expert dispatch: "ragged" (sorted jax.lax.ragged_dot, ~k/E of
-    # the dense FLOPs) or "dense" (every expert on every token — the
-    # correctness oracle).
-    "VDT_MOE_IMPL": lambda: os.environ.get("VDT_MOE_IMPL", "ragged"),
+    # MoE expert dispatch: "auto" picks per call site — dense-fused for
+    # bandwidth-bound shapes (decode, or quantized experts whose
+    # dequant fuses into the dense dot but not into ragged_dot),
+    # ragged (sorted jax.lax.ragged_dot, ~k/E of the dense FLOPs) for
+    # compute-bound prefill rows.  "ragged"/"dense" force one path.
+    "VDT_MOE_IMPL": lambda: os.environ.get("VDT_MOE_IMPL", "auto"),
     # --- external, replicated for weight download ---
     "HF_TOKEN": lambda: os.environ.get("HF_TOKEN", ""),
     "HUGGING_FACE_HUB_TOKEN": lambda: os.environ.get("HUGGING_FACE_HUB_TOKEN", ""),

@@ -141,6 +141,10 @@ class Executor:
         # bucket (boot-time; keeps serving recompile-free).
         self.collective_rpc("warmup_decode")
 
+    def warmup_prefill(self) -> None:
+        """Pre-compile prefill token buckets on every worker (boot)."""
+        self.collective_rpc("warmup_prefill")
+
     def register_failure_callback(self, callback: FailureCallback) -> None:
         """Engine asks to be told about worker loss (launch.py:316-320)."""
         if self.is_failed:

@@ -19,6 +19,7 @@ reference gets by shipping SchedulerOutput, not tensors (SURVEY.md §2.5).
 
 from __future__ import annotations
 
+import hashlib
 import zlib
 from dataclasses import dataclass, field
 from functools import partial
@@ -135,6 +136,9 @@ class ModelRunner:
         # dim over the mesh's "dp" axis; with dp=1 they are replicated.
         self._input_spec = None
         self._dp = 1
+        from vllm_distributed_tpu.worker.aot_cache import AotCache
+
+        self._aot = AotCache(None)  # armed in load_model (single-chip)
 
     # ---- lifecycle (the collective_rpc verbs, launch.py:290-292) ----
     def load_model(self, load_format: str = "auto") -> None:
@@ -183,6 +187,45 @@ class ModelRunner:
             axis = "dp" if self._dp > 1 else None
             self._input_spec = NamedSharding(self.mesh, P(axis))
         self._shard_kernels()
+        # Persistent AOT program cache (§5.4 warm restarts): skips
+        # trace+lower on reboot, not just XLA compile.  Single-device
+        # only — a meshed program's shardings don't round-trip through
+        # the flat-leaf export boundary.  "auto" = TPU only (CPU test
+        # runs would litter the cache with host-specific artifacts).
+        from vllm_distributed_tpu import envs
+        from vllm_distributed_tpu.worker.aot_cache import AotCache
+
+        mode = envs.VDT_AOT_CACHE
+        use_aot = self.mesh is None and (
+            mode == "1" or (mode == "auto" and jax.default_backend() == "tpu")
+        )
+        # Everything traced into the programs that leaf shapes/dtypes
+        # do NOT capture: model hyperparameters (rope/eps/soft-cap
+        # constants can differ between same-shaped checkpoints), the
+        # kernel backend, quantization scheme, cache dtype, and the
+        # package version (so a kernel bugfix invalidates artifacts).
+        from vllm_distributed_tpu.version import __version__
+
+        mc = self.config.model_config
+        try:
+            hf_id = mc.hf_config.to_json_string(use_diff=False)
+        except Exception:  # noqa: BLE001 — exotic config objects
+            hf_id = repr(mc.hf_config.__dict__)
+        context = "|".join(
+            (
+                __version__,
+                hashlib.sha256(hf_id.encode()).hexdigest()[:16],
+                str(mc.quantization),
+                str(mc.dtype),
+                self.config.cache_config.cache_dtype,
+                self.attn_backend,
+                str(self.page_size),
+            )
+        )
+        self._aot = AotCache(
+            envs.VDT_COMPILE_CACHE_DIR if use_aot else None,
+            context=context,
+        )
 
     def _shard_kernels(self) -> None:
         """Partition the Pallas kernels over the mesh "tp" axis.
@@ -525,6 +568,71 @@ class ModelRunner:
         )
         return n
 
+    def warmup_prefill(self) -> int:
+        """Pre-compile the single-step (prefill/mixed) program for each
+        power-of-2 token bucket up to the step budget, so the FIRST
+        request after boot pays execution time, not a trace+compile
+        (r4's 21 s cold TTFT at 1B was exactly this compile).  One
+        synthetic single-request prefill per bucket, written into
+        reserved page 0.  Returns the number of buckets compiled."""
+        import time as _time
+
+        from vllm_distributed_tpu.engine.scheduler import (
+            NewRequestData,
+            SchedulerOutput,
+        )
+
+        if self.kv_caches is None:
+            return 0
+        t0 = _time.monotonic()
+        sc = self.config.scheduler_config
+        cap = min(
+            next_power_of_2(sc.max_num_batched_tokens),
+            next_power_of_2(max(sc.max_model_len - 1, 1)),
+        )
+        t = _MIN_TOKEN_BUCKET  # shortest prompts land in bucket 16
+        buckets = []
+        while t <= cap:
+            buckets.append(t)
+            t *= 2
+        if not buckets:
+            buckets = [cap]
+        n = 0
+        for t_pad in buckets:
+            prompt_len = min(t_pad, sc.max_model_len - 1)
+            pages_pad = self._pages_bucket(
+                cdiv(prompt_len + 1, self.page_size)
+            )
+            so = SchedulerOutput(
+                step_id=0,
+                new_requests=[
+                    NewRequestData(
+                        req_id="__warmp",
+                        prompt_token_ids=[1] * prompt_len,
+                        num_prompt_tokens=prompt_len,
+                        page_ids=[0] * pages_pad,
+                        num_computed_tokens=0,
+                        num_new_tokens=prompt_len,
+                        sampling_params=SamplingParams(
+                            temperature=0.0, max_tokens=2
+                        ),
+                    )
+                ],
+                num_scheduled_tokens={"__warmp": prompt_len},
+                total_num_scheduled_tokens=prompt_len,
+                decode_steps=1,
+            )
+            self.execute_model(so)
+            self.requests.pop("__warmp", None)
+            n += 1
+        logger.info(
+            "prefill warmup: %d token buckets %s in %.1fs",
+            n,
+            buckets,
+            _time.monotonic() - t0,
+        )
+        return n
+
     # ---- auxiliary (non-scheduled) forwards: embeddings & scoring ----
     @partial(jax.jit, static_argnames=("self",))
     def _jit_aux_forward(self, params, kv, tokens, meta):
@@ -739,14 +847,22 @@ class ModelRunner:
                 packed = jax.device_put(
                     packed, NamedSharding(self.mesh, P())
                 )
-            sampled, logprobs, self.kv_caches = self._jit_step_packed(
-                self.params,
-                self.kv_caches,
-                packed,
-                spec=pack_spec,
-                max_q_pad=max_q_pad,
-                **flags,
-            )
+            statics = dict(spec=pack_spec, max_q_pad=max_q_pad, **flags)
+            if self._aot.enabled:
+                sampled, logprobs, self.kv_caches = self._aot.call(
+                    f"step:{sorted(statics.items())}",
+                    partial(
+                        type(self)._jit_step_packed.__wrapped__,
+                        self,
+                        **statics,
+                    ),
+                    (self.params, self.kv_caches, packed),
+                    donate_args=(1,),
+                )
+            else:
+                sampled, logprobs, self.kv_caches = self._jit_step_packed(
+                    self.params, self.kv_caches, packed, **statics
+                )
         else:
             meta = AttentionMetadata(
                 q_seq_ids=jnp.asarray(seq_ids),
@@ -1093,16 +1209,27 @@ class ModelRunner:
         )
         if self.mesh is not None:
             packed = jax.device_put(packed, NamedSharding(self.mesh, P()))
-        toks, carry_out, self.kv_caches = self._jit_decode_steps(
-            self.params,
-            self.kv_caches,
-            packed,
-            carry_tok,
+        statics = dict(
             spec=pack_spec,
             k_steps=k_steps,
             do_penalties=flags["do_penalties"],
             do_top_k_p=flags["do_top_k_p"],
         )
+        if self._aot.enabled:
+            toks, carry_out, self.kv_caches = self._aot.call(
+                f"decode_steps:{sorted(statics.items())}",
+                partial(
+                    type(self)._jit_decode_steps.__wrapped__,
+                    self,
+                    **statics,
+                ),
+                (self.params, self.kv_caches, packed, carry_tok),
+                donate_args=(1,),
+            )
+        else:
+            toks, carry_out, self.kv_caches = self._jit_decode_steps(
+                self.params, self.kv_caches, packed, carry_tok, **statics
+            )
         # Each sequence's LAST VALID token stays on device as the next
         # dispatch's input (under-K tails: token n_active-1, not K-1).
         self._decode_carry = (order, base_lens + n_active, carry_out)
