@@ -62,3 +62,120 @@ def test_plugin_import(tmp_path):
     )
     ToolParserManager.import_tool_parser(str(plugin))
     assert ToolParserManager.get("custom_test") is not None
+
+
+# ---- streaming (SSE tool-call deltas) ----
+QWEN3_TEXT = (
+    "Let me check.\n<tool_call>\n<function=get_weather>\n"
+    "<parameter=city>San Francisco</parameter>\n"
+    "<parameter=days>3</parameter>\n"
+    "</function>\n</tool_call>\ndone"
+)
+
+
+def _drive(parser_name, text, chunk=3):
+    sp = ToolParserManager.get(parser_name).streaming()
+    content, tools = "", []
+    for i in range(0, len(text), chunk):
+        c, t = sp.push(text[i : i + chunk])
+        content += c
+        tools += t
+    c, t = sp.finish()
+    return content + c, tools + t
+
+
+def _reassemble(tools):
+    """Concatenate streamed fragments per index into full calls."""
+    calls = {}
+    for frag in tools:
+        call = calls.setdefault(
+            frag["index"], {"function": {"arguments": ""}}
+        )
+        if "id" in frag:
+            call["id"] = frag["id"]
+        fn = frag.get("function", {})
+        if "name" in fn:
+            call["function"]["name"] = fn["name"]
+        call["function"]["arguments"] += fn.get("arguments", "")
+    return [calls[i] for i in sorted(calls)]
+
+
+def test_qwen3_streaming_matches_extract():
+    for chunk in (1, 3, 7, len(QWEN3_TEXT)):
+        content, tools = _drive("qwen3_coder", QWEN3_TEXT, chunk)
+        calls = _reassemble(tools)
+        assert len(calls) == 1, (chunk, tools)
+        assert calls[0]["function"]["name"] == "get_weather"
+        assert json.loads(calls[0]["function"]["arguments"]) == {
+            "city": "San Francisco",
+            "days": 3,
+        }
+        assert "Let me check." in content and "done" in content
+        assert "<tool_call>" not in content
+
+
+def test_qwen3_streaming_emits_header_before_block_end():
+    """The call header (name) must stream out BEFORE </tool_call>
+    arrives — that's the point of streaming deltas."""
+    sp = ToolParserManager.get("qwen3_coder").streaming()
+    _, tools = sp.push(
+        "<tool_call>\n<function=run>\n<parameter=cmd>ls</parameter>\n"
+    )
+    assert any(
+        f.get("function", {}).get("name") == "run" for f in tools
+    )
+    assert any(
+        "cmd" in f.get("function", {}).get("arguments", "")
+        for f in tools
+    )
+
+
+def test_qwen3_streaming_truncated_closes_json():
+    sp = ToolParserManager.get("qwen3_coder").streaming()
+    _, t1 = sp.push("<tool_call><function=run><parameter=cmd>ls</parameter>")
+    _, t2 = sp.finish()
+    calls = _reassemble(t1 + t2)
+    assert json.loads(calls[0]["function"]["arguments"]) == {"cmd": "ls"}
+
+
+def test_hermes_streaming_block_granular():
+    text = (
+        'hi <tool_call>{"name": "f", "arguments": {"a": 1}}</tool_call>'
+        ' bye'
+    )
+    content, tools = _drive("hermes", text, chunk=5)
+    calls = _reassemble(tools)
+    assert len(calls) == 1
+    assert calls[0]["function"]["name"] == "f"
+    assert json.loads(calls[0]["function"]["arguments"]) == {"a": 1}
+    assert content.startswith("hi ") and content.endswith(" bye")
+
+
+def test_streaming_partial_marker_held_back():
+    sp = ToolParserManager.get("qwen3_coder").streaming()
+    c1, _ = sp.push("text <tool_")
+    assert c1 == "text "  # the possible marker prefix is held
+    c2, _ = sp.push("gap continues")  # not a marker after all
+    c3, _ = sp.finish()
+    assert (c1 + c2 + c3) == "text <tool_gap continues"
+
+
+def test_qwen3_streaming_malformed_body_does_not_wedge():
+    """A parameter missing its closing tag must not swallow the rest
+    of the stream: the call closes at </function> and trailing content
+    keeps flowing."""
+    sp = ToolParserManager.get("qwen3_coder").streaming()
+    text = (
+        "<tool_call>\n<function=f>\n<parameter=a>x</function>\n"
+        "</tool_call>\ndone"
+    )
+    # Note: the half-open parameter waits until </function> proves no
+    # </parameter> is coming — feed everything, then finish.
+    c1, t1 = sp.push(text)
+    c2, t2 = sp.finish()
+    content = c1 + c2
+    # The malformed half-parameter is dropped; args stay valid JSON.
+    calls = _reassemble(t1 + t2)
+    assert json.loads(calls[0]["function"]["arguments"]) == {}
+    assert "done" in content
+

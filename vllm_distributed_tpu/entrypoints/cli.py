@@ -224,10 +224,15 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
             "temperature": 0.0,
             "ignore_eos": True,
             "stream": True,
+            # Final chunk carries usage: tokens are counted from the
+            # stream itself, not assumed = output_len (a truncated or
+            # errored stream must not overstate throughput).
+            "stream_options": {"include_usage": True},
         }
         async with sem:
             t0 = time.perf_counter()
             chunk_times: list[float] = []
+            got_tokens = 0
             async with session.post(
                 f"{url}/v1/completions", json=body
             ) as resp:
@@ -240,21 +245,29 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                     if payload == "[DONE]":
                         break
                     chunk = json.loads(payload)
-                    choice = chunk.get("choices", [{}])[0]
+                    usage = chunk.get("usage")
+                    if usage:
+                        got_tokens = usage.get(
+                            "completion_tokens", got_tokens
+                        )
+                    choices = chunk.get("choices") or []
+                    choice = choices[0] if choices else None
                     # Token-bearing chunks: anything before the finish
                     # marker ("text" may be empty when the server runs
                     # without a tokenizer, e.g. dummy-weight benches).
-                    if not choice.get("finish_reason"):
+                    if choice is not None and not choice.get(
+                        "finish_reason"
+                    ):
                         chunk_times.append(time.perf_counter())
         if chunk_times:
             ttfts.append(chunk_times[0] - t0)
-            out_tokens += args.output_len
-            if args.output_len > 1:
+            out_tokens += got_tokens
+            if got_tokens > 1:
                 # Client-side per-token interval: tokens arrive in fused
                 # bursts, so spread the span over the tokens after the
                 # first (the serving ITL definition).
                 span = chunk_times[-1] - chunk_times[0]
-                itls.append(span / (args.output_len - 1))
+                itls.append(span / (got_tokens - 1))
 
     timeout = aiohttp.ClientTimeout(total=None, sock_read=600)
     async with aiohttp.ClientSession(timeout=timeout) as session:

@@ -301,11 +301,40 @@ async def _stream_chat(
         (req.stream_options or {}).get("include_usage", False)
     )
     usage = UsageInfo()
+    # Streaming tool-call parsing (the reference's flagship COMMAND is
+    # an agent workload: --enable-auto-tool-choice --tool-call-parser
+    # qwen3_coder, .env.server:11): tool-call fragments stream in SSE
+    # chunks as the text arrives, not after the request finishes.
+    use_tools = bool(
+        state.tool_call_parser
+        and (req.tools or state.enable_auto_tool_choice)
+    )
 
     async def stream_one(i: int) -> None:
         first = True
         sent = 0
         finish = None
+        stream_parser = (
+            ToolParserManager.get(state.tool_call_parser).streaming()
+            if use_tools
+            else None
+        )
+        sent_tool_deltas = False
+
+        async def emit(delta: ChatDelta, finish_reason=None) -> None:
+            await send(
+                ChatCompletionStreamResponse(
+                    id=request_id,
+                    model=state.model_name,
+                    choices=[
+                        ChatStreamChoice(
+                            index=i, delta=delta,
+                            finish_reason=finish_reason,
+                        )
+                    ],
+                )
+            )
+
         async for out in state.engine.generate(
             f"{request_id}-{i}",
             prompt=None if prompt_ids else prompt,
@@ -316,26 +345,25 @@ async def _stream_chat(
             delta_text = comp.text[sent:]
             sent = len(comp.text)
             finish = comp.finish_reason
-            if first or delta_text or comp.finished:
+            tool_deltas: list[dict] = []
+            if stream_parser is not None:
+                delta_text, tool_deltas = stream_parser.push(delta_text)
+                if comp.finished:
+                    tail_text, tail_tools = stream_parser.finish()
+                    delta_text += tail_text
+                    tool_deltas += tail_tools
+                sent_tool_deltas |= bool(tool_deltas)
+            if comp.finished and sent_tool_deltas:
+                finish = "tool_calls"
+            if first or delta_text or tool_deltas or comp.finished:
                 delta = ChatDelta(
                     role="assistant" if first else None,
                     content=delta_text or ("" if first else None),
+                    tool_calls=tool_deltas or None,
                 )
                 first = False
-                await send(
-                    ChatCompletionStreamResponse(
-                        id=request_id,
-                        model=state.model_name,
-                        choices=[
-                            ChatStreamChoice(
-                                index=i,
-                                delta=delta,
-                                finish_reason=(
-                                    finish if comp.finished else None
-                                ),
-                            )
-                        ],
-                    )
+                await emit(
+                    delta, finish if comp.finished else None
                 )
             if comp.finished:
                 usage.prompt_tokens += len(out.prompt_token_ids)
@@ -493,6 +521,10 @@ async def _stream_completion(
         await response.write(f"data: {payload}\n\n".encode())
 
     no_tokenizer = state.engine.tokenizer is None
+    include_usage = bool(
+        (req.stream_options or {}).get("include_usage", False)
+    )
+    usage = UsageInfo()
 
     async def stream_one(choice_idx: int, text, ids) -> None:
         sent = 0
@@ -508,6 +540,9 @@ async def _stream_completion(
             sent = len(comp.text)
             new_toks = len(comp.token_ids) - sent_toks
             sent_toks = len(comp.token_ids)
+            if comp.finished:
+                usage.prompt_tokens += len(out.prompt_token_ids)
+                usage.completion_tokens += len(comp.token_ids)
             # Without a tokenizer (dummy-weight serving/benches) there is
             # no text to delta — stream empty chunks on token arrival so
             # SSE timing still reflects token delivery.
@@ -537,6 +572,17 @@ async def _stream_completion(
                 tasks.append(stream_one(idx, text, ids))
                 idx += 1
         await asyncio.gather(*tasks)
+        if include_usage:
+            usage.total_tokens = (
+                usage.prompt_tokens + usage.completion_tokens
+            )
+            final = CompletionResponse(
+                id=request_id,
+                model=state.model_name,
+                choices=[],
+                usage=usage,
+            )
+            await send_json(json.dumps(final.model_dump(exclude_none=True)))
         await send_json("[DONE]")
     except (EngineDeadError, ValueError) as e:
         await send_json(json.dumps({"error": str(e)}))

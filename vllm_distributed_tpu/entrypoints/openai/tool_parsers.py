@@ -26,6 +26,102 @@ class ToolParser:
     def extract(self, text: str) -> tuple[str | None, list[dict]]:
         raise NotImplementedError
 
+    def streaming(self) -> "StreamingToolParser":
+        """A fresh per-request incremental parser (SSE tool-call
+        deltas).  Default: block-granular streaming (whole calls emit
+        as each ``<tool_call>`` block closes); format-aware subclasses
+        stream finer fragments."""
+        return StreamingToolParser(self)
+
+
+class StreamingToolParser:
+    """Incremental tool-call parsing over a text stream.
+
+    ``push(text_delta)`` returns ``(content_delta, tool_deltas)``:
+    plain text outside tool blocks streams through immediately (minus
+    any suffix that could begin a block marker), and tool-call
+    fragments follow the OpenAI streaming shape — each dict carries
+    ``index`` plus, on its first fragment, ``id``/``type`` and the
+    function name; ``function.arguments`` fragments CONCATENATE to the
+    full JSON arguments string.  ``finish()`` flushes: an unterminated
+    block is surfaced back as plain content (a truncated call is not a
+    call).
+
+    This base class emits each call whole once its block closes —
+    correct for any registered format via ``extract``.  The flagship
+    qwen3_coder format gets parameter-granular deltas
+    (Qwen3CoderStreamingParser)."""
+
+    START = "<tool_call>"
+    END = "</tool_call>"
+
+    def __init__(self, parser: ToolParser) -> None:
+        self.parser = parser
+        self._buf = ""
+        self._in_block = False
+        self._index = 0
+        self.saw_tool_call = False
+
+    @staticmethod
+    def _partial_suffix(text: str, marker: str) -> int:
+        """Length of the longest tail of ``text`` that is a proper
+        prefix of ``marker`` (must be held back, it may grow into the
+        marker)."""
+        for n in range(min(len(marker) - 1, len(text)), 0, -1):
+            if text.endswith(marker[:n]):
+                return n
+        return 0
+
+    # ---- hooks for subclasses ----
+    def _consume_block(self) -> list[dict] | None:
+        """Try to consume tool content at the head of the buffer (which
+        starts with START).  Returns fragments, or None to wait for
+        more text.  Must leave the buffer past everything consumed and
+        reset _in_block when the block closed."""
+        end = self._buf.find(self.END)
+        if end < 0:
+            return None
+        block = self._buf[: end + len(self.END)]
+        self._buf = self._buf[end + len(self.END) :]
+        self._in_block = False
+        _, calls = self.parser.extract(block)
+        out = []
+        for call in calls:
+            out.append({"index": self._index, **call})
+            self._index += 1
+        return out
+
+    def push(self, delta: str) -> tuple[str, list[dict]]:
+        self._buf += delta
+        content: list[str] = []
+        tools: list[dict] = []
+        while True:
+            if not self._in_block:
+                i = self._buf.find(self.START)
+                if i < 0:
+                    keep = self._partial_suffix(self._buf, self.START)
+                    cut = len(self._buf) - keep
+                    if cut > 0:
+                        content.append(self._buf[:cut])
+                        self._buf = self._buf[cut:]
+                    break
+                content.append(self._buf[:i])
+                self._buf = self._buf[i:]
+                self._in_block = True
+                self.saw_tool_call = True
+            frags = self._consume_block()
+            if frags is None:
+                break
+            tools.extend(frags)
+        return "".join(content), tools
+
+    def finish(self) -> tuple[str, list[dict]]:
+        """End of stream: unterminated tool text degrades to content."""
+        content, tools = self._buf, []
+        self._buf = ""
+        self._in_block = False
+        return content, tools
+
 
 class ToolParserManager:
     _parsers: dict[str, type[ToolParser]] = {}
@@ -119,6 +215,118 @@ class Qwen3CoderToolParser(ToolParser):
             return text, []
         content = self._BLOCK.sub("", text).strip() or None
         return content, calls
+
+    def streaming(self) -> "Qwen3CoderStreamingParser":
+        return Qwen3CoderStreamingParser(self)
+
+
+class Qwen3CoderStreamingParser(StreamingToolParser):
+    """Parameter-granular streaming for the qwen3_coder XML-ish format
+    (the parser the reference's flagship COMMAND names,
+    .env.server:11): the call header (id + function name) is emitted as
+    soon as ``<function=NAME>`` closes its ``>``, and each completed
+    ``<parameter=K>V</parameter>`` emits an arguments fragment — the
+    fragments concatenate to the same JSON object the finished-text
+    parser produces."""
+
+    _FN_OPEN = re.compile(r"<function=([^>]+)>")
+    _PARAM_ONE = re.compile(
+        r"\s*<parameter=([^>]+)>(.*?)</parameter>", re.DOTALL
+    )
+
+    def __init__(self, parser: ToolParser) -> None:
+        super().__init__(parser)
+        self._call_open = False  # emitted header, not yet closed args
+        self._nargs = 0
+
+    def _frag(self, arguments: str) -> dict:
+        return {"index": self._index, "function": {"arguments": arguments}}
+
+    def _consume_block(self) -> list[dict] | None:
+        out: list[dict] = []
+        progress = True
+        while progress:
+            progress = False
+            if not self._call_open:
+                m = self._FN_OPEN.search(self._buf)
+                end = self._buf.find(self.END)
+                if m is None or (0 <= end < m.start()):
+                    # No (further) function in this block: close it once
+                    # the end tag arrives.
+                    if end < 0:
+                        return out or None
+                    self._buf = self._buf[end + len(self.END) :]
+                    self._in_block = False
+                    return out
+                out.append(
+                    {
+                        "index": self._index,
+                        "id": f"call_{uuid.uuid4().hex[:24]}",
+                        "type": "function",
+                        "function": {"name": m.group(1).strip()},
+                    }
+                )
+                self._buf = self._buf[m.end() :]
+                self._call_open = True
+                self._nargs = 0
+                progress = True
+                continue
+            # Inside <function=...>: complete parameters stream out;
+            # </function> closes the arguments object.
+            pm = self._PARAM_ONE.match(self._buf)
+            if pm is not None:
+                key = json.dumps(pm.group(1).strip())
+                val = json.dumps(_coerce(pm.group(2).strip()))
+                prefix = "{" if self._nargs == 0 else ", "
+                out.append(self._frag(f"{prefix}{key}: {val}"))
+                self._nargs += 1
+                self._buf = self._buf[pm.end() :]
+                progress = True
+                continue
+            fn_end = self._buf.find("</function>")
+            if fn_end >= 0:
+                # Close the call.  Anything before the tag that is not
+                # a complete parameter is malformed tool text — dropped
+                # (the finished-text extract() mis-parses such bodies
+                # the same way: its non-greedy regex stops at the first
+                # '</function>'), but the stream must NOT wedge on it:
+                # trailing content after the block has to keep flowing.
+                if self._buf[:fn_end].strip():
+                    logger.warning(
+                        "malformed tool-call body ignored in stream"
+                    )
+                out.append(
+                    self._frag("{}" if self._nargs == 0 else "}")
+                )
+                self._index += 1
+                self._call_open = False
+                self._buf = self._buf[fn_end + len("</function>") :]
+                progress = True
+                continue
+            blk_end = self._buf.find(self.END)
+            if blk_end >= 0:
+                # </tool_call> with no </function>: close the call at
+                # the block end so the outer loop can consume it.
+                out.append(
+                    self._frag("{}" if self._nargs == 0 else "}")
+                )
+                self._index += 1
+                self._call_open = False
+                progress = True
+                continue
+        return out or None
+
+    def finish(self) -> tuple[str, list[dict]]:
+        if self._call_open:
+            # Truncated mid-call: close the arguments object so the
+            # concatenated fragments stay valid JSON.
+            frag = self._frag("{}" if self._nargs == 0 else "}")
+            self._index += 1
+            self._call_open = False
+            self._buf = ""
+            self._in_block = False
+            return "", [frag]
+        return super().finish()
 
 
 def _coerce(value: str) -> Any:
