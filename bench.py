@@ -165,8 +165,11 @@ def _check_kernels() -> str:
             f"ideal {2 * m_ * h_ * i_}"
         )
 
-    # int8 weight-streaming matmul vs dequant-in-graph.
-    from vllm_distributed_tpu.ops.pallas.quant_matmul import int8_matmul
+    # int8/int4 weight-streaming matmuls vs dequant-in-graph.
+    from vllm_distributed_tpu.ops.pallas.quant_matmul import (
+        int4_matmul,
+        int8_matmul,
+    )
     from vllm_distributed_tpu.ops.quant import dequantize, quantize
 
     x = jnp.asarray(rng.normal(size=(32, 1024)) * 0.5, jnp.float32)
@@ -181,8 +184,21 @@ def _check_kernels() -> str:
     )
     if mm_err > 2e-2:
         raise AssertionError(f"int8_matmul mismatch on chip: {mm_err}")
+    qt4 = quantize(w, 4, group=128)
+    mm4_want = np.asarray(x @ dequantize(qt4, jnp.float32))
+    mm4_got = np.asarray(
+        int4_matmul(
+            x, jnp.asarray(qt4.q), jnp.asarray(qt4.scale), group=128
+        )
+    )
+    mm4_err = float(
+        np.max(np.abs(mm4_got - mm4_want)) / (np.abs(mm4_want).max() + 1e-9)
+    )
+    if mm4_err > 2e-2:
+        raise AssertionError(f"int4_matmul mismatch on chip: {mm4_err}")
     return (
-        f"pass (attn {err:.1e}; kv_update exact; int8_matmul {mm_err:.1e})"
+        f"pass (attn {err:.1e}; kv_update exact; int8_matmul "
+        f"{mm_err:.1e}; int4_matmul {mm4_err:.1e})"
     )
 
 
@@ -202,7 +218,8 @@ def _hbm_bw() -> tuple[str, float]:
 
 
 def _run_config(shapes, *, batch, k_steps, quant, timed_dispatches,
-                kv_dtype="auto", warm_engine_probe=False,
+                kv_dtype="auto", model_kind="llama",
+                warm_engine_probe=False, prefill_probe=False,
                 timed_dispatches_cap=None):
     """One engine, one decode measurement.  Returns a detail dict."""
     import jax
@@ -210,14 +227,20 @@ def _run_config(shapes, *, batch, k_steps, quant, timed_dispatches,
     from vllm_distributed_tpu.config import EngineArgs
     from vllm_distributed_tpu.engine.llm_engine import LLMEngine
     from vllm_distributed_tpu.sampling_params import SamplingParams
-    from vllm_distributed_tpu.testing import write_llama_config
+    from vllm_distributed_tpu.testing import (
+        write_llama_config,
+        write_mixtral_config,
+    )
 
     if timed_dispatches_cap is not None:
         timed_dispatches = min(timed_dispatches, timed_dispatches_cap)
     warmup_dispatches = 2
     prompt_len = 32
     max_tokens = 1 + k_steps * (warmup_dispatches + timed_dispatches)
-    model_dir = write_llama_config(**shapes)
+    writer = (
+        write_mixtral_config if model_kind == "mixtral" else write_llama_config
+    )
+    model_dir = writer(**shapes)
 
     def build():
         return LLMEngine.from_engine_args(
@@ -259,6 +282,7 @@ def _run_config(shapes, *, batch, k_steps, quant, timed_dispatches,
             quant=quant, prompt_len=prompt_len, max_tokens=max_tokens,
             warmup_dispatches=warmup_dispatches,
             warm_engine_probe=warm_engine_probe,
+            prefill_probe=prefill_probe,
         )
     finally:
         # Always release HBM — a failed config must not leak its pool
@@ -267,7 +291,8 @@ def _run_config(shapes, *, batch, k_steps, quant, timed_dispatches,
 
 
 def _measure(engine, build, free_engine, *, batch, k_steps, quant,
-             prompt_len, max_tokens, warmup_dispatches, warm_engine_probe):
+             prompt_len, max_tokens, warmup_dispatches, warm_engine_probe,
+             prefill_probe=False):
     import jax
 
     from vllm_distributed_tpu.sampling_params import SamplingParams
@@ -301,9 +326,19 @@ def _measure(engine, build, free_engine, *, batch, k_steps, quant,
     elapsed = time.perf_counter() - t0
     tps = timed_tokens / elapsed
 
-    # Roofline for one decode micro-step: weight bytes as RESIDENT
-    # (quantized weights stream their compressed bytes) plus the KV
-    # history the attention actually reads (bucketed pages per seq).
+    # Roofline for one decode micro-step.  Byte model (VERDICT r4 #4 —
+    # derived from ACTUALLY SCHEDULED context, not the pages bucket):
+    #   weight_bytes: resident param bytes (quantized weights stream
+    #     their compressed form; MoE counts every resident expert —
+    #     the top-k dispatch reads less, making raw frac conservative).
+    #   kv_read_bytes: batch × ceil(mean_ctx/page)×page rows × row
+    #     bytes, where mean_ctx = prompt + half the generated tokens
+    #     (the timed window's midpoint) and a row is 2 planes × HD ×
+    #     itemsize (+ Hkv f32 scales when the pool is int8).  Page
+    #     granularity matches the kernel's DMA; the kernel may overread
+    #     up to one KV *block* per sequence, so the floor is a slight
+    #     underestimate — the frac is reported RAW (can exceed 1 only
+    #     if this model is wrong).
     runner = getattr(
         getattr(getattr(engine, "executor", None), "worker", None),
         "runner",
@@ -316,21 +351,18 @@ def _measure(engine, build, free_engine, *, batch, k_steps, quant,
             x.nbytes for x in jax.tree.leaves(runner.params)
         )
         mean_ctx = prompt_len + max_tokens // 2
-        pages_pad = runner._pages_bucket(
-            -(-mean_ctx // runner.page_size)
-        )
+        page = runner.page_size
+        rows = -(-mean_ctx // page) * page
         from vllm_distributed_tpu.ops.attention import kv_pool_width
 
         m = runner.model
-        kv_read_bytes = (
-            batch
-            * pages_pad
-            * runner.page_size
-            * kv_pool_width(m.num_kv_heads, m.head_dim)
-            * 2  # K and V
+        row_bytes = (
+            kv_pool_width(m.num_kv_heads, m.head_dim)
             * jax.numpy.dtype(runner.kv_cache_dtype()).itemsize
-            * m.num_layers
         )
+        if runner.kv_cache_quantized:
+            row_bytes += m.num_kv_heads * 4  # f32 scale row
+        kv_read_bytes = batch * rows * 2 * row_bytes * m.num_layers
     kind, bw = _hbm_bw()
     floor_ms = (param_bytes + kv_read_bytes) / bw * 1e3
     micro_ms = 1e3 / (tps / batch) if tps else float("inf")
@@ -368,35 +400,88 @@ def _measure(engine, build, free_engine, *, batch, k_steps, quant,
         "itl_ms_p90": pct(0.9),
         "itl_ms_p99": pct(0.99),
         "roofline_microstep_ms": round(floor_ms, 3),
-        "roofline_frac": round(min(floor_ms / micro_ms, 1.0), 3),
+        # RAW (unclamped): >1 means the byte model is wrong, not that
+        # the chip beat physics (VERDICT r4 weak #3).
+        "roofline_frac": round(floor_ms / micro_ms, 3),
         "ttft_cold_s": round(ttft_cold_s, 2),
         "param_bytes": param_bytes,
         "kv_read_bytes_per_microstep": kv_read_bytes,
     }
-    if warm_engine_probe:
-        # Warm TTFT: a fresh engine on the same shapes hits the
-        # persistent compile cache — the restart-to-first-token story
-        # (§5.4).  Free the first engine's HBM before the rebuild.  A
-        # probe failure must not discard the config's measurement.
+    if warm_engine_probe or prefill_probe:
+        # Warm TTFT: a FRESH engine on the same shapes hits the
+        # persistent caches this run just wrote (XLA disk cache + AOT
+        # export artifacts) — the restart-to-first-token story (§5.4):
+        # no retrace, no relower, compile-cache-hit only.  Free the
+        # first engine's HBM before the rebuild.  A probe failure must
+        # not discard the config's measurement.
         free_engine(engine)
         try:
             engine2 = build()
             try:
-                engine2.add_request(
-                    "warm",
-                    prompt_token_ids=[3] * prompt_len,
-                    sampling_params=SamplingParams(
-                        temperature=0.0, max_tokens=2, ignore_eos=True
-                    ),
+                # Replay the SAME admission shape as the measured run
+                # (batch x prompt_len) so the first step hits the
+                # artifact the run just exported — a lone request would
+                # land in a token bucket the first engine never
+                # compiled and measure a fresh compile instead.
+                sp2 = SamplingParams(
+                    temperature=0.0, max_tokens=2, ignore_eos=True
                 )
+                for i in range(batch):
+                    engine2.add_request(
+                        f"warm{i}",
+                        prompt_token_ids=[
+                            (7 * i + j) % 1000 + 1
+                            for j in range(prompt_len)
+                        ],
+                        sampling_params=sp2,
+                    )
                 t0 = time.perf_counter()
                 engine2.step()
                 detail["ttft_warm_s"] = round(time.perf_counter() - t0, 2)
+                while engine2.has_unfinished_requests():
+                    engine2.step()
+                if prefill_probe:
+                    detail["prefill"] = _prefill_probe(
+                        engine2, prompt_len=256, n_prompts=8
+                    )
             finally:
                 free_engine(engine2)
         except Exception as e:  # noqa: BLE001
             detail["ttft_warm_error"] = f"{type(e).__name__}: {e}"
     return detail
+
+
+def _prefill_probe(engine, *, prompt_len, n_prompts) -> dict:
+    """Prefill tokens/sec (VERDICT r4 #3: 'no prefill tokens/sec number
+    anywhere'): run one compile pass, then time a batch of fresh
+    prompts through their prefill steps (max_tokens=1 — decode excluded
+    by construction)."""
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+
+    prompt_len = min(
+        prompt_len, engine.config.model_config.max_model_len - 8
+    )
+    sp = SamplingParams(temperature=0.0, max_tokens=1, ignore_eos=True)
+
+    def run(tag):
+        for i in range(n_prompts):
+            toks = [(11 * i + j) % 900 + 1 for j in range(prompt_len)]
+            engine.add_request(f"{tag}{i}", prompt_token_ids=toks,
+                               sampling_params=sp)
+        t0 = time.perf_counter()
+        while engine.has_unfinished_requests():
+            engine.step()
+        return time.perf_counter() - t0
+
+    run("pfc")  # compile pass
+    elapsed = run("pf")
+    total = n_prompts * prompt_len
+    return {
+        "prompt_len": prompt_len,
+        "n_prompts": n_prompts,
+        "elapsed_s": round(elapsed, 3),
+        "prefill_tokens_per_sec": round(total / elapsed, 1),
+    }
 
 
 def _serve_probe() -> dict:
@@ -430,6 +515,7 @@ def _serve_probe() -> dict:
             num_decode_steps=16,
             max_concurrent_dispatches=6,
             warmup_decode=True,
+            warmup_prefill=True,
         )
     )
     state = init_app_state(engine, served_model_name="bench-1b")
@@ -443,13 +529,13 @@ def _serve_probe() -> dict:
         args = argparse.Namespace(
             url=f"http://127.0.0.1:{port}",
             model="bench-1b",
-            num_prompts=16,
-            concurrency=8,
+            num_prompts=48,
+            concurrency=16,
             input_len=32,
             output_len=128,
         )
-        # Warmup pass (compiles), then the measured pass.  Same prompt
-        # count/concurrency so the ramp hits the same batch buckets.
+        # Warmup pass (absorbs any join-shape compiles the boot warmup
+        # missed), then the measured pass.
         warm = argparse.Namespace(**{**vars(args), "output_len": 16})
         loop.run_until_complete(_bench_serve_async(warm))
         result = loop.run_until_complete(_bench_serve_async(args))
@@ -458,14 +544,35 @@ def _serve_probe() -> dict:
     finally:
         engine.shutdown()
         loop.close()
+        # Release HBM so the matched engine-direct run that follows can
+        # boot (shutdown alone leaves params/pool pinned by jit caches).
+        import jax
+
+        r = getattr(
+            getattr(engine.engine, "executor", None), "worker", None
+        )
+        r = getattr(r, "runner", None)
+        if r is not None and r.params is not None:
+            for leaf in jax.tree.leaves((r.params, r.kv_caches)):
+                leaf.delete()
+            carry = getattr(r, "_decode_carry", None)
+            if carry is not None:
+                carry[2].delete()
+            r.params, r.kv_caches, r._decode_carry = None, None, None
 
 
 def main() -> None:
+    import tempfile
+
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    # Persistent XLA compile cache: makes the warm-TTFT probe measure
-    # the restart story (§5.4) rather than a full recompile (the
-    # in-memory jit cache can't help — it keys on the runner instance).
-    os.environ.setdefault("VDT_COMPILE_CACHE_DIR", "/tmp/vdt_bench_xla_cache")
+    # RUN-SCOPED persistent cache dir: the warm-TTFT probe measures the
+    # restart story (§5.4 — XLA disk cache + AOT export artifacts
+    # written EARLIER IN THIS RUN), while ttft_cold stays honestly cold
+    # (a shared /tmp dir would leak warmth across runs).
+    os.environ.setdefault(
+        "VDT_COMPILE_CACHE_DIR",
+        tempfile.mkdtemp(prefix="vdt_bench_cache_"),
+    )
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
         # The env var alone can lose to an interpreter-startup jax import
         # (sitecustomize); the config update before first backend use wins.
@@ -498,22 +605,42 @@ def main() -> None:
         )
         configs = [(explicit or "tiny", cfg)]
     else:
+        from vllm_distributed_tpu.testing import MIXTRAL_8X1B
+
         configs = [
+            # Continuity shapes (pinned since r2/r4 — VERDICT r4 #4).
             ("llama_1b_bf16_b32", dict(
                 shapes=LLAMA_1B, batch=32, k_steps=16, quant=None)),
             ("llama_1b_int8_b64", dict(
-                shapes=LLAMA_1B, batch=64, k_steps=32, quant="int8")),
+                shapes=LLAMA_1B, batch=64, k_steps=32, quant="int8",
+                prefill_probe=True)),
+            # int4 weight streaming (nibble-unpack in VMEM).
+            ("llama_1b_int4_b64", dict(
+                shapes=LLAMA_1B, batch=64, k_steps=32, quant="int4",
+                kv_dtype="int8")),
         ]
         if os.environ.get("VDT_BENCH_FAST") != "1":
-            configs.append(
+            configs += [
                 # 7B KV is ~1 MiB/token (MHA, 32 layers): the batch and
-                # decode length must FIT the ~6 GiB pool or the scheduler
+                # decode length must FIT the pool or the scheduler
                 # preempts in a loop mid-bench (r3's "12 s stalls" were
-                # exactly this thrash).  16 seqs x ~290 tokens ~= 4.6 GiB.
+                # exactly this thrash).  b16 is the r4 continuity shape;
+                # the int8 KV cache (~0.5 MiB/token) doubles capacity,
+                # so b48 is the headline config.
                 ("llama_7b_int8_b16", dict(
                     shapes=LLAMA_7B, batch=16, k_steps=16, quant="int8",
-                    timed_dispatches_cap=16))
-            )
+                    timed_dispatches_cap=16)),
+                ("llama_7b_int8_kv8_b48", dict(
+                    shapes=LLAMA_7B, batch=48, k_steps=16, quant="int8",
+                    kv_dtype="int8", timed_dispatches_cap=16,
+                    prefill_probe=True)),
+                # MoE (the reference flagship family is MoE): ragged
+                # sorted dispatch, single chip, int8 weights.
+                ("moe_mixtral8x1b_int8_b32", dict(
+                    shapes=MIXTRAL_8X1B, batch=32, k_steps=16,
+                    quant="int8", model_kind="mixtral",
+                    timed_dispatches_cap=16)),
+            ]
 
     details = {}
     best_name, best = None, None
@@ -538,12 +665,59 @@ def main() -> None:
     if best is None:
         raise RuntimeError(f"every bench config failed: {details}")
 
+    # MoE dispatch-path ratio (VERDICT r4 #5): the headline config runs
+    # the "auto" policy (dense-fused at bandwidth-bound decode — see
+    # models/mixtral.py _mlp); rerun briefly with the ragged path
+    # forced so the tradeoff is measured on the record every round.
+    moe = details.get("moe_mixtral8x1b_int8_b32")
+    if moe and "error" not in moe:
+        from vllm_distributed_tpu.testing import MIXTRAL_8X1B
+
+        os.environ["VDT_MOE_IMPL"] = "ragged"
+        try:
+            ragged = _run_config(
+                shapes=MIXTRAL_8X1B, batch=32, k_steps=16, quant="int8",
+                model_kind="mixtral", timed_dispatches=8,
+            )
+            moe["ragged_tokens_per_sec_p50"] = ragged[
+                "tokens_per_sec_p50"
+            ]
+            moe["auto_vs_ragged_speedup"] = round(
+                moe["tokens_per_sec_p50"]
+                / max(ragged["tokens_per_sec_p50"], 1e-9),
+                2,
+            )
+        except Exception as e:  # noqa: BLE001
+            moe["ragged_oracle_error"] = f"{type(e).__name__}: {e}"
+        finally:
+            os.environ.pop("VDT_MOE_IMPL", None)
+
     serve_detail = None
     if not on_cpu and os.environ.get("VDT_BENCH_SERVE", "1") == "1":
         try:
             serve_detail = _serve_probe()
         except Exception as e:  # noqa: BLE001
             serve_detail = {"error": f"{type(e).__name__}: {e}"}
+        if serve_detail and "error" not in serve_detail:
+            # Matched engine-direct comparison (VERDICT r4 #1 bar:
+            # serve >= 50% of engine-direct at the same batch/quant/K).
+            try:
+                direct = _run_config(
+                    shapes=LLAMA_1B, batch=16, k_steps=16, quant="int8",
+                    timed_dispatches=8,
+                )
+                serve_detail["engine_direct_matched_tps"] = direct[
+                    "tokens_per_sec"
+                ]
+                serve_detail["serve_frac_of_engine_direct"] = round(
+                    serve_detail["output_tokens_per_s"]
+                    / max(direct["tokens_per_sec"], 1e-9),
+                    3,
+                )
+            except Exception as e:  # noqa: BLE001
+                serve_detail["engine_direct_error"] = (
+                    f"{type(e).__name__}: {e}"
+                )
 
     n_chips = jax.local_device_count()
     result = {

@@ -222,3 +222,50 @@ def test_int8_engine_pallas_interpret_path(tiny_llama):
     assert layer["wqkv"].matmul == "pallas_interpret"
     assert "wgu" in layer and "wq" not in layer and "gate" not in layer
     assert via_kernel == base
+
+
+def test_int4_matmul_kernel_interpret():
+    """Weight-streaming int4 kernel (permuted-contraction nibble
+    unpack) vs dequantize-in-graph, multiple group sizes."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from vllm_distributed_tpu.ops.pallas.quant_matmul import int4_matmul
+    from vllm_distributed_tpu.ops.quant import dequantize, quantize
+
+    rng = np.random.default_rng(0)
+    for in_dim, out_dim, group, blk in (
+        (256, 512, 128, 256),
+        (256, 256, 64, 128),
+        (128, 128, 2, 128),
+    ):
+        x = jnp.asarray(
+            rng.standard_normal((8, in_dim)) * 0.3, jnp.float32
+        )
+        w = rng.standard_normal((in_dim, out_dim)).astype(np.float32) * 0.1
+        qt = quantize(w, 4, group=group)
+        want = np.asarray(x @ dequantize(qt, jnp.float32))
+        got = np.asarray(
+            int4_matmul(
+                x, jnp.asarray(qt.q), jnp.asarray(qt.scale),
+                group=group, block_out=blk, interpret=True,
+            )
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_int4_engine_pallas_interpret_path(tiny_llama):
+    """Engine e2e on the int4 streaming path must match the int4
+    dequant-in-graph path token-for-token (identical quantized values,
+    different execution backend)."""
+    import os
+    from unittest import mock
+
+    _, base = _greedy(tiny_llama, quantization="int4")
+    with mock.patch.dict(
+        os.environ, {"VDT_USE_PALLAS": "pallas_interpret"}
+    ):
+        eng, via_kernel = _greedy(tiny_llama, quantization="int4")
+    layer = eng.executor.worker.runner.params["layers"][0]
+    assert layer["wq"].matmul == "pallas_interpret"
+    assert via_kernel == base

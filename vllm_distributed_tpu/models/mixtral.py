@@ -285,7 +285,22 @@ class MixtralForCausalLM(LlamaForCausalLM):
         return envs.VDT_MOE_IMPL
 
     def _mlp(self, h: jax.Array, layer: dict) -> jax.Array:
-        if self._moe_impl() == "dense":
+        impl = self._moe_impl()
+        if impl == "auto":
+            # Measured on v5e (BENCH_r05 moe config + PERF.md): decode
+            # is weight-BANDWIDTH-bound, where the dense einsum wins —
+            # XLA fuses the int8 dequant into the dot so the compressed
+            # bytes stream once, while ragged_dot cannot fuse a
+            # producer and materializes the bf16 expert stack per call
+            # (4.4x slower end-to-end at batch 32).  The ragged path's
+            # k/E FLOP saving only pays on big COMPUTE-bound row
+            # counts with unquantized experts.
+            from vllm_distributed_tpu.ops.quant import QuantizedTensor
+
+            quantized = isinstance(layer["w1"], QuantizedTensor)
+            rows = h.shape[0] * self.top_k
+            impl = "dense" if (quantized or rows <= 256) else "ragged"
+        if impl == "dense":
             return self._mlp_dense(h, layer)
         return self._mlp_ragged(h, layer)
 
@@ -356,8 +371,14 @@ class MixtralForCausalLM(LlamaForCausalLM):
             inner = jax.nn.silu(h1) * h3
             orows = jax.lax.ragged_dot(inner, w2, gs)
 
-        y = jnp.zeros((t, h.shape[1]), orows.dtype)
-        return y.at[tok].add(orows * row_w[:, None]).astype(h.dtype)
+        # f32 accumulation for the k-way combine: the dense oracle's
+        # einsum promotes the combine matrix to f32, so a bf16
+        # scatter-add here would drift from it (ADVICE r4 #1).
+        y = jnp.zeros((t, h.shape[1]), jnp.float32)
+        contrib = orows.astype(jnp.float32) * row_w.astype(jnp.float32)[
+            :, None
+        ]
+        return y.at[tok].add(contrib).astype(h.dtype)
 
     def _ragged_ep(self, xs, gs, w1, w3, w2, mesh, tp):
         """EP shard_map: each device's local experts own a contiguous

@@ -18,14 +18,15 @@ land contiguously.  Aligned whole-page slabs CAN be DMA'd — that is
 the shape of the flush path planned for staged decode writes — but a
 single token row cannot, hence dynamic_update_slice here.
 
-This module keeps its historical name/location (ops/pallas/kv_update)
-because it is the decode-path writer the runner and tests select; the
-implementation is pure XLA.
+STATUS: bench/test oracle only.  The production decode path stages
+micro-step rows in dense side buffers and flushes them once per
+dispatch through ops/pallas/kv_flush (the runner's _pick_kv_flush_fn);
+nothing in the serving path selects this writer anymore.  It remains
+the per-row in-place reference the flush path is tested against, and
+the record of WHY a per-row Pallas writer is impossible (above).
 
-Cost: ~1.8 µs per row update (measured); the batch-64 decode step pays
-~2 DUS per sequence per layer.  The staged side-buffer design (write
-micro-step K/V densely, flush per dispatch) removes this from the
-per-micro-step path.
+Cost: ~1.8 µs per row update (measured) — the number that motivated
+the staged-flush design.
 """
 
 from __future__ import annotations

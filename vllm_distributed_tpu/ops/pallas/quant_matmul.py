@@ -1,21 +1,33 @@
-"""Pallas weight-streaming int8 matmul: x @ dequant(Wq).
+"""Pallas weight-streaming quantized matmuls: x @ dequant(Wq).
 
-The decode hot path is HBM-bound on weight reads; weight-only int8
-halves those bytes — but ONLY if the int8 weights are what actually
-streams.  XLA either hoists the dequant out of the fused decode scan
-(materializing the bf16 model; blocked by an optimization_barrier in
-model_runner) or materializes a dequantized copy per micro-step, which
-pays int8-read + bf16-write + bf16-read and erases the win.  This
-kernel does what the hardware wants: DMA int8 tiles HBM→VMEM (Pallas
-pipelines/double-buffers the grid blocks), dequantize in VMEM, feed the
-MXU in bf16 — the only HBM traffic is the int8 bytes.
+The decode hot path is HBM-bound on weight reads; weight-only int8/int4
+cuts those bytes 2×/4× — but ONLY if the compressed weights are what
+actually streams.  XLA either hoists the dequant out of the fused
+decode scan (materializing the bf16 model; blocked by an
+optimization_barrier in model_runner) or materializes a dequantized
+copy per micro-step, which pays compressed-read + bf16-write +
+bf16-read and erases the win.  These kernels do what the hardware
+wants: DMA compressed tiles HBM→VMEM (Pallas pipelines/double-buffers
+the grid blocks), dequantize in VMEM, feed the MXU — the only HBM
+traffic is the compressed bytes.
+
+int4 packing note: the host packs input rows 2i (low nibble) and 2i+1
+(high nibble) into one byte (ops/quant.py).  Un-interleaving rows in
+VMEM would be a sublane relayout Mosaic handles poorly, so the kernel
+never interleaves: a matmul contraction is order-invariant, so the
+CALLER permutes x's columns to [evens | odds] (cheap XLA op on the tiny
+activation) and the kernel runs TWO dots — low nibbles against the
+even columns, high nibbles against the odd columns.  Group scales along
+the input dim stay aligned because rows 2i and 2i+1 always share a
+group (group sizes are even): each half's row r maps to group
+r // (group/2), a contiguous sublane broadcast.
 
 Activations stay exact (weight-only quantization, same numerics as
-``dequantize()`` + matmul: q.astype(f32) * scale).
+``dequantize()`` + matmul).
 
-Used for 2D per-channel int8 weights on the single-chip path; under
-tp>1 the matmuls belong to GSPMD (a custom call would break its
-partitioning), so the dequant-in-graph fallback applies there.
+Used for 2D weights on the single-chip path; under tp>1 the int8 path
+shard_maps per shard (ops/quant.py), int4 falls back to
+dequant-in-graph.
 """
 
 from __future__ import annotations
@@ -48,6 +60,77 @@ def _kernel(x_ref, q_ref, s_ref, o_ref, *, out_dtype):
         preferred_element_type=jnp.float32,
     )
     o_ref[...] = acc.astype(out_dtype)
+
+
+def _kernel4(x_ref, q_ref, s_ref, o_ref, *, group, out_dtype):
+    # x [T, IN] (columns permuted to [evens | odds]); q [IN/2, BLK]
+    # uint8 (low nibble = even row, high = odd); s [IN/group, BLK] f32.
+    half = q_ref.shape[0]
+    # Mosaic has no direct uint8->f32 cast; hop through int32.
+    q = q_ref[...].astype(jnp.int32)
+    low = (q & 0xF).astype(jnp.float32) - 8.0
+    high = (q >> 4).astype(jnp.float32) - 8.0
+    # Each half's row r belongs to group r // (group/2): expand the
+    # scale rows by sublane broadcast (shared by both halves).
+    g2 = group // 2
+    s = s_ref[...]
+    sexp = jnp.broadcast_to(
+        s[:, None, :], (s.shape[0], g2, s.shape[1])
+    ).reshape(half, s.shape[1])
+    x = x_ref[...].astype(jnp.float32)
+    acc = jnp.dot(
+        x[:, :half], low * sexp, preferred_element_type=jnp.float32
+    )
+    acc += jnp.dot(
+        x[:, half:], high * sexp, preferred_element_type=jnp.float32
+    )
+    o_ref[...] = acc.astype(out_dtype)
+
+
+def int4_matmul(
+    x: jax.Array,  # [T, IN]
+    q: jax.Array,  # [IN/2, OUT] uint8 (packed nibbles)
+    scale: jax.Array,  # [IN/group, OUT] f32 (group-wise along IN)
+    *,
+    group: int,
+    block_out: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """x @ dequant4(q, scale) with packed int4 weights streamed
+    tile-by-tile (see module docstring for the permuted-contraction
+    trick)."""
+    t, in_dim = x.shape
+    half, out_dim = q.shape
+    assert half * 2 == in_dim, (x.shape, q.shape)
+    assert group % 2 == 0 and group >= 2
+    block_out = min(block_out, out_dim)
+    if out_dim % block_out:
+        raise ValueError(f"out dim {out_dim} % block {block_out} != 0")
+    if not fits_vmem_budget(in_dim, block_out, x.nbytes):
+        raise ValueError(
+            f"int4_matmul tile budget exceeded (in={in_dim}, "
+            f"block={block_out}, T={t})"
+        )
+    # Permute the contraction to [evens | odds] (cheap: x is the small
+    # activation).  The kernel's two dots undo the nibble packing.
+    x2 = jnp.concatenate([x[:, 0::2], x[:, 1::2]], axis=-1)
+    kernel = functools.partial(
+        _kernel4, group=group, out_dtype=x.dtype
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(out_dim // block_out,),
+        in_specs=[
+            pl.BlockSpec((t, in_dim), lambda j: (0, 0)),
+            pl.BlockSpec((half, block_out), lambda j: (0, j)),
+            pl.BlockSpec(
+                (scale.shape[0], block_out), lambda j: (0, j)
+            ),
+        ],
+        out_specs=pl.BlockSpec((t, block_out), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((t, out_dim), x.dtype),
+        interpret=interpret,
+    )(x2, q, scale.astype(jnp.float32))
 
 
 def int8_matmul(

@@ -160,11 +160,11 @@ def maybe_dequantize(w, dtype) -> jax.Array:
 
 def pick_matmul_mode(quant_method: str | None) -> str:
     """Execution backend for quantized matmuls, decided at load time:
-    "pallas" streams int8 tiles through the Pallas kernel — single-chip
-    directly, tp>1 per shard under shard_map (quant_matmul wraps it;
-    GSPMD cannot partition the custom call itself).  int4 and
-    non-quantized stay "dequant"."""
-    if quant_method != "int8":
+    "pallas" streams compressed tiles through the Pallas kernels —
+    int8 single-chip and per-tp-shard under shard_map; int4 single-chip
+    (tp>1 int4 falls back to dequant-in-graph at call time).
+    Non-quantized stays "dequant"."""
+    if quant_method not in ("int8", "int4"):
         return "dequant"
     from vllm_distributed_tpu import envs
 
@@ -259,24 +259,41 @@ def quant_matmul(x: jax.Array, w, bias=None) -> jax.Array:
     when the weight was placed on a mesh; everything else dequantizes
     in-graph."""
     if isinstance(w, QuantizedTensor):
-        from vllm_distributed_tpu.ops.pallas.quant_matmul import int8_matmul
+        from vllm_distributed_tpu.ops.pallas.quant_matmul import (
+            int4_matmul,
+            int8_matmul,
+        )
 
         interpret = w.matmul == "pallas_interpret"
         eligible = (
             w.matmul != "dequant"
-            and w.bits == 8
             and w.q.ndim == 2
             and x.ndim == 2
             and x.shape[0] <= 256
         )
         out = None
-        if eligible and w.mesh is not None and w.spec is not None:
-            out = _sharded_int8_matmul(x, w, interpret)
-        elif eligible and w.mesh is None:
-            blk = _pick_block(w.q.shape[-1], w.q.shape[0], x.nbytes)
+        if w.bits == 8:
+            if eligible and w.mesh is not None and w.spec is not None:
+                out = _sharded_int8_matmul(x, w, interpret)
+            elif eligible and w.mesh is None:
+                blk = _pick_block(w.q.shape[-1], w.q.shape[0], x.nbytes)
+                if blk is not None:
+                    out = int8_matmul(
+                        x, w.q, w.scale, block_out=blk,
+                        interpret=interpret,
+                    )
+        elif (
+            w.bits == 4
+            and eligible
+            and w.mesh is None  # tp>1 int4: dequant-in-graph for now
+            and w.group >= 2
+            and w.group % 2 == 0
+        ):
+            blk = _pick_block(w.q.shape[-1], w.shape[-2], x.nbytes)
             if blk is not None:
-                out = int8_matmul(
-                    x, w.q, w.scale, block_out=blk, interpret=interpret
+                out = int4_matmul(
+                    x, w.q, w.scale, group=w.group, block_out=blk,
+                    interpret=interpret,
                 )
         if out is None:
             out = x @ dequantize(w, x.dtype)

@@ -132,3 +132,11 @@ MIXTRAL_8X7B = dict(
     heads=32, kv_heads=8, num_experts=8, top_k=2, max_pos=4096,
     dtype="bfloat16",
 )
+# Mixtral-style MoE scaled to fit ONE v5e chip with int8 weights
+# (~4.8B params): same 8-expert/top-2 routing shape as the flagship
+# family, 1B-class dims — the single-chip MoE bench config.
+MIXTRAL_8X1B = dict(
+    vocab_size=32000, hidden=2048, intermediate=5632, layers=16,
+    heads=32, kv_heads=8, num_experts=8, top_k=2, max_pos=4096,
+    dtype="bfloat16",
+)
