@@ -514,8 +514,11 @@ def _serve_probe() -> dict:
             max_model_len=512,
             num_decode_steps=16,
             max_concurrent_dispatches=6,
+            # warmup_decode only: the probe's prefill shapes are
+            # multi-request JOINS, which the single-request prefill
+            # buckets of --warmup-prefill would not cover anyway — the
+            # HTTP warmup passes below compile the real shapes.
             warmup_decode=True,
-            warmup_prefill=True,
         )
     )
     state = init_app_state(engine, served_model_name="bench-1b")
@@ -534,11 +537,29 @@ def _serve_probe() -> dict:
             input_len=32,
             output_len=128,
         )
-        # Warmup pass (absorbs any join-shape compiles the boot warmup
-        # missed), then the measured pass.
-        warm = argparse.Namespace(**{**vars(args), "output_len": 16})
-        loop.run_until_complete(_bench_serve_async(warm))
+        # Warmup passes at EVERY measured concurrency (each join batch
+        # size is its own prefill program shape), then the measured
+        # passes: headline at c16 plus a small sweep (r4 weak #6) for
+        # per-stream latency at low load.
+        sweep_concs = (1, 4)
+        for conc in (args.concurrency, *sweep_concs):
+            warm = argparse.Namespace(
+                **{**vars(args), "output_len": 16, "concurrency": conc,
+                   "num_prompts": max(2 * conc, 4)}
+            )
+            loop.run_until_complete(_bench_serve_async(warm))
         result = loop.run_until_complete(_bench_serve_async(args))
+        result["sweep"] = {}
+        for conc in sweep_concs:
+            a = argparse.Namespace(
+                **{**vars(args), "concurrency": conc,
+                   "num_prompts": max(3 * conc, 4)}
+            )
+            r = loop.run_until_complete(_bench_serve_async(a))
+            result["sweep"][f"c{conc}"] = {
+                k: r[k]
+                for k in ("output_tokens_per_s", "ttft_s", "itl_ms")
+            }
         loop.run_until_complete(server.close())
         return result
     finally:
@@ -569,10 +590,13 @@ def main() -> None:
     # restart story (§5.4 — XLA disk cache + AOT export artifacts
     # written EARLIER IN THIS RUN), while ttft_cold stays honestly cold
     # (a shared /tmp dir would leak warmth across runs).
-    os.environ.setdefault(
-        "VDT_COMPILE_CACHE_DIR",
-        tempfile.mkdtemp(prefix="vdt_bench_cache_"),
-    )
+    if "VDT_COMPILE_CACHE_DIR" not in os.environ:
+        import atexit
+        import shutil
+
+        cache = tempfile.mkdtemp(prefix="vdt_bench_cache_")
+        os.environ["VDT_COMPILE_CACHE_DIR"] = cache
+        atexit.register(shutil.rmtree, cache, ignore_errors=True)
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
         # The env var alone can lose to an interpreter-startup jax import
         # (sitecustomize); the config update before first backend use wins.
@@ -617,7 +641,7 @@ def main() -> None:
             # int4 weight streaming (nibble-unpack in VMEM).
             ("llama_1b_int4_b64", dict(
                 shapes=LLAMA_1B, batch=64, k_steps=32, quant="int4",
-                kv_dtype="int8")),
+                kv_dtype="int8", timed_dispatches_cap=12)),
         ]
         if os.environ.get("VDT_BENCH_FAST") != "1":
             configs += [
@@ -630,10 +654,11 @@ def main() -> None:
                 ("llama_7b_int8_b16", dict(
                     shapes=LLAMA_7B, batch=16, k_steps=16, quant="int8",
                     timed_dispatches_cap=16)),
+                # (no warm/prefill probes here: each one is a full 7B
+                # rebuild — the restart story is measured once, at 1B)
                 ("llama_7b_int8_kv8_b48", dict(
                     shapes=LLAMA_7B, batch=48, k_steps=16, quant="int8",
-                    kv_dtype="int8", timed_dispatches_cap=16,
-                    prefill_probe=True)),
+                    kv_dtype="int8", timed_dispatches_cap=16)),
                 # MoE (the reference flagship family is MoE): ragged
                 # sorted dispatch, single chip, int8 weights.
                 ("moe_mixtral8x1b_int8_b32", dict(
@@ -670,7 +695,10 @@ def main() -> None:
     # models/mixtral.py _mlp); rerun briefly with the ragged path
     # forced so the tradeoff is measured on the record every round.
     moe = details.get("moe_mixtral8x1b_int8_b32")
-    if moe and "error" not in moe:
+    # Skip (and don't clobber) when the user forced an impl themselves:
+    # the comparison is only meaningful against the auto headline.
+    user_impl = os.environ.get("VDT_MOE_IMPL")
+    if moe and "error" not in moe and user_impl in (None, "auto"):
         from vllm_distributed_tpu.testing import MIXTRAL_8X1B
 
         os.environ["VDT_MOE_IMPL"] = "ragged"
@@ -690,7 +718,10 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             moe["ragged_oracle_error"] = f"{type(e).__name__}: {e}"
         finally:
-            os.environ.pop("VDT_MOE_IMPL", None)
+            if user_impl is None:
+                os.environ.pop("VDT_MOE_IMPL", None)
+            else:
+                os.environ["VDT_MOE_IMPL"] = user_impl
 
     serve_detail = None
     if not on_cpu and os.environ.get("VDT_BENCH_SERVE", "1") == "1":

@@ -16,6 +16,17 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("VDT_PLATFORM", "cpu")
+# Hermetic compile cache: the shared default dir can hold entries
+# produced by a remote AOT compiler with different host features, whose
+# loader errors spam every test log (VERDICT r4 weak #8).
+import atexit  # noqa: E402
+import shutil  # noqa: E402
+import tempfile  # noqa: E402
+
+if "VDT_COMPILE_CACHE_DIR" not in os.environ:
+    _cache = tempfile.mkdtemp(prefix="vdt_test_cache_")
+    os.environ["VDT_COMPILE_CACHE_DIR"] = _cache
+    atexit.register(shutil.rmtree, _cache, ignore_errors=True)
 
 import jax  # noqa: E402
 

@@ -188,3 +188,15 @@ def test_kv_transfer_config_engine_path(model_dir):
         for o in engine.step():
             toks = o.outputs[0].token_ids
     assert len(toks) == 4
+
+
+def test_get_tokenizer_info(served):
+    """Parity: the reference registers vLLM's tokenizer-info endpoint
+    (launch.py:34, 428)."""
+    async def go(client):
+        r = await client.get("/get_tokenizer_info", headers=AUTH)
+        assert r.status == 200
+        data = await r.json()
+        assert data["vocab_size"] and data["tokenizer_class"]
+
+    _call(served, go)

@@ -267,5 +267,8 @@ def test_int4_engine_pallas_interpret_path(tiny_llama):
     ):
         eng, via_kernel = _greedy(tiny_llama, quantization="int4")
     layer = eng.executor.worker.runner.params["layers"][0]
-    assert layer["wq"].matmul == "pallas_interpret"
+    # int4 projections fuse like int8 on the kernel path (same concat
+    # along the out dim preserves packing and group layout).
+    assert layer["wqkv"].matmul == "pallas_interpret"
+    assert layer["wqkv"].bits == 4
     assert via_kernel == base

@@ -47,6 +47,13 @@ def _add_server_args(parser: argparse.ArgumentParser) -> None:
         default=None,
         help="require 'Authorization: Bearer <key>' on API endpoints",
     )
+    parser.add_argument(
+        "--log-config",
+        type=str,
+        default=None,
+        help="JSON logging-config file applied via logging.config."
+        "dictConfig (the reference's load_log_config, launch.py:34,423)",
+    )
 
 
 def make_parser() -> argparse.ArgumentParser:
@@ -117,6 +124,11 @@ async def _serve_async(args: argparse.Namespace) -> None:
 
     if args.model_tag:
         args.model = args.model_tag
+    if getattr(args, "log_config", None):
+        import logging.config
+
+        with open(args.log_config) as f:
+            logging.config.dictConfig(json.load(f))
     if args.tool_parser_plugin:
         ToolParserManager.import_tool_parser(args.tool_parser_plugin)
     engine_args = EngineArgs.from_cli_args(args)

@@ -670,6 +670,26 @@ async def embeddings(request: web.Request) -> web.Response:
     return web.json_response(resp.model_dump())
 
 
+async def tokenizer_info(request: web.Request) -> web.Response:
+    """Tokenizer metadata (the reference registers vLLM's
+    maybe_register_tokenizer_info_endpoint, launch.py:34, 428)."""
+    state: ServerState = request.app["state"]
+    tok = state.engine.tokenizer
+    if tok is None:
+        return _error("tokenizer unavailable")
+    info = {
+        "tokenizer_class": type(tok).__name__,
+        "vocab_size": getattr(tok, "vocab_size", None),
+        "model_max_length": getattr(tok, "model_max_length", None),
+        "bos_token": getattr(tok, "bos_token", None),
+        "eos_token": getattr(tok, "eos_token", None),
+        "pad_token": getattr(tok, "pad_token", None),
+        "chat_template": state.chat_template
+        or getattr(tok, "chat_template", None),
+    }
+    return web.json_response(info)
+
+
 # ---- app assembly ----
 def build_app(state: ServerState) -> web.Application:
     app = web.Application(
@@ -682,6 +702,7 @@ def build_app(state: ServerState) -> web.Application:
     app.router.add_get("/v1/models", list_models)
     app.router.add_post("/tokenize", tokenize)
     app.router.add_post("/detokenize", detokenize)
+    app.router.add_get("/get_tokenizer_info", tokenizer_info)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/embeddings", embeddings)

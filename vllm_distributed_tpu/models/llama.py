@@ -200,19 +200,24 @@ class LlamaForCausalLM(SupportsQuantization):
     _GU_FUSE = ("gate", "up")
 
     def fuse_quantized_projections(self, params: dict) -> dict:
-        """Concatenate the int8 Q|K|V and gate|up weights out-dim-wise
-        so each layer issues one Pallas weight-streaming call instead of
-        three/two (per-out-block computation is independent, so results
-        are bit-identical to the unfused calls).  Only applies where
-        every member is an eligible int8 kernel-mode tensor; called by
-        the runner on the single-chip path after load."""
+        """Concatenate the quantized Q|K|V and gate|up weights
+        out-dim-wise so each layer issues one Pallas weight-streaming
+        call instead of three/two (per-out-block computation is
+        independent, so results are bit-identical to the unfused
+        calls).  int8 concatenates q [in, out] and per-channel scales;
+        int4 concatenates the packed q [in/2, out] and group scales
+        [in/group, out] — both along the out dim, which preserves the
+        packing and group layout exactly.  Only applies where every
+        member is an eligible kernel-mode tensor of the same
+        bits/group; called by the runner on the single-chip path after
+        load."""
         from vllm_distributed_tpu.ops.quant import QuantizedTensor
 
         def fusable(layer, names):
             ws = [layer.get(n) for n in names]
             if not all(
                 isinstance(w, QuantizedTensor)
-                and w.bits == 8
+                and w.bits in (8, 4)
                 and w.q.ndim == 2
                 and w.matmul in ("pallas", "pallas_interpret")
                 # A tp-sharded concat along the out dim would interleave
@@ -222,6 +227,8 @@ class LlamaForCausalLM(SupportsQuantization):
                 for w in ws
             ):
                 return None
+            if len({(w.bits, w.group) for w in ws}) != 1:
+                return None  # mixed schemes stay unfused
             if any(layer.get(f"b{n[-1]}") is not None for n in names
                    if n.startswith("w")):
                 return None  # biased projections (qwen2) stay unfused
@@ -238,8 +245,8 @@ class LlamaForCausalLM(SupportsQuantization):
                 layer[fused_name] = QuantizedTensor(
                     q=jnp.concatenate([w.q for w in ws], axis=-1),
                     scale=jnp.concatenate([w.scale for w in ws], axis=-1),
-                    bits=8,
-                    group=0,
+                    bits=ws[0].bits,
+                    group=ws[0].group,
                     shape=(ws[0].shape[0], sum(w.shape[1] for w in ws)),
                     dtype=ws[0].dtype,
                     matmul=ws[0].matmul,

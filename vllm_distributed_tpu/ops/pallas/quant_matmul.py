@@ -46,9 +46,19 @@ def fits_vmem_budget(in_dim: int, block_out: int, x_nbytes: int) -> bool:
     26 MiB cap is empirically anchored: [8192x512] and [2048x2048]
     tiles (both = 24 MiB by this model) compile and run on v5e across
     the whole bench suite; one step up ([4096x2048] = 48 MiB) must not
-    be approved.  Single source of truth for the caller's eligibility
-    check and the kernel's own guard."""
+    be approved.  Single source of truth for the int8 caller's
+    eligibility check and the kernel's own guard (int4 has its own
+    model below — its temporaries are larger)."""
     return in_dim * block_out * 6 + 2 * x_nbytes <= 26 * 2**20
+
+
+def fits_vmem_budget4(in_dim: int, block_out: int, x_nbytes: int) -> bool:
+    """int4 kernel VMEM model: per LOGICAL input element the kernel
+    holds ~half-height planes of int32 q (2B), two f32 nibble planes
+    (4B), the expanded scales (2B) and the two scaled operands (4B),
+    plus double-buffered packed tiles — ~16B/element against int8's
+    6B.  Same empirically-anchored 26 MiB cap."""
+    return in_dim * block_out * 16 + 2 * x_nbytes <= 26 * 2**20
 
 
 def _kernel(x_ref, q_ref, s_ref, o_ref, *, out_dtype):
@@ -106,7 +116,7 @@ def int4_matmul(
     block_out = min(block_out, out_dim)
     if out_dim % block_out:
         raise ValueError(f"out dim {out_dim} % block {block_out} != 0")
-    if not fits_vmem_budget(in_dim, block_out, x.nbytes):
+    if not fits_vmem_budget4(in_dim, block_out, x.nbytes):
         raise ValueError(
             f"int4_matmul tile budget exceeded (in={in_dim}, "
             f"block={block_out}, T={t})"

@@ -176,15 +176,22 @@ def pick_matmul_mode(quant_method: str | None) -> str:
     return "dequant"
 
 
-def _pick_block(out_dim: int, in_dim: int, x_nbytes: int) -> int | None:
+def _pick_block(
+    out_dim: int, in_dim: int, x_nbytes: int, bits: int = 8
+) -> int | None:
     """Largest out-block that divides out_dim and fits the VMEM budget.
     Bigger tiles stream faster ([2048x8192] with blk 2048: 1084 GB/s vs
     723 at blk 512 on v5e) — but the budget only admits them for small
-    in_dims (2048-class); 4096/8192-in matmuls cap at 1024/512."""
-    from vllm_distributed_tpu.ops.pallas.quant_matmul import fits_vmem_budget
+    in_dims (2048-class); 4096/8192-in matmuls cap at 1024/512.  int4
+    uses its own (larger-temporaries) budget model."""
+    from vllm_distributed_tpu.ops.pallas.quant_matmul import (
+        fits_vmem_budget,
+        fits_vmem_budget4,
+    )
 
+    fits = fits_vmem_budget4 if bits == 4 else fits_vmem_budget
     for blk in (2048, 1024, 512, 256, 128):
-        if out_dim % blk == 0 and fits_vmem_budget(in_dim, blk, x_nbytes):
+        if out_dim % blk == 0 and fits(in_dim, blk, x_nbytes):
             return blk
     return None
 
@@ -289,7 +296,7 @@ def quant_matmul(x: jax.Array, w, bias=None) -> jax.Array:
             and w.group >= 2
             and w.group % 2 == 0
         ):
-            blk = _pick_block(w.q.shape[-1], w.shape[-2], x.nbytes)
+            blk = _pick_block(w.q.shape[-1], w.shape[-2], x.nbytes, bits=4)
             if blk is not None:
                 out = int4_matmul(
                     x, w.q, w.scale, group=w.group, block_out=blk,
