@@ -68,9 +68,13 @@ def _check_kernels() -> str:
         q_seq_ids=jnp.asarray(seq_ids),
         q_positions=jnp.asarray(positions),
         slot_mapping=jnp.zeros(t, jnp.int32),
+        # Page 0 is the engine's reserved dump page (garbage by
+        # contract), so harness tables use pages 1..P-1 like the
+        # allocator does.
         block_tables=jnp.asarray(
             np.arange(s_pad * pages, dtype=np.int32).reshape(s_pad, pages)
-            % pages
+            % (pages - 1)
+            + 1
         ),
         seq_lens=jnp.asarray([37, 20, 0, 0], jnp.int32),
         logits_indices=jnp.zeros(s_pad, jnp.int32),
@@ -105,7 +109,7 @@ def _check_kernels() -> str:
     sid2 = np.array([0, 1, s2, 3], np.int32)  # row 2 -> dropped padding
     pos2 = np.maximum(lens2 - 1, 0)[np.minimum(sid2, s2 - 1)]
     bt2 = (
-        rng.permutation(np.arange(s2 * 40) % pages2)
+        rng.permutation(np.arange(s2 * 40) % (pages2 - 1) + 1)
         .reshape(s2, 40)
         .astype(np.int32)
     )
