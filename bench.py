@@ -1,24 +1,28 @@
 """Throughput bench — prints ONE JSON line for the driver.
 
 Measures steady-state decode throughput (tokens/sec/chip) of the engine
-on Llama-shaped models with dummy weights on whatever backend is live
-(the real TPU chip under the driver).  The reference publishes no
-numbers (BASELINE.md: "published": {}), so vs_baseline is reported as
-1.0 by convention; the `detail` block carries the honest engineering
-numbers per config: dispatch percentiles, inter-token latency, a
-roofline that counts BOTH weight and KV-cache traffic, warm/cold TTFT,
-and on-chip kernel checks (Pallas attention, in-place KV writer, int8
-weight-streaming matmul) run before any timing.
+on dummy-weight family-member shapes on whatever backend is live (the
+real TPU chip under the driver).  The reference publishes no numbers
+(BASELINE.md: "published": {}), so vs_baseline is reported as 1.0 by
+convention; the `detail` block carries the honest engineering numbers
+per config: dispatch percentiles, inter-token latency, an UNCLAMPED
+roofline against weight + actually-scheduled-KV traffic, cold/warm
+TTFT, prefill tokens/sec, and on-chip kernel checks (Pallas attention
+incl. int8 pools, KV writer, int8/int4 weight streamers, grouped
+ragged_dot lowering) run before any timing.
 
-Default configs: Llama-1B bf16 @ batch 32 (the r1/r2 continuity
-config), Llama-1B int8 @ batch 64 (best single-chip throughput), and
-Llama-7B int8 @ batch 32 (the BASELINE.md-tracked shape; int8 is how
-7B fits one v5e chip).  The headline value is the best decode tok/s per
-chip across configs.
+Default configs: Llama-1B bf16 b32 and int8 b64 (continuity shapes),
+Llama-1B int4+int8KV b64 (streamer), Llama-7B int8 b16 (r4 continuity)
+and int8+int8KV b48 (headline 7B), and a Mixtral-shape 8x1B MoE b32
+(auto dispatch + a forced-ragged comparison).  The serve probe drives
+the OpenAI server over HTTP/SSE at c16 with a c1/c4 sweep and a
+matched engine-direct fraction.  The headline value is the best decode
+tok/s/chip across configs.
 
 Env knobs: VDT_BENCH_MODEL=1b|7b|tiny + VDT_BENCH_BATCH/VDT_BENCH_STEPS/
-VDT_BENCH_QUANT run one explicit config instead; VDT_BENCH_DISPATCHES
-sizes the timed window; VDT_BENCH_FAST=1 skips the 7B config.
+VDT_BENCH_QUANT/VDT_BENCH_KV run one explicit config instead;
+VDT_BENCH_DISPATCHES sizes the timed window; VDT_BENCH_FAST=1 skips the
+7B and MoE configs; VDT_BENCH_SERVE=0 skips the serve probe.
 """
 
 from __future__ import annotations
