@@ -105,3 +105,39 @@ def test_stop_token_mid_window():
     out = _run(8, reqs)
     idx = toks.index(stop_tok)
     assert out["r0"] == toks[: idx + 1]
+
+
+def test_heterogeneous_tails_masked_not_recompiled():
+    """Uniform-K with per-sequence tail masking (round 5): requests
+    with different max_tokens — none a multiple of K, several under
+    one K — must produce exactly their budget, bit-identical to the
+    sync engine, while the scheduler emits only K=num_decode_steps
+    fused scans (no tail-K program proliferation)."""
+    reqs = [
+        dict(temperature=0.0, max_tokens=m, ignore_eos=True)
+        for m in (3, 17, 40, 5, 29, 8)
+    ]
+    sync = _run(1, reqs)
+    assert [len(sync[f"r{i}"]) for i in range(6)] == [3, 17, 40, 5, 29, 8]
+
+    from vllm_distributed_tpu.engine.scheduler import Scheduler
+
+    seen_k = set()
+    orig = Scheduler.schedule
+
+    def spy(self):
+        out = orig(self)
+        if out.decode_steps > 1:
+            seen_k.add(out.decode_steps)
+            # Under-K tails are per-request num_new, not a smaller K.
+            for c in out.cached_requests:
+                assert c.num_new_tokens <= out.decode_steps
+        return out
+
+    Scheduler.schedule = spy
+    try:
+        fused = _run(8, reqs)
+    finally:
+        Scheduler.schedule = orig
+    assert fused == sync
+    assert seen_k == {8}, seen_k
