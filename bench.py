@@ -22,7 +22,10 @@ tok/s/chip across configs.
 Env knobs: VDT_BENCH_MODEL=1b|7b|tiny + VDT_BENCH_BATCH/VDT_BENCH_STEPS/
 VDT_BENCH_QUANT/VDT_BENCH_KV run one explicit config instead;
 VDT_BENCH_DISPATCHES sizes the timed window; VDT_BENCH_FAST=1 skips the
-7B and MoE configs; VDT_BENCH_SERVE=0 skips the serve probe.
+7B and MoE configs; VDT_BENCH_SERVE=0 skips the serve probe;
+VDT_BENCH_PREFIX_CACHE=1 builds the engines with --enable-prefix-caching
+(details then report prefix_cache_hit_rate; `tools/ablation` is the
+dedicated on/off warm-TTFT comparison).
 """
 
 from __future__ import annotations
@@ -265,6 +268,9 @@ def _run_config(shapes, *, batch, k_steps, quant, timed_dispatches,
                 ),
                 quantization=quant,
                 kv_cache_dtype=kv_dtype,
+                enable_prefix_caching=(
+                    os.environ.get("VDT_BENCH_PREFIX_CACHE", "0") == "1"
+                ),
             )
         )
 
@@ -415,6 +421,11 @@ def _measure(engine, build, free_engine, *, batch, k_steps, quant,
         "param_bytes": param_bytes,
         "kv_read_bytes_per_microstep": kv_read_bytes,
     }
+    sched = getattr(engine, "scheduler", None)
+    if sched is not None and getattr(sched, "prefix_cache_queries", 0):
+        detail["prefix_cache_hit_rate"] = round(
+            sched.prefix_cache_hits / sched.prefix_cache_queries, 4
+        )
     if warm_engine_probe or prefill_probe:
         # Warm TTFT: a FRESH engine on the same shapes hits the
         # persistent caches this run just wrote (XLA disk cache + AOT

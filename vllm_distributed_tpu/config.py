@@ -142,6 +142,10 @@ class CacheConfig:
     page_size: int = 16
     num_pages: int | None = None
     hbm_utilization: float = 0.9
+    # Automatic prefix caching: content-addressed full pages with
+    # ref-counted LRU reuse (block_manager.PrefixCachingAllocator).
+    # Default off — the seed allocator path is byte-for-byte unchanged.
+    enable_prefix_caching: bool = False
     # "auto" follows model dtype; "int8" quantizes the pool per (token,
     # kv head) — ~2x capacity, ~2x less attention HBM traffic; staged
     # decode rows quantize at flush, numerics run f32 in-kernel.
@@ -349,6 +353,7 @@ class EngineArgs:
     # env var works on both the CLI and the programmatic path.
     hbm_utilization: float | None = None
     kv_cache_dtype: str = "auto"
+    enable_prefix_caching: bool = False
 
     tensor_parallel_size: int = 1
     pipeline_parallel_size: int = 1
@@ -407,6 +412,13 @@ class EngineArgs:
             "(default: $VDT_HBM_UTILIZATION or 0.9)",
         )
         parser.add_argument("--kv-cache-dtype", type=str, default="auto")
+        parser.add_argument(
+            "--enable-prefix-caching",
+            action="store_true",
+            help="reuse KV pages across requests sharing a prompt "
+            "prefix (content-addressed pages, ref-counted LRU "
+            "eviction)",
+        )
         parser.add_argument(
             "--tensor-parallel-size", "-tp", type=int, default=1
         )
@@ -497,6 +509,7 @@ class EngineArgs:
             num_pages=self.num_kv_pages,
             hbm_utilization=hbm_utilization,
             cache_dtype=self.kv_cache_dtype,
+            enable_prefix_caching=self.enable_prefix_caching,
         )
         parallel_config = ParallelConfig(
             tensor_parallel_size=self.tensor_parallel_size,

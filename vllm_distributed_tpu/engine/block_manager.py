@@ -10,9 +10,19 @@ recently-touched HBM is reused first.
 Slot addressing: token `t` of a request lives at flat slot
 ``page_ids[t // page_size] * page_size + t % page_size`` — the layout the
 attention kernels and the KV scatter in the model runner share.
+
+``PrefixCachingAllocator`` extends this with automatic prefix caching
+(vLLM's ``--enable-prefix-caching`` from Kwon et al. 2023; the hash-chain
+cousin of SGLang's RadixAttention, Zheng et al. 2024 — see PAPERS.md):
+full pages are content-addressed, freed pages park in an LRU queue
+instead of becoming garbage, and later requests re-attach them
+ref-counted, skipping the prefill of the shared prefix.
 """
 
 from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
 
 from vllm_distributed_tpu.engine.request import Request
 from vllm_distributed_tpu.utils import cdiv
@@ -42,7 +52,7 @@ class PageAllocator:
     def can_allocate(self, req: Request, num_new_tokens: int) -> bool:
         have = len(self._allocated.get(req.request_id, ()))
         need = self.num_pages_needed(req.num_computed_tokens + num_new_tokens)
-        return need - have <= len(self._free)
+        return need - have <= self.num_free_pages
 
     def allocate(self, req: Request, num_new_tokens: int) -> list[int]:
         """Ensure req owns enough pages to cover `num_computed_tokens +
@@ -77,3 +87,206 @@ class PageAllocator:
     def slot_for_token(self, req: Request, token_idx: int) -> int:
         page = req.page_ids[token_idx // self.page_size]
         return page * self.page_size + token_idx % self.page_size
+
+
+def hash_page_tokens(parent_key: bytes, token_ids: list[int]) -> bytes:
+    """Content address of one FULL page: sha256 over the parent page's
+    key followed by this page's token ids.  Chaining the parent key means
+    identical page content under different prefixes gets different keys —
+    a page's KV depends on every token before it, not just its own."""
+    h = hashlib.sha256(parent_key)
+    for t in token_ids:
+        h.update(int(t).to_bytes(8, "little", signed=True))
+    return h.digest()
+
+
+class PrefixCachingAllocator(PageAllocator):
+    """PageAllocator with content-addressed KV page reuse.
+
+    Every full page whose KV has actually been computed is registered
+    under ``hash_page_tokens(parent_key, page_tokens)``.  Pages released
+    by finished/preempted requests keep their registration and move to an
+    LRU queue (still counted free) instead of the plain free list; a new
+    request whose prompt walks the same hash chain re-attaches them with
+    a ref-count bump and starts prefill after the cached prefix.
+    Allocation draws from the free list first and evicts the
+    least-recently-freed cached page only when it must.
+
+    Shared pages need no copy-on-write: only full computed pages are ever
+    shared, hits stop at a page boundary strictly inside the prompt, and
+    every token from the hit onward is written into freshly allocated
+    pages — a shared page is never written.
+
+    Evicting a page whose descendants are still registered strands them
+    (lookups walk the chain from page 0 and stop at the gap); stranded
+    entries stay harmlessly registered until their own eviction.
+    """
+
+    def __init__(self, num_pages: int, page_size: int) -> None:
+        super().__init__(num_pages, page_size)
+        # page -> live owner count (pages in the free list / LRU: absent).
+        self._refs: dict[int, int] = {}
+        # Content registry (invariant: page_key[p] == k  <=>
+        # hash_to_page[k] == p; duplicate content never re-registers).
+        self._hash_to_page: dict[bytes, int] = {}
+        self._page_key: dict[int, bytes] = {}
+        # Cached-free pages, least recently freed first (eviction order).
+        self._lru: OrderedDict[int, None] = OrderedDict()
+        # req_id -> number of pages registered so far.
+        self._reg: dict[str, int] = {}
+        # req_id -> memoized page hash chain.  A request's token prefix
+        # never changes while it is alive (outputs only append; the
+        # stop-string truncation happens as the request finishes), so
+        # repeated queries of a waiting request and the later
+        # registration pass reuse these instead of re-hashing.
+        self._chains: dict[str, list[bytes]] = {}
+
+    @property
+    def num_free_pages(self) -> int:
+        # Cached-free pages are reusable on demand: count them free.
+        return len(self._free) + len(self._lru)
+
+    def can_allocate_with_prefix(
+        self, hit_pages: list[int], num_tokens_total: int
+    ) -> bool:
+        """Admission check for a request about to attach `hit_pages` and
+        then prefill up to `num_tokens_total` tokens: attaching removes
+        the cached-free hit pages from the free count, but also shrinks
+        what remains to allocate."""
+        need_new = self.num_pages_needed(num_tokens_total) - len(hit_pages)
+        free = self.num_free_pages - sum(
+            1 for p in hit_pages if p in self._lru
+        )
+        return need_new <= free
+
+    def _pop_free_page(self) -> int:
+        if self._free:
+            return self._free.pop()
+        if self._lru:
+            # Evict the least-recently-freed cached page.
+            page, _ = self._lru.popitem(last=False)
+            key = self._page_key.pop(page)
+            del self._hash_to_page[key]
+            return page
+        raise NoFreePagesError(f"out of KV pages ({self.num_pages} total)")
+
+    def allocate(self, req: Request, num_new_tokens: int) -> list[int]:
+        pages = self._allocated.setdefault(req.request_id, [])
+        need = self.num_pages_needed(
+            req.num_computed_tokens + num_new_tokens
+        )
+        new_pages: list[int] = []
+        while len(pages) < need:
+            try:
+                p = self._pop_free_page()
+            except NoFreePagesError:
+                # Roll back: caller decides to preempt.  Evicted pages
+                # lost their registration — a sliver of cache, never
+                # correctness.
+                for q in new_pages:
+                    pages.remove(q)
+                    self._refs.pop(q, None)
+                    self._free.append(q)
+                raise
+            self._refs[p] = 1
+            pages.append(p)
+            new_pages.append(p)
+        req.page_ids = pages
+        return new_pages
+
+    def free(self, req: Request) -> None:
+        pages = self._allocated.pop(req.request_id, [])
+        self._reg.pop(req.request_id, None)
+        self._chains.pop(req.request_id, None)
+        # Reverse order: plain pages reuse LIFO (like the base class) and
+        # cached pages enter the LRU leaf-first, so eviction consumes the
+        # chain tail before the (more shareable) root.
+        for p in reversed(pages):
+            refs = self._refs.get(p, 1) - 1
+            if refs > 0:
+                self._refs[p] = refs
+                continue
+            self._refs.pop(p, None)
+            if p in self._page_key:
+                self._lru[p] = None  # ref was live, so p cannot be in _lru
+            else:
+                self._free.append(p)
+        req.page_ids = []
+
+    # ---- prefix-cache surface (scheduler-facing) ----
+    def _chain(self, req: Request, upto_pages: int) -> list[bytes]:
+        """The request's page hash chain, memoized and extended on
+        demand (each page hashed at most once per request lifetime)."""
+        keys = self._chains.setdefault(req.request_id, [])
+        if len(keys) < upto_pages:
+            ids = req.all_token_ids
+            ps = self.page_size
+            parent = keys[-1] if keys else b""
+            for i in range(len(keys), upto_pages):
+                parent = hash_page_tokens(parent, ids[i * ps : (i + 1) * ps])
+                keys.append(parent)
+        return keys
+
+    def query_prefix(self, req: Request) -> tuple[int, list[int]]:
+        """Longest registered page chain matching the request's tokens.
+        Returns (num_cached_tokens, pages) without changing ownership.
+        The hit always stops strictly below prefill_target at a page
+        boundary: at least one token must be recomputed (the final step
+        has to produce logits to sample from), and capping at the page
+        boundary keeps every write of that recompute inside freshly
+        allocated pages — shared pages are NEVER written, so a sharer's
+        attention can't be perturbed by another request's prefill (XLA
+        does not promise bit-identical KV across chunk shapes).  Partial
+        pages never match: only full pages are ever registered."""
+        prefill_target = req.prefill_target
+        max_pages = min(req.num_tokens, prefill_target) // self.page_size
+        keys = self._chain(req, max_pages)
+        pages: list[int] = []
+        for i in range(max_pages):
+            page = self._hash_to_page.get(keys[i])
+            if page is None:
+                break
+            pages.append(page)
+        if pages and len(pages) * self.page_size >= prefill_target:
+            pages.pop()  # fully cached prompt: recompute the whole tail page
+        if not pages:
+            return 0, []
+        return len(pages) * self.page_size, pages
+
+    def attach_prefix(self, req: Request, hit_pages: list[int]) -> None:
+        """Adopt a queried page chain as the request's first pages
+        (ref-counted; cached-free pages leave the LRU).  Must be the
+        request's first allocation."""
+        owned = self._allocated.setdefault(req.request_id, [])
+        assert not owned, "attach_prefix after allocate"
+        for p in hit_pages:
+            self._lru.pop(p, None)
+            self._refs[p] = self._refs.get(p, 0) + 1
+        owned.extend(hit_pages)
+        req.page_ids = owned
+        # Registration resumes after the attached chain.
+        self._reg[req.request_id] = len(hit_pages)
+
+    def register_computed(self, req: Request) -> None:
+        """Register every newly FULL page whose tokens are now computed
+        (call after num_computed_tokens advances).  Content that is
+        already registered under another page is skipped — first writer
+        wins, the duplicate page stays plain."""
+        rid = req.request_id
+        n_reg = self._reg.get(rid, 0)
+        ps = self.page_size
+        # num_computed_tokens can overrun the host token list when an
+        # early stop discards the tail of a fused-decode dispatch; only
+        # pages whose tokens all exist are hashable.
+        full = min(req.num_computed_tokens, req.num_tokens) // ps
+        if full <= n_reg:
+            return
+        pages = self._allocated.get(rid, [])
+        keys = self._chain(req, full)
+        while n_reg < full and n_reg < len(pages):
+            key, page = keys[n_reg], pages[n_reg]
+            if key not in self._hash_to_page and page not in self._page_key:
+                self._hash_to_page[key] = page
+                self._page_key[page] = key
+            n_reg += 1
+        self._reg[rid] = n_reg

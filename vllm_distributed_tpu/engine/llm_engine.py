@@ -57,6 +57,7 @@ class LLMEngine:
             enabled=config.observability_config.collect_metrics,
         )
         self._preemptions_seen = 0
+        self._prefix_cache_seen = (0, 0)  # (queries, hits) already recorded
 
         self.tokenizer = None
         if not config.model_config.skip_tokenizer_init:
@@ -250,6 +251,16 @@ class LLMEngine:
             self.scheduler.num_preemptions - self._preemptions_seen
         )
         self._preemptions_seen = self.scheduler.num_preemptions
+        pc = (
+            self.scheduler.prefix_cache_queries,
+            self.scheduler.prefix_cache_hits,
+        )
+        self.metrics.record_prefix_cache(
+            pc[0] - self._prefix_cache_seen[0],
+            pc[1] - self._prefix_cache_seen[1],
+        )
+        self._prefix_cache_seen = pc
+        self.metrics.record_kv_cache_usage(self.scheduler.kv_cache_usage)
 
         outputs: list[RequestOutput] = []
         for req_id in scheduler_output.num_scheduled_tokens:

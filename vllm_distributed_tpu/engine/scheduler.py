@@ -27,6 +27,7 @@ from vllm_distributed_tpu.config import CacheConfig, SchedulerConfig
 from vllm_distributed_tpu.engine.block_manager import (
     NoFreePagesError,
     PageAllocator,
+    PrefixCachingAllocator,
 )
 from vllm_distributed_tpu.engine.request import Request, RequestStatus
 from vllm_distributed_tpu.logger import init_logger
@@ -88,7 +89,16 @@ class Scheduler:
     ) -> None:
         self.config = scheduler_config
         self.page_size = cache_config.page_size
-        self.allocator = PageAllocator(num_pages, cache_config.page_size)
+        # Prefix caching swaps the allocator behind the same interface;
+        # with the flag off the seed allocator (and behaviour) is
+        # untouched.
+        self.enable_prefix_caching = cache_config.enable_prefix_caching
+        alloc_cls = (
+            PrefixCachingAllocator
+            if self.enable_prefix_caching
+            else PageAllocator
+        )
+        self.allocator = alloc_cls(num_pages, cache_config.page_size)
         self.waiting: deque[Request] = deque()
         self.running: list[Request] = []
         self.requests: dict[str, Request] = {}
@@ -97,6 +107,10 @@ class Scheduler:
         self._finished_since_last: list[str] = []
         # Cumulative preemption count (metrics, SURVEY.md §5.5).
         self.num_preemptions = 0
+        # Cumulative prefix-cache token counters (metrics): tokens
+        # eligible for lookup at admission vs tokens served from cache.
+        self.prefix_cache_queries = 0
+        self.prefix_cache_hits = 0
 
     # ---- intake ----
     def add_request(self, req: Request) -> None:
@@ -148,6 +162,13 @@ class Scheduler:
     @property
     def num_unfinished(self) -> int:
         return len(self.waiting) + len(self.running)
+
+    @property
+    def kv_cache_usage(self) -> float:
+        """Fraction of usable KV pages held by live requests (cached
+        pages awaiting reuse count as free — they are evictable)."""
+        usable = self.allocator.num_pages - 1  # page 0 reserved
+        return 1.0 - self.allocator.num_free_pages / max(usable, 1)
 
     def has_unfinished_requests(self) -> bool:
         return self.num_unfinished > 0
@@ -249,7 +270,21 @@ class Scheduler:
             req = self.waiting[0]
             if req.request_id in preempted:
                 break  # do not resume a request preempted this same step
-            remaining_prompt = req.prefill_target - req.num_computed_tokens
+            # Prefix cache: a request without pages resumes after the
+            # longest cached page chain matching its tokens (pure query;
+            # state changes only on actual admission below).  Covers
+            # preemption-resume too — content addressing makes a
+            # request's own earlier pages an ordinary hit.
+            hit_tokens, hit_pages = 0, []
+            if (
+                self.enable_prefix_caching
+                and req.num_computed_tokens == 0
+                and not req.page_ids
+            ):
+                hit_tokens, hit_pages = self.allocator.query_prefix(req)
+            remaining_prompt = (
+                req.prefill_target - req.num_computed_tokens - hit_tokens
+            )
             num_new = min(remaining_prompt, token_budget)
             if num_new <= 0:
                 break
@@ -258,9 +293,24 @@ class Scheduler:
                     break
                 num_new = remaining_prompt
             # Admission: don't preempt running requests for new ones.
-            if not self.allocator.can_allocate(req, num_new):
+            if hit_pages:
+                ok = self.allocator.can_allocate_with_prefix(
+                    hit_pages, hit_tokens + num_new
+                )
+            else:
+                ok = self.allocator.can_allocate(req, num_new)
+            if not ok:
                 break
             self.waiting.popleft()
+            if self.enable_prefix_caching:
+                self.prefix_cache_queries += req.prefill_target
+                self.prefix_cache_hits += hit_tokens
+                req.metrics.cached_tokens = hit_tokens
+                if hit_pages:
+                    self.allocator.attach_prefix(req, hit_pages)
+                    # The chunked-prefill path resumes from here, so the
+                    # model runner gets the partial prefill for free.
+                    req.num_computed_tokens = hit_tokens
             new_pages = self.allocator.allocate(req, num_new)
             if req.status == RequestStatus.WAITING:
                 import time as _time
@@ -366,6 +416,11 @@ class Scheduler:
                 if status is not None:
                     req.status = status
                     break
+            if self.enable_prefix_caching:
+                # Pages fully covered by computed tokens now hold valid
+                # KV: register them (before any free below, so a
+                # finishing request's pages enter the LRU registered).
+                self.allocator.register_computed(req)
             if req.status.is_finished:
                 self.running.remove(req)
                 self.allocator.free(req)

@@ -93,6 +93,21 @@ class EngineMetrics:
         self.preemptions = counter(
             "vllm:num_preemptions", "Requests preempted by the scheduler"
         )
+        self.prefix_cache_queries = counter(
+            "vllm:prefix_cache_queries",
+            "Tokens looked up in the prefix cache at (re-)admission "
+            "(includes preemption-resume lookups)",
+        )
+        self.prefix_cache_hits = counter(
+            "vllm:prefix_cache_hits",
+            "Tokens served from cached KV pages instead of prefill "
+            "(cross-request prefix reuse and preemption-resume recovery)",
+        )
+        self.kv_cache_usage = gauge(
+            "vllm:gpu_cache_usage_perc",  # vLLM's name, kept for dashboards
+            "Fraction of usable KV pages held by live requests "
+            "(evictable cached pages count as free)",
+        )
         self.ttft = histogram(
             "vllm:time_to_first_token_seconds",
             "Time from request arrival to first generated token",
@@ -108,9 +123,7 @@ class EngineMetrics:
             "Request end-to-end latency",
             _E2E_BUCKETS,
         )
-        from prometheus_client import Counter as _Counter
-
-        self._success = _Counter(
+        self._success = Counter(
             "vllm:request_success",
             "Finished requests by finish reason",
             ["model_name", "finished_reason"],
@@ -132,6 +145,18 @@ class EngineMetrics:
     def record_prompt_tokens(self, n: int) -> None:
         if self.enabled and n:
             self.prompt_tokens.inc(n)
+
+    def record_prefix_cache(self, queries: int, hits: int) -> None:
+        if not self.enabled:
+            return
+        if queries:
+            self.prefix_cache_queries.inc(queries)
+        if hits:
+            self.prefix_cache_hits.inc(hits)
+
+    def record_kv_cache_usage(self, frac: float) -> None:
+        if self.enabled:
+            self.kv_cache_usage.set(frac)
 
     def record_new_tokens(self, req_metrics, n: int, now: float | None = None) -> None:
         """n new tokens for one request: TTFT on the first, ITL after."""

@@ -68,6 +68,9 @@ class RequestMetrics:
     # Prompt tokens already reported to vllm:prompt_tokens (prefill
     # progress is counted per processed step, remainder at first token).
     prompt_tokens_counted: int = 0
+    # Tokens served from the prefix cache at the last admission
+    # (0 unless --enable-prefix-caching hit; RequestOutput-visible).
+    cached_tokens: int = 0
 
     @property
     def ttft(self) -> float | None:
