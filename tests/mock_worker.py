@@ -1,6 +1,20 @@
 """Accelerator-free mock worker for control-plane tests (SURVEY.md §4
 item 4: exercise executor topology, lifecycle ordering, reply-rank
-selection, env replication, and failure propagation without chips)."""
+selection, env replication, and failure propagation without chips).
+
+Fault hooks: ``inject_fault`` arms deterministic failures on NON-driver
+workers only (the worker lives in the agent process, so transport-level
+modes reach the agent's process-global FaultInjector installed when the
+agent runs with VDT_FAULT_INJECTION=1):
+
+- worker faults:    ``hang_execute``, ``die_in_execute`` — fire on the
+                    next execute_model/dispatch_model;
+- transport faults: ``drop_writes`` / ``blackhole_writes`` /
+                    ``corrupt_writes`` / ``delay_writes`` / ``hang_writes``
+                    — armed with a small ``after_writes`` budget so the
+                    arming RPC's own reply frame (and at most one
+                    concurrent pong) escapes before the fault engages.
+"""
 
 from __future__ import annotations
 
@@ -12,6 +26,14 @@ from vllm_distributed_tpu.outputs import ModelRunnerOutput
 
 # Simulated device time per fused dispatch in the two-phase protocol.
 MOCK_STEP_SECONDS = 0.3
+
+_TRANSPORT_FAULTS = {
+    "drop_writes": "drop",
+    "blackhole_writes": "blackhole",
+    "corrupt_writes": "corrupt",
+    "delay_writes": "delay",
+    "hang_writes": "hang",
+}
 
 
 class MockWorker:
@@ -29,9 +51,44 @@ class MockWorker:
         self.is_driver_worker = is_driver_worker
         self.calls: list[str] = []
         self._deferred: queue.Queue = queue.Queue()
+        self._fault: str | None = None
         # (event, step_id, monotonic time) — lets tests assert that
         # dispatch N+1 reached this worker before fetch N completed.
         self.timeline: list[tuple[str, int, float]] = []
+
+    # ---- fault injection ----
+    def inject_fault(
+        self, name: str, value: float = 1.0, after_writes: int = 1
+    ) -> str:
+        """Arm one fault on the remote worker; no-op on the driver (the
+        fault under test is always a REMOTE host misbehaving)."""
+        if self.is_driver_worker:
+            return "driver-noop"
+        if name in _TRANSPORT_FAULTS:
+            from vllm_distributed_tpu.distributed.rpc_transport import (
+                get_global_injector,
+            )
+
+            injector = get_global_injector()
+            assert injector is not None, (
+                "transport faults need the agent started with "
+                "VDT_FAULT_INJECTION=1"
+            )
+            injector.arm(
+                _TRANSPORT_FAULTS[name], value, after_writes=after_writes
+            )
+            return "armed"
+        assert name in ("hang_execute", "die_in_execute"), name
+        self._fault = name
+        return "armed"
+
+    def _maybe_fault(self) -> None:
+        fault, self._fault = self._fault, None
+        if fault == "hang_execute":
+            time.sleep(3600)  # wedged device program; agent proc is
+            # terminated by the test, the thread never outlives it
+        elif fault == "die_in_execute":
+            os._exit(17)  # crash mid-RPC: no goodbye, just EOF
 
     def init_device(self) -> None:
         self.calls.append("init_device")
@@ -47,6 +104,7 @@ class MockWorker:
         self.num_pages = num_pages
 
     def execute_model(self, scheduler_output) -> ModelRunnerOutput | None:
+        self._maybe_fault()
         if not self.is_driver_worker:
             return None
         out = ModelRunnerOutput()
@@ -56,6 +114,7 @@ class MockWorker:
 
     # ---- two-phase step (cross-RPC pipelining) ----
     def dispatch_model(self, scheduler_output) -> int:
+        self._maybe_fault()
         self.timeline.append(
             ("dispatch", scheduler_output.step_id, time.monotonic())
         )

@@ -1,0 +1,49 @@
+"""Control-plane code hygiene (ISSUE 2 satellite): the distributed/
+package is the layer whose job is failure DETECTION, so broad
+exception-swallowing there hides exactly the signals the fault-tolerance
+layer exists to surface.  This AST lint fails on any new
+``except Exception: pass`` / bare ``except: pass`` block in
+``vllm_distributed_tpu/distributed/`` — swallowed teardown errors must
+at least be logged at debug (see rpc_transport close()).
+"""
+
+import ast
+from pathlib import Path
+
+DISTRIBUTED = (
+    Path(__file__).resolve().parent.parent
+    / "vllm_distributed_tpu"
+    / "distributed"
+)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare `except:`
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in _BROAD for e in t.elts
+        )
+    return False
+
+
+def test_no_silent_broad_except_in_distributed():
+    offenders = []
+    for path in sorted(DISTRIBUTED.glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if len(node.body) == 1 and isinstance(node.body[0], ast.Pass):
+                offenders.append(f"{path.name}:{node.lineno}")
+    assert not offenders, (
+        "silent broad except blocks in distributed/ (log at debug "
+        f"instead of swallowing): {offenders}"
+    )

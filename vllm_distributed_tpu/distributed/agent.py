@@ -5,9 +5,14 @@ The per-host rebuild of the reference's remote-node agent
 (launch.py:543-632, SURVEY.md §2 C2), with the per-GPU process fan-out
 collapsed to one agent per TPU host (§2.5).  Behavior contract kept:
 
-- connect-retry every 10 s while unused (launch.py:583-586);
+- connect-retry with jittered exponential backoff while unused (the
+  reference retries on a fixed 10 s, launch.py:583-586; backoff avoids
+  thundering-herd redials when a large deployment's server restarts);
 - once a worker exists, any disconnect is fatal — exit(1) and let the
   supervisor restart the host (launch.py:579-581);
+- symmetric liveness: the driver heartbeats every agent, and a deployed
+  agent that stops hearing the driver fail-fasts too, so an orphaned
+  TPU host releases its devices instead of holding them forever;
 - the agent's ``print`` is exposed as an RPC param so the driver can log
   remotely (launch.py:556 — genuinely useful, kept);
 - GC pacing every 10 s on the event loop to bound pause times
@@ -21,7 +26,9 @@ import asyncio
 import concurrent.futures
 import gc
 import os
+import random
 import sys
+import time
 from typing import Any
 
 from vllm_distributed_tpu import envs
@@ -30,8 +37,16 @@ from vllm_distributed_tpu.utils import run_method
 
 logger = init_logger(__name__)
 
-RETRY_SECONDS = 10.0
+RETRY_BASE_SECONDS = 1.0
+RETRY_CAP_SECONDS = 30.0
 GC_INTERVAL_SECONDS = 10.0
+
+
+def reconnect_delay(attempt: int) -> float:
+    """Jittered exponential backoff: full cap ~30 s, never synchronized
+    across a fleet of agents redialing a restarted server."""
+    ceiling = min(RETRY_CAP_SECONDS, RETRY_BASE_SECONDS * 2**attempt)
+    return ceiling * random.uniform(0.5, 1.0)
 
 
 class WorkerHost:
@@ -81,15 +96,58 @@ async def _gc_pacer() -> None:
         gc.collect()
 
 
+async def server_silence_watchdog(hb: dict) -> None:
+    """Returns (normally) once the driver has been silent for more than
+    ``interval * (miss_threshold + 1)`` seconds while this host is
+    deployed — the caller treats that as fatal.  ``hb`` carries
+    ``last_contact`` (monotonic seconds, None until deployed); the
+    driver's heartbeat pings refresh it.  Env knobs are read lazily each
+    tick because the driver replicates them at create_worker time."""
+    while True:
+        interval = envs.VDT_HEARTBEAT_INTERVAL_SECONDS
+        threshold = envs.VDT_HEARTBEAT_MISS_THRESHOLD
+        if interval <= 0:  # liveness disabled deployment-wide
+            await asyncio.sleep(GC_INTERVAL_SECONDS)
+            continue
+        await asyncio.sleep(interval)
+        last = hb.get("last_contact")
+        if last is None:
+            continue  # not deployed yet
+        silent = time.monotonic() - last
+        if silent > interval * (threshold + 1):
+            logger.error(
+                "server silent for %.1fs (> %d×%.1fs heartbeat budget) "
+                "while deployed",
+                silent,
+                threshold + 1,
+                interval,
+            )
+            return
+
+
 async def agent_async_main(server_ip: str, port: int | None = None) -> None:
     from vllm_distributed_tpu.distributed.rpc_transport import (
+        FaultInjector,
         StreamRpcTransport,
         prepare_peer_readloop,
+        set_global_injector,
     )
 
     port = port or envs.VDT_SERVER_PORT
     state: dict[str, Any] = {"worker_host": None}
+    hb: dict[str, Any] = {"last_contact": None}
     gc_task = asyncio.ensure_future(_gc_pacer())
+
+    # Test harness hooks (inert in production): a process-global fault
+    # injector the mock-worker layer can arm over RPC, and a
+    # deterministic pre-dial delay.
+    injector = None
+    if os.environ.get("VDT_FAULT_INJECTION") == "1":
+        injector = FaultInjector()
+        set_global_injector(injector)
+    connect_delay = float(
+        os.environ.get("VDT_FAULT_CONNECT_DELAY_SECONDS", "0")
+    )
 
     info_cache: dict[str, Any] = {}
 
@@ -124,6 +182,12 @@ async def agent_async_main(server_ip: str, port: int | None = None) -> None:
             "platform": env_platform or info_cache["platform"],
         }
 
+    def ping(payload: Any = None) -> Any:
+        """Driver liveness probe: echoes the payload so the driver can
+        measure RTT, and refreshes the server-silence watchdog."""
+        hb["last_contact"] = time.monotonic()
+        return payload
+
     async def create_worker(
         config, rank, num_hosts, distributed_init_method, env, worker_cls
     ):
@@ -137,45 +201,75 @@ async def agent_async_main(server_ip: str, port: int | None = None) -> None:
             is_driver_worker=False,
         )
         state["worker_host"] = WorkerHost(worker)
+        # Deployed: arm the server-silence watchdog from "now".
+        hb["last_contact"] = time.monotonic()
         logger.info("worker created: host rank %d/%d", rank, num_hosts)
         return state["worker_host"]
 
     # Pre-warm the chip probe so the driver's host_info call answers
     # from cache instead of paying the cold jax import inline.
     warm_task = asyncio.ensure_future(host_info())
+    attempt = 0
     try:
+        if connect_delay > 0:
+            logger.info("fault: delaying connect by %.1fs", connect_delay)
+            await asyncio.sleep(connect_delay)
         while True:
             try:
                 reader, writer = await asyncio.open_connection(
                     server_ip, port
                 )
             except OSError as e:
+                delay = reconnect_delay(attempt)
+                attempt += 1
                 logger.info(
-                    "server %s:%d unreachable (%s); retry in %.0fs",
+                    "server %s:%d unreachable (%s); retry in %.1fs",
                     server_ip,
                     port,
                     e,
-                    RETRY_SECONDS,
+                    delay,
                 )
-                await asyncio.sleep(RETRY_SECONDS)
+                await asyncio.sleep(delay)
                 continue
-            transport = StreamRpcTransport(reader, writer)
+            attempt = 0
+            transport = StreamRpcTransport(reader, writer, injector=injector)
             peer, readloop = prepare_peer_readloop(transport, "server")
             peer.params["host_info"] = host_info
             peer.params["create_worker"] = create_worker
+            peer.params["ping"] = ping
             peer.params["print"] = print  # driver's remote console
             logger.info("connected to %s:%d; serving", server_ip, port)
+            readloop_task = asyncio.ensure_future(readloop())
+            watchdog_task = asyncio.ensure_future(
+                server_silence_watchdog(hb)
+            )
             try:
-                await readloop()
+                await asyncio.wait(
+                    {readloop_task, watchdog_task},
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if watchdog_task.done() and not readloop_task.done():
+                    # Server wedged: the socket is open but the driver's
+                    # heartbeats stopped.  Release this host's devices.
+                    logger.error(
+                        "driver heartbeats stopped while deployed — "
+                        "exiting to release TPU devices"
+                    )
+                    sys.exit(1)
+                await readloop_task
             except Exception as e:  # noqa: BLE001
                 logger.warning("connection lost: %s", e)
+            finally:
+                watchdog_task.cancel()
             if state["worker_host"] is not None:
                 # Fail-fast: this host was part of a live deployment.
                 logger.error(
                     "disconnected while deployed — exiting for restart"
                 )
                 sys.exit(1)
-            await asyncio.sleep(RETRY_SECONDS)
+            hb["last_contact"] = None
+            await asyncio.sleep(reconnect_delay(attempt))
+            attempt += 1
     finally:
         warm_task.cancel()
         gc_task.cancel()

@@ -1,0 +1,86 @@
+"""Structured per-host failure attribution for the control plane.
+
+The reference (and vLLM upstream) surface worker loss as an
+undifferentiated "engine dead"; a multi-host TPU deployment over DCN has
+strictly more ways to partially fail, so every kill path in the control
+plane produces a ``HostFailure`` naming WHICH host failed, in WHICH
+lifecycle phase, and WHY.  The record travels executor → engine →
+AsyncLLM → ``/health`` 503 body / ``vllm:engine_dead_info`` verbatim, so
+the operator's first signal already carries the attribution.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+# Lifecycle phases a host can fail in, in boot order.
+PHASE_CONNECT = "connect"      # dialing / connection lost
+PHASE_INIT = "init"            # remote worker creation / device init
+PHASE_EXECUTE = "execute"      # collective_rpc / execute_model
+PHASE_HEARTBEAT = "heartbeat"  # liveness probe missed
+
+
+@dataclass
+class HostFailure:
+    """One host's failure: who, where in the lifecycle, and the cause
+    chain.  ``host_rank == -1`` means no single host is attributable
+    (e.g. boot timed out with several agents missing)."""
+
+    host_rank: int
+    address: str
+    phase: str
+    message: str
+    cause: str = ""  # flattened exception chain, innermost last
+    timestamp: float = field(default_factory=time.time)
+
+    def describe(self) -> str:
+        where = (
+            f"host {self.host_rank}" if self.host_rank >= 0 else "deployment"
+        )
+        if self.address:
+            where += f" ({self.address})"
+        text = f"[{self.phase}] {where}: {self.message}"
+        if self.cause:
+            text += f" | cause: {self.cause}"
+        return text
+
+    def to_dict(self) -> dict:
+        return {
+            "host_rank": self.host_rank,
+            "address": self.address,
+            "phase": self.phase,
+            "message": self.message,
+            "cause": self.cause,
+            "timestamp": self.timestamp,
+        }
+
+    @classmethod
+    def from_exception(
+        cls,
+        host_rank: int,
+        address: str,
+        phase: str,
+        message: str,
+        exc: BaseException,
+    ) -> "HostFailure":
+        return cls(
+            host_rank=host_rank,
+            address=address,
+            phase=phase,
+            message=message,
+            cause=format_cause_chain(exc),
+        )
+
+
+def format_cause_chain(exc: BaseException, limit: int = 5) -> str:
+    """Flatten ``raise X from Y`` / implicit-context chains into one
+    line: ``TypeError('a') <- OSError('b')``, innermost cause last."""
+    parts: list[str] = []
+    seen: set[int] = set()
+    cur: BaseException | None = exc
+    while cur is not None and id(cur) not in seen and len(parts) < limit:
+        seen.add(id(cur))
+        parts.append(f"{type(cur).__name__}({str(cur)!r})")
+        cur = cur.__cause__ or cur.__context__
+    return " <- ".join(parts)

@@ -179,6 +179,7 @@ class RpcPeer:
         kwargs: dict,
         *,
         oneway: bool = False,
+        timeout: float | None = None,
     ):
         msg = {
             "type": "apply",
@@ -197,8 +198,28 @@ class RpcPeer:
             fut = self._make_pending(reply_id)
             if fut.done():
                 return await fut
-            await self._send(msg)
-            return await fut
+            if timeout is None:
+                await self._send(msg)
+                return await fut
+
+            async def send_and_wait():
+                # The deadline covers the SEND too: a peer that stops
+                # reading backs up the transport (drain blocks, the
+                # writer mutex queues everyone behind it) and must still
+                # count as a miss, not wedge the caller.
+                await self._send(msg)
+                return await fut
+
+            try:
+                return await asyncio.wait_for(send_and_wait(), timeout)
+            except asyncio.TimeoutError:
+                # Reclaim the pending slot: if the reply frame was lost
+                # (not merely late), nothing will ever resolve it, and
+                # repeated deadline-bounded calls (heartbeats) must not
+                # grow the pending map.  A late reply finding no slot is
+                # dropped by _handle_result.
+                self._pending.pop(reply_id, None)
+                raise
 
         return send_then_wait()
 
@@ -316,6 +337,20 @@ class RpcPeer:
     @property
     def killed(self) -> bool:
         return self._killed is not None
+
+    @property
+    def killed_reason(self) -> str | None:
+        return self._killed
+
+
+def apply_with_timeout(proxy: RpcProxy, timeout: float, *args, **kwargs):
+    """Invoke ``proxy(*args, **kwargs)`` with a deadline.  Unlike wrapping
+    the call in ``asyncio.wait_for`` from the outside, the peer's pending
+    slot is reclaimed on timeout, so lost reply frames cannot leak
+    futures (the heartbeat loop calls this every interval forever)."""
+    return proxy._peer._apply(
+        proxy._proxy_id, None, args, kwargs, timeout=timeout
+    )
 
 
 def _send_finalize(peer_ref, proxy_id: str) -> None:

@@ -1,4 +1,4 @@
-"""RPC transports + wire format.
+"""RPC transports + wire format + fault injection.
 
 Framing matches the reference's (rpc_reader.py:73-82, 117-125, 155-164):
 ``4-byte big-endian length | 1 type byte | payload`` where type 0 is a
@@ -11,20 +11,26 @@ Transports:
 - ``StreamRpcTransport``  — asyncio TCP, cloudpickle payloads: the
   cross-host path (reference RpcPickleStreamTransport,
   rpc_reader.py:146-181).
-- ``ConnectionRpcTransport`` — multiprocessing.Pipe with a reader thread:
-  the driver↔local-worker path (reference RpcConnectionTransport,
-  rpc_reader.py:184-206).
+- ``ConnectionRpcTransport`` — multiprocessing.Pipe; both directions run
+  on the default thread-pool executor so the event loop never blocks
+  (reference runs a dedicated read thread, rpc_reader.py:184-206).
 
 ``prepare_peer_readloop`` glues a transport to an RpcPeer with a
 mutex-serialized writer (rpc_reader.py:229-239) and returns
 (peer, readloop); the read loop ending (EOF/error) kills the peer — that
 is the disconnect-detection contract (SURVEY.md §5.3).
+
+``FaultInjector`` is the deterministic fault hook the injection test
+suite drives: a transport constructed with one consults it on every
+outbound frame and can drop, delay, corrupt, or hang writes on demand.
+Production transports carry no injector and pay only a None check.
 """
 
 from __future__ import annotations
 
 import asyncio
 import struct
+import threading
 from typing import Any
 
 import cloudpickle
@@ -37,6 +43,97 @@ logger = init_logger(__name__)
 _MSG = 0
 _BUF = 1
 _HEADER = struct.Struct(">IB")
+
+
+class FaultInjector:
+    """Deterministic outbound-frame faults for tests.
+
+    Arm one mode at a time; ``after_writes`` frames pass through first so
+    the arming RPC's own reply can escape before the fault engages:
+
+    - ``drop``       — swallow the next *value* frames, then disarm;
+    - ``blackhole``  — swallow every subsequent frame (one-way partition:
+                       the socket stays open, nothing arrives);
+    - ``corrupt``    — flip bytes in the next *value* frames (the reader
+                       side fails to unpickle and kills the connection);
+    - ``delay``      — sleep *value* seconds before each frame;
+    - ``hang``       — block every write forever (wedged sender).
+
+    State is lock-guarded: arming happens on worker threads while writes
+    run on the transport's event loop.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._mode: str | None = None
+        self._value: float = 0.0
+        self._skip = 0
+        self.frames_dropped = 0
+        self.frames_corrupted = 0
+
+    def arm(
+        self, mode: str, value: float = 0.0, after_writes: int = 0
+    ) -> None:
+        if mode not in ("drop", "blackhole", "corrupt", "delay", "hang"):
+            raise ValueError(f"unknown fault mode {mode!r}")
+        with self._lock:
+            self._mode = mode
+            self._value = value
+            self._skip = after_writes
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._mode = None
+
+    async def on_write(
+        self, kind: int, payload: bytes
+    ) -> tuple[int, bytes] | None:
+        """Apply the armed fault to one outbound frame.  Returns the
+        (possibly corrupted) frame, or None to drop it; may sleep."""
+        with self._lock:
+            mode = self._mode
+            if mode is None:
+                return kind, payload
+            if self._skip > 0:
+                self._skip -= 1
+                return kind, payload
+            if mode == "drop":
+                self._value -= 1
+                if self._value <= 0:
+                    self._mode = None
+                self.frames_dropped += 1
+                return None
+            if mode == "blackhole":
+                self.frames_dropped += 1
+                return None
+            if mode == "corrupt":
+                self._value -= 1
+                if self._value <= 0:
+                    self._mode = None
+                self.frames_corrupted += 1
+                return kind, bytes(b ^ 0xFF for b in payload)
+            delay = self._value
+        if mode == "delay":
+            await asyncio.sleep(delay)
+            return kind, payload
+        # hang: a wedged sender never completes this write.
+        await asyncio.Event().wait()
+        return None  # unreachable
+
+
+# Process-global injector so the mock-worker layer (which lives behind an
+# RPC boundary in the agent process) can arm faults on the agent's own
+# transport.  Installed only when VDT_FAULT_INJECTION=1.
+_global_injector: FaultInjector | None = None
+
+
+def set_global_injector(injector: FaultInjector | None) -> None:
+    global _global_injector
+    _global_injector = injector
+
+
+def get_global_injector() -> FaultInjector | None:
+    return _global_injector
 
 
 class RpcTransport:
@@ -55,9 +152,11 @@ class StreamRpcTransport(RpcTransport):
         self,
         reader: asyncio.StreamReader,
         writer: asyncio.StreamWriter,
+        injector: FaultInjector | None = None,
     ) -> None:
         self.reader = reader
         self.writer = writer
+        self.injector = injector
 
     async def read(self) -> tuple[int, bytes]:
         header = await self.reader.readexactly(_HEADER.size)
@@ -66,23 +165,32 @@ class StreamRpcTransport(RpcTransport):
         return kind, payload
 
     async def write(self, kind: int, payload: bytes) -> None:
+        if self.injector is not None:
+            frame = await self.injector.on_write(kind, payload)
+            if frame is None:
+                return
+            kind, payload = frame
         self.writer.write(_HEADER.pack(len(payload), kind) + payload)
         await self.writer.drain()
 
     def close(self) -> None:
         try:
             self.writer.close()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001 — teardown best-effort
+            logger.debug("stream transport close failed: %s", e)
 
 
 class ConnectionRpcTransport(RpcTransport):
-    """multiprocessing.Connection; reads run on the default thread-pool
-    executor so the event loop never blocks (reference runs a dedicated
-    read thread, rpc_reader.py:209-223)."""
+    """multiprocessing.Connection; reads AND writes run on the default
+    thread-pool executor so the event loop never blocks (reference runs a
+    dedicated read thread, rpc_reader.py:209-223; send_bytes can block on
+    a full pipe just like recv_bytes on an empty one)."""
 
-    def __init__(self, connection: Any) -> None:
+    def __init__(
+        self, connection: Any, injector: FaultInjector | None = None
+    ) -> None:
         self.connection = connection
+        self.injector = injector
 
     async def read(self) -> tuple[int, bytes]:
         loop = asyncio.get_running_loop()
@@ -91,13 +199,21 @@ class ConnectionRpcTransport(RpcTransport):
         return kind, data[1:]
 
     async def write(self, kind: int, payload: bytes) -> None:
-        self.connection.send_bytes(bytes([kind]) + payload)
+        if self.injector is not None:
+            frame = await self.injector.on_write(kind, payload)
+            if frame is None:
+                return
+            kind, payload = frame
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, self.connection.send_bytes, bytes([kind]) + payload
+        )
 
     def close(self) -> None:
         try:
             self.connection.close()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001 — teardown best-effort
+            logger.debug("pipe transport close failed: %s", e)
 
 
 def prepare_peer_readloop(

@@ -29,7 +29,13 @@ logger = init_logger(__name__)
 
 
 class EngineDeadError(RuntimeError):
-    pass
+    """The engine can no longer serve.  ``failure`` carries the
+    structured per-host attribution (HostFailure) when the death came
+    from the multihost control plane, None otherwise."""
+
+    def __init__(self, message: str, failure=None) -> None:
+        super().__init__(message)
+        self.failure = failure
 
 
 class AsyncLLM:
@@ -99,7 +105,7 @@ class AsyncLLM:
 
     async def _run_aux(self, fn, *args):
         if self._dead is not None:
-            raise EngineDeadError(str(self._dead))
+            raise self._dead_error()
         loop = asyncio.get_running_loop()
         self._loop = loop
         fut = loop.create_future()
@@ -107,7 +113,7 @@ class AsyncLLM:
         self._wake.set()
         if self._dead is not None and not fut.done():
             # Raced the engine death after its intake drain.
-            raise EngineDeadError(str(self._dead))
+            raise self._dead_error()
         return await fut
 
     def _to_request_queue(self, request_id: str, item) -> None:
@@ -126,6 +132,12 @@ class AsyncLLM:
         try:
             while not self._shutdown:
                 self._drain_intake()
+                if self.engine.errored:
+                    # An idle deployment with a dead executor must not
+                    # look healthy: heartbeat/disconnect failures are
+                    # surfaced here even when no request is in flight
+                    # (step() would never run to notice them).
+                    raise RuntimeError(self.engine._dead_message())
                 if not self.engine.has_unfinished_requests():
                     self._wake.wait(timeout=0.2)
                     self._wake.clear()
@@ -140,7 +152,7 @@ class AsyncLLM:
             self._dead = e
             if self._loop is not None:
                 self._loop.call_soon_threadsafe(
-                    self._fail_all_queues, EngineDeadError(str(e))
+                    self._fail_all_queues, self._dead_error()
                 )
             # Aux ops already queued (or racing the death) would await
             # forever — fail them too.
@@ -154,7 +166,7 @@ class AsyncLLM:
                         self._resolve_aux,
                         payload[2],
                         None,
-                        EngineDeadError(str(e)),
+                        self._dead_error(),
                     )
 
     def _dispatch_outputs(self, outputs: list[RequestOutput]) -> None:
@@ -167,6 +179,16 @@ class AsyncLLM:
         for q in self._queues.values():
             q.put_nowait(e)
 
+    def _dead_error(self) -> EngineDeadError:
+        """Typed death with the structured HostFailure attached (drain
+        contract: every in-flight/queued/new request gets THIS, never a
+        hang)."""
+        return EngineDeadError(
+            str(self._dead) if self._dead is not None
+            else self.engine._dead_message(),
+            failure=self.failure_info,
+        )
+
     # ---- EngineClient surface ----
     @property
     def is_running(self) -> bool:
@@ -174,11 +196,16 @@ class AsyncLLM:
 
     @property
     def errored(self) -> bool:
-        return self._dead is not None
+        return self._dead is not None or self.engine.errored
+
+    @property
+    def failure_info(self):
+        """Structured HostFailure from the control plane, if any."""
+        return getattr(self.engine, "failure_info", None)
 
     async def check_health(self) -> None:
-        if self._dead is not None:
-            raise EngineDeadError(str(self._dead))
+        if self._dead is not None or self.engine.errored:
+            raise self._dead_error()
 
     async def generate(
         self,
@@ -189,12 +216,16 @@ class AsyncLLM:
     ) -> AsyncIterator[RequestOutput]:
         """Feed a request and yield cumulative RequestOutputs until
         finished.  Cancellation (client disconnect) aborts the request."""
-        if self._dead is not None:
-            raise EngineDeadError(str(self._dead))
+        if self._dead is not None or self.engine.errored:
+            raise self._dead_error()
         self._loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
         self._queues[request_id] = q
         try:
+            if self._dead is not None:
+                # Raced the death after the check above: the fail-all
+                # sweep may have already run without seeing our queue.
+                raise self._dead_error()
             self._intake.put(
                 (
                     "add",

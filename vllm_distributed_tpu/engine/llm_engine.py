@@ -56,6 +56,9 @@ class LLMEngine:
             config.model_config.model,
             enabled=config.observability_config.collect_metrics,
         )
+        # Liveness instruments (host_up, heartbeat latency) are emitted
+        # from the executor's heartbeat loop.
+        self.executor.metrics = self.metrics
         self._preemptions_seen = 0
         self._prefix_cache_seen = (0, 0)  # (queries, hits) already recorded
 
@@ -67,6 +70,7 @@ class LLMEngine:
             )
         self.detokenizers: dict[str, IncrementalDetokenizer] = {}
         self._failed = False
+        self.failure_info = None  # HostFailure from the executor, if any
         self.executor.register_failure_callback(self._on_failure)
         # Pipelining: dispatched-but-unapplied fused-decode steps (at most
         # one between step() calls, two briefly within a call) — the
@@ -80,7 +84,25 @@ class LLMEngine:
 
     def _on_failure(self) -> None:
         self._failed = True
-        logger.error("executor reported failure; engine is dead")
+        self.failure_info = getattr(self.executor, "failure_info", None)
+        detail = (
+            f": {self.failure_info.describe()}"
+            if self.failure_info is not None
+            else ""
+        )
+        logger.error("executor reported failure; engine is dead%s", detail)
+        self.metrics.record_engine_dead(self.failure_info)
+
+    @property
+    def errored(self) -> bool:
+        """Executor failure observed — the next step() (or the AsyncLLM
+        loop's idle check) turns this into engine death."""
+        return self._failed
+
+    def _dead_message(self) -> str:
+        if self.failure_info is not None:
+            return f"Engine executor failed: {self.failure_info.describe()}"
+        return "Engine executor failed."
 
     # ---- intake ----
     def add_request(
@@ -210,7 +232,7 @@ class LLMEngine:
 
     def step(self) -> list[RequestOutput]:
         if self._failed:
-            raise RuntimeError("Engine executor failed.")
+            raise RuntimeError(self._dead_message())
         outputs: list[RequestOutput] = []
         outputs.extend(self._finalize_done())
         if self._pending and not self._pipeline_safe():

@@ -96,6 +96,27 @@ def _error(message: str, status: int = 400) -> web.Response:
     )
 
 
+def _engine_dead_response(e: EngineDeadError) -> web.Response:
+    """Degraded-mode rejection: 503 (not 500 — the deployment supervisor
+    is restarting the backend, the request is retryable elsewhere/later)
+    with Retry-After and the structured per-host attribution."""
+    body = ErrorResponse(message=str(e), code=503).model_dump()
+    failure = getattr(e, "failure", None)
+    if failure is not None:
+        body["failure"] = failure.to_dict()
+    return web.json_response(
+        body,
+        status=503,
+        headers={"Retry-After": str(envs.VDT_RETRY_AFTER_SECONDS)},
+    )
+
+
+def _request_error(e: Exception) -> web.Response:
+    if isinstance(e, EngineDeadError):
+        return _engine_dead_response(e)
+    return _error(str(e), 400)
+
+
 def _apply_chat_template(state: ServerState, req: ChatCompletionRequest) -> str:
     tokenizer = state.engine.tokenizer
     conversation = [
@@ -163,7 +184,17 @@ async def health(request: web.Request) -> web.Response:
     try:
         await state.engine.check_health()
     except EngineDeadError as e:
-        return web.json_response({"status": "dead", "error": str(e)}, status=503)
+        body = {"status": "dead", "error": str(e)}
+        failure = getattr(e, "failure", None)
+        if failure is not None:
+            # Per-host attribution verbatim from the control plane:
+            # which host, which lifecycle phase, and the cause chain.
+            body["failure"] = failure.to_dict()
+        return web.json_response(
+            body,
+            status=503,
+            headers={"Retry-After": str(envs.VDT_RETRY_AFTER_SECONDS)},
+        )
     return web.Response(status=200)
 
 
@@ -246,7 +277,7 @@ async def chat_completions(request: web.Request) -> web.Response:
             )
         )
     except (EngineDeadError, ValueError) as e:
-        return _error(str(e), 500 if isinstance(e, EngineDeadError) else 400)
+        return _request_error(e)
 
     choices = []
     usage = UsageInfo()
@@ -454,7 +485,7 @@ async def completions(request: web.Request) -> web.Response:
     try:
         outs = await asyncio.gather(*gens)
     except (EngineDeadError, ValueError) as e:
-        return _error(str(e), 500 if isinstance(e, EngineDeadError) else 400)
+        return _request_error(e)
 
     choices = []
     usage = UsageInfo()
@@ -480,7 +511,7 @@ async def completions(request: web.Request) -> web.Response:
                         )
                     prompt_lps = score_cache[key]
                 except EngineDeadError as e:
-                    return _error(str(e), 500)
+                    return _engine_dead_response(e)
                 lp_dict = {
                     "tokens": [str(t) for t in out.prompt_token_ids]
                     + lp_dict["tokens"],
@@ -646,7 +677,7 @@ async def embeddings(request: web.Request) -> web.Response:
             *(state.engine.embed(ids) for ids in items)
         )
     except EngineDeadError as e:
-        return _error(str(e), 500)
+        return _engine_dead_response(e)
     if req.encoding_format == "base64":
         import base64
         import struct

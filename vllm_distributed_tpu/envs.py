@@ -27,6 +27,30 @@ environment_variables: dict[str, Callable[[], Any]] = {
     "VDT_HEALTH_CHECK_TIMEOUT_SECONDS": lambda: int(
         os.environ.get("VDT_HEALTH_CHECK_TIMEOUT_SECONDS", "10")
     ),
+    # Heartbeat liveness: the driver pings every remote agent on this
+    # interval (seconds, float; 0 disables); VDT_HEARTBEAT_MISS_THRESHOLD
+    # consecutive misses mark the host dead without waiting for a request
+    # to hit the execute timeout.  Replicated to agents, which run the
+    # symmetric watchdog and fail-fast when the server goes silent.
+    "VDT_HEARTBEAT_INTERVAL_SECONDS": lambda: float(
+        os.environ.get("VDT_HEARTBEAT_INTERVAL_SECONDS", "10")
+    ),
+    "VDT_HEARTBEAT_MISS_THRESHOLD": lambda: int(
+        os.environ.get("VDT_HEARTBEAT_MISS_THRESHOLD", "3")
+    ),
+    # Boot deadlines: how long the driver waits for all agents to dial in
+    # (0 = forever), and for remote worker creation.
+    "VDT_CONNECT_TIMEOUT_SECONDS": lambda: float(
+        os.environ.get("VDT_CONNECT_TIMEOUT_SECONDS", "600")
+    ),
+    "VDT_INIT_TIMEOUT_SECONDS": lambda: float(
+        os.environ.get("VDT_INIT_TIMEOUT_SECONDS", "120")
+    ),
+    # Retry-After hint (seconds) on 503s while the engine is dead and the
+    # supervisor is reforming the deployment.
+    "VDT_RETRY_AFTER_SECONDS": lambda: int(
+        os.environ.get("VDT_RETRY_AFTER_SECONDS", "30")
+    ),
     # --- engine ---
     "VDT_LOG_LEVEL": lambda: os.environ.get("VDT_LOG_LEVEL", "INFO"),
     "VDT_COMPILE_CACHE_DIR": lambda: os.environ.get(

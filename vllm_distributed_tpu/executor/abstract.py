@@ -15,6 +15,7 @@ import concurrent.futures
 from typing import Any, Callable
 
 from vllm_distributed_tpu.config import EngineConfig
+from vllm_distributed_tpu.distributed.failure import HostFailure
 from vllm_distributed_tpu.engine.scheduler import SchedulerOutput
 from vllm_distributed_tpu.outputs import ModelRunnerOutput
 
@@ -31,7 +32,14 @@ class Executor:
         self.parallel_config = config.parallel_config
         self.scheduler_config = config.scheduler_config
         self.is_failed = False
+        # First HostFailure recorded wins: later kill-path echoes of the
+        # same incident must not overwrite the root attribution.
+        self.failure_info: HostFailure | None = None
         self.failure_callback: FailureCallback | None = None
+        # EngineMetrics hook, set by LLMEngine after boot; executors that
+        # emit liveness metrics (heartbeat latency, host_up) must
+        # None-check it — heartbeats start before the engine exists.
+        self.metrics = None
         self._init_executor()
 
     # ---- to implement ----
@@ -152,7 +160,9 @@ class Executor:
         else:
             self.failure_callback = callback
 
-    def _notify_failure(self) -> None:
+    def _notify_failure(self, failure: HostFailure | None = None) -> None:
+        if failure is not None and self.failure_info is None:
+            self.failure_info = failure
         self.is_failed = True
         cb, self.failure_callback = self.failure_callback, None
         if cb is not None:

@@ -20,7 +20,15 @@ one worker slot per host, then drives init/load/execute via
 
 Failure contract (§3.5/§5.3): a lost agent after deployment kills the
 executor (fail-fast); engine learns via register_failure_callback; the
-supervisor (compose restart / systemd) reforms the deployment.
+supervisor (compose restart / systemd) reforms the deployment.  Every
+kill path produces a ``HostFailure`` naming the host and lifecycle phase
+(connect/init/execute/heartbeat); the FIRST one recorded is the root
+attribution surfaced on /health.  Liveness does not wait for traffic:
+the driver heartbeats every agent on VDT_HEARTBEAT_INTERVAL_SECONDS and
+VDT_HEARTBEAT_MISS_THRESHOLD consecutive misses trip failure even on an
+idle deployment (vLLM's engine only notices a dead worker when an
+in-flight execute exhausts its timeout; over DCN a wedged-but-connected
+host is a routine failure mode, not an exotic one).
 """
 
 from __future__ import annotations
@@ -35,7 +43,14 @@ from typing import Any
 
 from vllm_distributed_tpu import envs
 from vllm_distributed_tpu.config import EngineConfig
-from vllm_distributed_tpu.distributed.rpc import RpcProxy
+from vllm_distributed_tpu.distributed.failure import (
+    PHASE_CONNECT,
+    PHASE_EXECUTE,
+    PHASE_HEARTBEAT,
+    PHASE_INIT,
+    HostFailure,
+)
+from vllm_distributed_tpu.distributed.rpc import RpcProxy, apply_with_timeout
 from vllm_distributed_tpu.distributed.rpc_transport import (
     StreamRpcTransport,
     prepare_peer_readloop,
@@ -51,6 +66,10 @@ from vllm_distributed_tpu.utils import (
 
 logger = init_logger(__name__)
 
+# (host_rank, address) tag attached to every gathered future so timeouts
+# and errors are attributable to the offending host.
+_LOCAL_ORIGIN = (0, "local")
+
 
 @dataclass
 class RemoteHost:
@@ -59,6 +78,7 @@ class RemoteHost:
     worker: RpcProxy | None = None  # proxy to the remote WorkerHost
     in_use: bool = False
     address: str = ""
+    transport: Any = None  # closing it unblocks the read loop
 
 
 class MultiHostExecutor(Executor):
@@ -73,7 +93,11 @@ class MultiHostExecutor(Executor):
         self.num_hosts = pc.num_hosts
         self.port = envs.VDT_SERVER_PORT
         self.execute_timeout = envs.VDT_EXECUTE_MODEL_TIMEOUT_SECONDS
+        self.heartbeat_interval = envs.VDT_HEARTBEAT_INTERVAL_SECONDS
+        self.heartbeat_threshold = max(1, envs.VDT_HEARTBEAT_MISS_THRESHOLD)
         self._remote_hosts: list[RemoteHost] = []
+        self._heartbeat_tasks: list[concurrent.futures.Future] = []
+        self._creating_host: RemoteHost | None = None
         self._hosts_ready = concurrent.futures.Future()
         self._loop = asyncio.new_event_loop()
         self._loop_thread = threading.Thread(
@@ -110,7 +134,30 @@ class MultiHostExecutor(Executor):
             self.num_hosts - 1,
             self.port,
         )
-        self._hosts_ready.result()
+        try:
+            self._boot()
+        except Exception:
+            # A half-booted executor must not leak its loop thread, pools,
+            # or listening socket when the constructor raises.
+            self._teardown(drain_workers=False)
+            raise
+
+    def _boot(self) -> None:
+        connect_timeout = envs.VDT_CONNECT_TIMEOUT_SECONDS
+        try:
+            self._hosts_ready.result(timeout=connect_timeout or None)
+        except concurrent.futures.TimeoutError as e:
+            failure = HostFailure(
+                host_rank=-1,
+                address="",
+                phase=PHASE_CONNECT,
+                message=(
+                    f"only {len(self._remote_hosts)}/{self.num_hosts - 1} "
+                    f"agent(s) dialed in within {connect_timeout:.0f}s"
+                ),
+            )
+            self._notify_failure(failure)
+            raise RuntimeError(f"Executor failed: {failure.describe()}") from e
         logger.info("all %d hosts connected", self.num_hosts)
 
         # Build the local (host 0) worker in-process.
@@ -119,11 +166,32 @@ class MultiHostExecutor(Executor):
         # Create remote workers, then run the lifecycle: device init is
         # concurrent across hosts because jax.distributed.initialize
         # blocks until the whole world joins.
-        asyncio.run_coroutine_threadsafe(
-            self._create_remote_workers(), self._loop
-        ).result(timeout=120)
-        self.collective_rpc("init_device")
-        self.collective_rpc("load_model")
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self._create_remote_workers(), self._loop
+            ).result(timeout=envs.VDT_INIT_TIMEOUT_SECONDS or None)
+        except Exception as e:
+            host = self._creating_host
+            failure = HostFailure.from_exception(
+                host.host_rank if host is not None else -1,
+                host.address if host is not None else "",
+                PHASE_INIT,
+                "remote worker creation failed"
+                if not isinstance(e, concurrent.futures.TimeoutError)
+                else (
+                    "remote worker creation timed out after "
+                    f"{envs.VDT_INIT_TIMEOUT_SECONDS:.0f}s"
+                ),
+                e,
+            )
+            self._notify_failure(failure)
+            raise RuntimeError(f"Executor failed: {failure.describe()}") from e
+        # Liveness from here on: a host that wedges during device init,
+        # weight load, or an idle stretch is caught by heartbeats, not by
+        # an eventual request timeout.
+        self._start_heartbeats()
+        self.collective_rpc("init_device", _phase=PHASE_INIT)
+        self.collective_rpc("load_model", _phase=PHASE_INIT)
 
     # ---- topology ----
     def _make_local_worker(self):
@@ -195,6 +263,7 @@ class MultiHostExecutor(Executor):
                 host_rank=len(self._remote_hosts) + 1,
                 peer=peer,
                 address=str(addr),
+                transport=transport,
             )
             self._remote_hosts.append(host)
             logger.info(
@@ -210,14 +279,26 @@ class MultiHostExecutor(Executor):
             logger.warning("agent %s read loop ended: %s", addr, e)
         finally:
             if host is not None:
-                if host.in_use:
-                    # Deployment member lost: fail fast (launch.py:130-144).
-                    logger.error(
-                        "host rank %d (%s) lost — executor failed",
-                        host.host_rank,
-                        host.address,
+                if host.in_use and not getattr(
+                    self, "_shutting_down", False
+                ):
+                    # Deployment member lost: fail fast (launch.py:130-144)
+                    # with the host named.  First recorded failure wins,
+                    # so a heartbeat/execute attribution that triggered
+                    # this kill is preserved as the root cause.
+                    failure = HostFailure(
+                        host_rank=host.host_rank,
+                        address=host.address,
+                        phase=PHASE_CONNECT,
+                        message=(
+                            "connection to agent lost "
+                            f"({host.peer.killed_reason or 'EOF'})"
+                        ),
                     )
-                    self._notify_failure()
+                    logger.error("%s — executor failed", failure.describe())
+                    if self.metrics is not None:
+                        self.metrics.record_host_down(host.host_rank)
+                    self._notify_failure(failure)
                 elif host in self._remote_hosts:
                     self._remote_hosts.remove(host)
 
@@ -237,6 +318,10 @@ class MultiHostExecutor(Executor):
     async def _create_remote_workers(self) -> None:
         env = envs.replication_env()
         for host in self._remote_hosts:
+            # Left pointing at the failing host on exception: _boot reads
+            # it AFTER .result() re-raises, so no finally-clear here (it
+            # would wipe the attribution before the engine thread looks).
+            self._creating_host = host
             create_worker = await host.peer.get_param("create_worker")
             host.worker = await create_worker(
                 self.config,
@@ -247,6 +332,100 @@ class MultiHostExecutor(Executor):
                 self.worker_cls,
             )
             host.in_use = True
+        self._creating_host = None
+
+    # ---- liveness ----
+    def _start_heartbeats(self) -> None:
+        if self.heartbeat_interval <= 0:
+            return
+        for host in self._remote_hosts:
+            self._heartbeat_tasks.append(
+                asyncio.run_coroutine_threadsafe(
+                    self._heartbeat_loop(host), self._loop
+                )
+            )
+
+    async def _heartbeat_loop(self, host: RemoteHost) -> None:
+        """Ping one agent every interval; N consecutive misses mark the
+        host dead WITHOUT waiting for a request to hit the execute
+        timeout.  A miss is a deadline-bounded apply whose pending slot
+        is reclaimed (rpc.apply_with_timeout), so lost pongs never leak
+        futures no matter how long the deployment runs."""
+        interval = self.heartbeat_interval
+        threshold = self.heartbeat_threshold
+        try:
+            ping = await asyncio.wait_for(
+                host.peer.get_param("ping"), interval * threshold
+            )
+        except Exception as e:  # noqa: BLE001
+            if not host.peer.killed:
+                logger.warning(
+                    "host rank %d (%s): no ping param (%s); heartbeat "
+                    "liveness disabled for this host",
+                    host.host_rank,
+                    host.address,
+                    e,
+                )
+            return
+        misses = 0
+        seq = 0
+        while not host.peer.killed:
+            t0 = time.monotonic()
+            seq += 1
+            try:
+                await apply_with_timeout(ping, interval, seq)
+                misses = 0
+                if self.metrics is not None:
+                    self.metrics.record_heartbeat(
+                        host.host_rank, time.monotonic() - t0
+                    )
+            except asyncio.TimeoutError:
+                misses += 1
+                logger.warning(
+                    "host rank %d (%s): heartbeat miss %d/%d",
+                    host.host_rank,
+                    host.address,
+                    misses,
+                    threshold,
+                )
+            except Exception as e:  # noqa: BLE001
+                if host.peer.killed:
+                    return  # disconnect path owns this failure
+                misses += 1
+                logger.warning(
+                    "host rank %d (%s): heartbeat error %s — miss %d/%d",
+                    host.host_rank,
+                    host.address,
+                    e,
+                    misses,
+                    threshold,
+                )
+            if misses >= threshold:
+                failure = HostFailure(
+                    host_rank=host.host_rank,
+                    address=host.address,
+                    phase=PHASE_HEARTBEAT,
+                    message=(
+                        f"{misses} consecutive heartbeats missed "
+                        f"({interval:.1f}s interval)"
+                    ),
+                )
+                logger.error("%s — executor failed", failure.describe())
+                if self.metrics is not None:
+                    self.metrics.record_host_down(host.host_rank)
+                self._notify_failure(failure)
+                host.peer.kill(failure.describe())
+                if host.transport is not None:
+                    host.transport.close()  # unblock the read loop
+                return
+            await asyncio.sleep(
+                max(0.0, interval - (time.monotonic() - t0))
+            )
+
+    def _cancel_heartbeats(self) -> None:
+        tasks, self._heartbeat_tasks = self._heartbeat_tasks, []
+        for task in tasks:
+            task.cancel()
 
     # ---- dispatch ----
     def collective_rpc(
@@ -258,6 +437,7 @@ class MultiHostExecutor(Executor):
         unique_reply_rank: int | None = None,
         non_block: bool = False,
         timeout: float | None = None,
+        _phase: str = PHASE_EXECUTE,
     ) -> Any:
         if self.is_failed:
             raise RuntimeError("Executor failed.")
@@ -267,20 +447,23 @@ class MultiHostExecutor(Executor):
         local_fut = self._local_pool.submit(
             run_method, self._local_worker, method, args, kwargs
         )
+        live = [h for h in self._remote_hosts if h.worker is not None]
         remote_futs = [
             asyncio.run_coroutine_threadsafe(
                 host.worker.run(method, args, kwargs), self._loop
             )
-            for host in self._remote_hosts
-            if host.worker is not None
+            for host in live
         ]
         futures = [local_fut, *remote_futs]
+        origins = [_LOCAL_ORIGIN] + [(h.host_rank, h.address) for h in live]
 
         if non_block:
             return self._gather_pool.submit(
-                self._gather, futures, unique_reply_rank, timeout
+                self._gather, futures, origins, unique_reply_rank, timeout,
+                _phase,
             )
-        return self._gather(futures, unique_reply_rank, timeout)
+        return self._gather(futures, origins, unique_reply_rank, timeout,
+                            _phase)
 
     def execute_model(self, scheduler_output, non_block: bool = False):
         """Blocking path: one collective execute_model RPC.  Pipelined
@@ -307,13 +490,13 @@ class MultiHostExecutor(Executor):
             (scheduler_output,),
             {},
         )
+        live = [h for h in self._remote_hosts if h.worker is not None]
         remote_d = [
             asyncio.run_coroutine_threadsafe(
                 host.worker.run("dispatch_model", (scheduler_output,), {}),
                 self._loop,
             )
-            for host in self._remote_hosts
-            if host.worker is not None
+            for host in live
         ]
 
         def _local_fetch():
@@ -327,34 +510,65 @@ class MultiHostExecutor(Executor):
             asyncio.run_coroutine_threadsafe(
                 host.worker.run("fetch_results", (step_id,), {}), self._loop
             )
-            for host in self._remote_hosts
-            if host.worker is not None
+            for host in live
         ]
+        remote_origins = [(h.host_rank, h.address) for h in live]
         return self._gather_pool.submit(
             self._gather,
             [local_f, *remote_f, *remote_d],
+            [_LOCAL_ORIGIN, *remote_origins, *remote_origins],
             0,  # host 0 (local driver) holds the canonical output
             self.execute_timeout,
+            PHASE_EXECUTE,
         )
 
-    def _gather(self, futures, unique_reply_rank, timeout):
-        # One overall deadline, not timeout × num_hosts.
+    def _gather(self, futures, origins, unique_reply_rank, timeout, phase):
+        # One overall deadline, not timeout × num_hosts; a blown deadline
+        # or a failed reply is attributed to the offending host(s).
         deadline = (
             time.monotonic() + timeout if timeout is not None else None
         )
-        try:
-            results = [
-                f.result(
-                    timeout=None
-                    if deadline is None
-                    else max(deadline - time.monotonic(), 0.0)
+        results = []
+        for fut, (host_rank, address) in zip(futures, origins):
+            try:
+                results.append(
+                    fut.result(
+                        timeout=None
+                        if deadline is None
+                        else max(deadline - time.monotonic(), 0.0)
+                    )
                 )
-                for f in futures
-            ]
-        except Exception as e:  # noqa: BLE001
-            logger.error("collective_rpc failed: %s", e)
-            self._notify_failure()
-            raise RuntimeError("Executor failed.") from e
+            except concurrent.futures.TimeoutError as e:
+                laggards = [
+                    o for f, o in zip(futures, origins) if not f.done()
+                ]
+                names = ", ".join(
+                    f"rank {r} ({a})" for r, a in laggards
+                ) or f"rank {host_rank} ({address})"
+                first = laggards[0] if laggards else (host_rank, address)
+                failure = HostFailure(
+                    host_rank=first[0],
+                    address=first[1],
+                    phase=phase,
+                    message=(
+                        f"{method_desc(phase)} deadline ({timeout:.0f}s) "
+                        f"missed by: {names}"
+                    ),
+                )
+                logger.error("%s", failure.describe())
+                self._notify_failure(failure)
+                raise RuntimeError(
+                    f"Executor failed: {failure.describe()}"
+                ) from e
+            except Exception as e:  # noqa: BLE001
+                failure = HostFailure.from_exception(
+                    host_rank, address, phase, "collective reply failed", e
+                )
+                logger.error("collective_rpc failed: %s", failure.describe())
+                self._notify_failure(failure)
+                raise RuntimeError(
+                    f"Executor failed: {failure.describe()}"
+                ) from e
         if unique_reply_rank is not None:
             return results[unique_reply_rank]
         return results
@@ -367,27 +581,46 @@ class MultiHostExecutor(Executor):
     def num_reply_workers(self) -> int:
         return self.num_hosts
 
-    def _notify_failure(self) -> None:
+    def _notify_failure(self, failure: HostFailure | None = None) -> None:
         # Errors during an intentional shutdown are teardown noise, not
         # deployment failures — don't mark the engine dead for them.
         if getattr(self, "_shutting_down", False):
             return
-        super()._notify_failure()
+        super()._notify_failure(failure)
 
     def shutdown(self) -> None:
         self._shutting_down = True
-        # Clean jax.distributed teardown on every host BEFORE dropping
-        # the control plane (the shutdown barrier needs all tasks).
-        try:
-            self.collective_rpc("shutdown", timeout=15.0)
-        except Exception:  # noqa: BLE001 — failed/partial deployments
-            pass
+        self._teardown(drain_workers=True)
+
+    def _teardown(self, drain_workers: bool) -> None:
+        self._cancel_heartbeats()
+        if drain_workers:
+            # Clean jax.distributed teardown on every host BEFORE dropping
+            # the control plane (the shutdown barrier needs all tasks).
+            try:
+                self.collective_rpc("shutdown", timeout=15.0)
+            except Exception:  # noqa: BLE001 — failed/partial deployments
+                pass
         for host in self._remote_hosts:
             try:
                 host.peer.kill("executor shutdown")
-            except Exception:  # noqa: BLE001
-                pass
+                if host.transport is not None:
+                    # Stream writers belong to the executor loop; close
+                    # them there, before the stop() queued below.
+                    self._loop.call_soon_threadsafe(host.transport.close)
+            except Exception as e:  # noqa: BLE001
+                logger.debug("peer teardown failed: %s", e)
+        server = getattr(self, "_server", None)
+        if server is not None:
+            self._loop.call_soon_threadsafe(server.close)
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._local_pool.shutdown(wait=False)
         self._local_fetch_pool.shutdown(wait=False)
         self._gather_pool.shutdown(wait=False)
+
+
+def method_desc(phase: str) -> str:
+    return {
+        PHASE_INIT: "worker init collective",
+        PHASE_EXECUTE: "collective reply",
+    }.get(phase, "collective reply")
