@@ -27,6 +27,13 @@ _ITL_BUCKETS = (
 _E2E_BUCKETS = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 40.0, 80.0, 160.0,
 )
+# Heartbeat RTTs live on the control plane (DCN), not the data plane:
+# sub-millisecond on loopback, a few ms cross-host, anything near the
+# ping interval is a miss in the making.
+_HEARTBEAT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0,
+)
 
 
 class EngineMetrics:
@@ -129,6 +136,25 @@ class EngineMetrics:
             ["model_name", "finished_reason"],
             registry=self.registry,
         )
+        # ---- control-plane liveness ----
+        self._host_up = Gauge(
+            "vllm:host_up",
+            "1 while the host answers heartbeats, 0 once marked dead",
+            ["model_name", "host_rank"],
+            registry=self.registry,
+        )
+        self.heartbeat_latency = histogram(
+            "vllm:heartbeat_latency_seconds",
+            "Control-plane heartbeat round-trip time per remote host",
+            _HEARTBEAT_BUCKETS,
+        )
+        self._engine_dead = Gauge(
+            "vllm:engine_dead_info",
+            "1 when the engine is dead; labels carry the failure "
+            "attribution (lifecycle phase + offending host)",
+            ["model_name", "phase", "host_rank"],
+            registry=self.registry,
+        )
         self._model_name = model_name
 
     # ---- engine-loop hooks ----
@@ -181,6 +207,34 @@ class EngineMetrics:
             for _ in range(n_after_first):
                 self.itl.observe(per_tok)
         req_metrics.last_token_time = now
+
+    # ---- control-plane liveness hooks (called from the executor's
+    # heartbeat loop and the engine failure callback; every caller
+    # tolerates a disabled/None metrics object) ----
+    def record_heartbeat(self, host_rank: int, latency: float) -> None:
+        if not self.enabled:
+            return
+        self._host_up.labels(
+            model_name=self._model_name, host_rank=str(host_rank)
+        ).set(1)
+        self.heartbeat_latency.observe(latency)
+
+    def record_host_down(self, host_rank: int) -> None:
+        if not self.enabled:
+            return
+        self._host_up.labels(
+            model_name=self._model_name, host_rank=str(host_rank)
+        ).set(0)
+
+    def record_engine_dead(self, failure) -> None:
+        """`failure` is a HostFailure or None (non-control-plane death)."""
+        if not self.enabled:
+            return
+        phase = failure.phase if failure is not None else "unknown"
+        host = str(failure.host_rank) if failure is not None else ""
+        self._engine_dead.labels(
+            model_name=self._model_name, phase=phase, host_rank=host
+        ).set(1)
 
     def record_finished(self, req_metrics, reason: str | None) -> None:
         if not self.enabled:
