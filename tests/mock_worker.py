@@ -9,6 +9,15 @@ agent runs with VDT_FAULT_INJECTION=1):
 
 - worker faults:    ``hang_execute``, ``die_in_execute`` — fire on the
                     next execute_model/dispatch_model;
+
+Token modes: by default every step samples the constant 42 (topology
+tests only care that A token arrived).  With ``VDT_MOCK_TOKEN_SEQ=1``
+the worker instead samples a deterministic function of the request's
+absolute position — token i equals the total token count before it — so
+recovery/replay tests can assert bit-identical continuations: a request
+replayed as prompt+emitted-prefix continues with exactly the tokens an
+uninterrupted run would have produced, and any replay bug (dropped,
+duplicated, or restarted-from-scratch tokens) changes the sequence.
 - transport faults: ``drop_writes`` / ``blackhole_writes`` /
                     ``corrupt_writes`` / ``delay_writes`` / ``hang_writes``
                     — armed with a small ``after_writes`` budget so the
@@ -55,6 +64,15 @@ class MockWorker:
         # (event, step_id, monotonic time) — lets tests assert that
         # dispatch N+1 reached this worker before fetch N completed.
         self.timeline: list[tuple[str, int, float]] = []
+        # Deterministic position-based sampling (see module docstring).
+        self._seq_mode = os.environ.get("VDT_MOCK_TOKEN_SEQ") == "1"
+        # req_id -> {"total": tokens known, "computed": KV computed}.
+        self._seq_state: dict[str, dict[str, int]] = {}
+        # Simulated device time per blocking execute_model (recovery
+        # tests need a stream slow enough to kill mid-generation).
+        self._execute_sleep = float(
+            os.environ.get("VDT_MOCK_EXECUTE_SLEEP_SECONDS", "0")
+        )
 
     # ---- fault injection ----
     def inject_fault(
@@ -103,13 +121,47 @@ class MockWorker:
     def initialize_cache(self, num_pages: int) -> None:
         self.num_pages = num_pages
 
+    def _sample(self, scheduler_output) -> dict[str, list[int]]:
+        """One sampled token per scheduled request: constant 42, or the
+        request's absolute position under VDT_MOCK_TOKEN_SEQ=1."""
+        if not self._seq_mode:
+            return {
+                req_id: [42]
+                for req_id in scheduler_output.num_scheduled_tokens
+            }
+        for nr in scheduler_output.new_requests:
+            self._seq_state[nr.req_id] = {
+                "total": len(nr.prompt_token_ids),
+                "computed": nr.num_computed_tokens,
+            }
+        for req_id in (
+            scheduler_output.finished_req_ids
+            + scheduler_output.preempted_req_ids
+        ):
+            self._seq_state.pop(req_id, None)
+        sampled: dict[str, list[int]] = {}
+        for req_id, n in scheduler_output.num_scheduled_tokens.items():
+            st = self._seq_state.get(req_id)
+            if st is None:
+                continue
+            st["computed"] += n
+            if st["computed"] >= st["total"]:
+                # Prompt fully prefetched: sample.  The token IS the
+                # absolute position, so a replayed request (longer
+                # prompt, same total) continues the identical sequence.
+                sampled[req_id] = [st["total"]]
+                st["total"] += 1
+        return sampled
+
     def execute_model(self, scheduler_output) -> ModelRunnerOutput | None:
         self._maybe_fault()
+        if self._execute_sleep:
+            time.sleep(self._execute_sleep)
+        sampled = self._sample(scheduler_output)
         if not self.is_driver_worker:
             return None
         out = ModelRunnerOutput()
-        for req_id in scheduler_output.num_scheduled_tokens:
-            out.sampled_token_ids[req_id] = [42]
+        out.sampled_token_ids = sampled
         return out
 
     # ---- two-phase step (cross-RPC pipelining) ----
@@ -126,11 +178,11 @@ class MockWorker:
         assert so.step_id == step_id, (so.step_id, step_id)
         time.sleep(MOCK_STEP_SECONDS)  # pretend the device is busy
         self.timeline.append(("fetch_done", step_id, time.monotonic()))
+        sampled = self._sample(so)
         if not self.is_driver_worker:
             return None
         out = ModelRunnerOutput()
-        for req_id in so.num_scheduled_tokens:
-            out.sampled_token_ids[req_id] = [42]
+        out.sampled_token_ids = sampled
         return out
 
     def get_timeline(self) -> list[tuple[str, int, float]]:

@@ -27,6 +27,7 @@ import pytest
 from aiohttp.test_utils import TestClient, TestServer
 
 from tests.mock_worker import MockWorker  # noqa: F401 (import check)
+from tools.chaos_soak import RespawningAgent, run_soak
 from vllm_distributed_tpu.config import EngineArgs
 from vllm_distributed_tpu.distributed.agent import (
     reconnect_delay,
@@ -114,6 +115,30 @@ def _fault_env(monkeypatch, tmp_path, port):
     monkeypatch.setenv("VDT_HEARTBEAT_INTERVAL_SECONDS", str(HB_INTERVAL))
     monkeypatch.setenv("VDT_HEARTBEAT_MISS_THRESHOLD", str(HB_THRESHOLD))
     monkeypatch.setenv("VDT_COMPILE_CACHE_DIR", str(tmp_path / "cc"))
+    # These tests assert the TERMINAL death contract (drain + reject);
+    # disable the in-process supervisor so a HostFailure stays fatal.
+    # The recovery suite below re-enables it with its own knobs.
+    monkeypatch.setenv("VDT_MAX_ENGINE_RESTARTS", "0")
+
+
+def _recovery_env(monkeypatch, tmp_path, port):
+    """Supervised-recovery flavor: fast restart policy, deterministic
+    mock token sequences, and execute pacing slow enough to kill a
+    stream mid-generation."""
+    _fault_env(monkeypatch, tmp_path, port)
+    monkeypatch.setenv("VDT_MAX_ENGINE_RESTARTS", "3")
+    monkeypatch.setenv("VDT_ENGINE_RESTART_BACKOFF_SECONDS", "0.2")
+    monkeypatch.setenv("VDT_ENGINE_RESTART_BACKOFF_CAP_SECONDS", "2")
+    monkeypatch.setenv("VDT_CRASH_LOOP_WINDOW_SECONDS", "60")
+    monkeypatch.setenv("VDT_CONNECT_TIMEOUT_SECONDS", "30")
+    monkeypatch.setenv("VDT_MOCK_TOKEN_SEQ", "1")
+    monkeypatch.setenv("VDT_MOCK_EXECUTE_SLEEP_SECONDS", "0.05")
+
+
+RECOVERY_AGENT_ENV = {
+    "VDT_MOCK_TOKEN_SEQ": "1",
+    "VDT_MOCK_EXECUTE_SLEEP_SECONDS": "0.05",
+}
 
 
 def _engine_args(tmp_path, **kw):
@@ -572,3 +597,242 @@ def test_fault_injector_unit():
         assert await inj.on_write(0, b"back") == (0, b"back")
 
     asyncio.new_event_loop().run_until_complete(go())
+
+# ---------------------------------------------------------------------
+# supervised recovery (ISSUE 4): kill → RECOVERING → rebuild → replay
+# ---------------------------------------------------------------------
+@pytest.fixture
+def recovery_deployment(tmp_path, monkeypatch):
+    """AsyncLLM over the mocked multihost executor with the supervisor
+    armed and a compose-style agent respawner, so a killed host redials
+    and the deployment can re-form in-process."""
+    port = get_open_port()
+    _recovery_env(monkeypatch, tmp_path, port)
+    baseline = _vdt_threads()
+    agents = RespawningAgent(port, RECOVERY_AGENT_ENV, spawn=_spawn_agent)
+    engine = AsyncLLM.from_engine_args(
+        _engine_args(
+            tmp_path,
+            num_decode_steps=1,
+            max_model_len=512,
+            distributed_executor_backend=FaultMultiHostExecutor,
+        )
+    )
+    yield engine, agents, baseline
+    engine.shutdown()
+    agents.stop()
+
+
+def _metric_value(engine, name):
+    for line in engine.metrics.render().decode().splitlines():
+        if line.startswith(name + "{") or line.startswith(name + " "):
+            return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+def test_kill_mid_stream_recovers_and_replays(recovery_deployment):
+    """The tentpole contract end to end: kill the remote host while a
+    greedy stream is mid-generation → /health reports RECOVERING (503 +
+    Retry-After from the backoff schedule, body carries the originating
+    HostFailure), the respawned agent re-forms the deployment, and the
+    interrupted request completes with output bit-identical to an
+    uninterrupted run — the client stream never observes an error."""
+    engine, agents, baseline = recovery_deployment
+    sp = SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True)
+    prompt = [1, 2, 3]
+    # Mock seq mode: token i == absolute position, so an uninterrupted
+    # greedy run of 12 tokens from a 3-token prompt is exactly 3..14.
+    expected = list(range(3, 15))
+
+    async def go(client):
+        health_states = []
+
+        async def poll_health():
+            while True:
+                r = await client.get("/health")
+                body = {} if r.status == 200 else await r.json()
+                health_states.append(
+                    (r.status, body, r.headers.get("Retry-After"))
+                )
+                await asyncio.sleep(0.05)
+
+        poller = asyncio.create_task(poll_health())
+        tokens = []
+        killed = False
+        async for out in engine.generate(
+            "victim", prompt_token_ids=prompt, sampling_params=sp
+        ):
+            tokens = list(out.outputs[0].token_ids)
+            if not killed and len(tokens) >= 3:
+                agents.kill_current()
+                killed = True
+        poller.cancel()
+        assert killed
+        assert out.finished
+        # Replay determinism: bit-identical to the uninterrupted run.
+        assert tokens == expected, f"{tokens} != {expected}"
+        # The RECOVERING state was observable on /health mid-blip.
+        recovering = [
+            s for s in health_states
+            if s[0] == 503 and s[1].get("status") == "recovering"
+        ]
+        assert recovering, (
+            f"RECOVERING never observed on /health: {health_states}"
+        )
+        _, body, retry_after = recovering[0]
+        assert body["failure"]["host_rank"] == 1
+        assert body["failure"]["phase"] in (
+            "execute", "connect", "heartbeat"
+        )
+        # Retry-After derives from the backoff schedule (base 0.2s,
+        # cap 2s -> ceil in [1, 2]).
+        assert 1 <= int(retry_after) <= 2
+        # Recovered: healthy again.
+        r = await client.get("/health")
+        assert r.status == 200
+
+    _serve(engine, go)
+    assert engine.supervisor.restarts_total >= 1
+    assert _metric_value(engine, "vllm:engine_restarts_total") >= 1
+    assert _metric_value(engine, "vllm:requests_replayed_total") >= 1
+    # The dead-info gauge closed the incident (back to 0).
+    assert _metric_value(engine, "vllm:engine_dead_info") == 0
+    engine.shutdown()
+    _assert_no_new_vdt_threads(baseline)
+
+
+def test_restart_policy_exhaustion_goes_terminal(tmp_path, monkeypatch):
+    """Exceeding VDT_MAX_ENGINE_RESTARTS within the crash-loop window
+    lands in the pre-supervisor terminal state: typed EngineDeadError
+    with attribution, 503 dead (not recovering), new work rejected, and
+    no leaked threads (the PR 2 leak assertions)."""
+    port = get_open_port()
+    _recovery_env(monkeypatch, tmp_path, port)
+    monkeypatch.setenv("VDT_MAX_ENGINE_RESTARTS", "2")
+    # Nobody respawns the agent, so every rebuild times out fast.
+    monkeypatch.setenv("VDT_CONNECT_TIMEOUT_SECONDS", "1")
+    baseline = _vdt_threads()
+    agent = _spawn_agent(port, RECOVERY_AGENT_ENV)
+    engine = AsyncLLM.from_engine_args(
+        _engine_args(
+            tmp_path,
+            num_decode_steps=1,
+            max_model_len=512,
+            distributed_executor_backend=FaultMultiHostExecutor,
+        )
+    )
+    sp = SamplingParams(temperature=0.0, max_tokens=50, ignore_eos=True)
+
+    async def go(client):
+        outs = 0
+        with pytest.raises(EngineDeadError) as ei:
+            async for _ in engine.generate(
+                "victim", prompt_token_ids=[1, 2, 3], sampling_params=sp
+            ):
+                outs += 1
+                if outs == 2:
+                    agent.terminate()
+        assert outs >= 2
+        failure = ei.value.failure
+        assert failure is not None
+        assert failure.host_rank == 1
+        # Terminal, not recovering: /health says dead with attribution.
+        r = await client.get("/health")
+        assert r.status == 503
+        body = await r.json()
+        assert body["status"] == "dead"
+        assert body["failure"]["host_rank"] == 1
+        # New work: immediate typed rejection.
+        with pytest.raises(EngineDeadError):
+            async for _ in engine.generate(
+                "after", prompt_token_ids=[1], sampling_params=sp
+            ):
+                pass
+
+    try:
+        _serve(engine, go)
+        # Both restart attempts were spent before giving up.
+        assert engine.supervisor.restarts_total == 2
+        assert _metric_value(engine, "vllm:engine_restarts_total") == 2
+        engine.shutdown()
+        _assert_no_new_vdt_threads(baseline)
+    finally:
+        if agent.is_alive():
+            agent.terminate()
+        agent.join(timeout=5)
+
+
+def test_request_submitted_during_recovery_waits_and_completes(
+    recovery_deployment,
+):
+    """A request that arrives while the engine is RECOVERING queues in
+    the intake and is served by the rebuilt engine — accepted work waits
+    out the blip instead of failing."""
+    engine, agents, baseline = recovery_deployment
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+
+    async def go(client):
+        # Kill mid-stream, then immediately submit new work while the
+        # supervisor is still rebuilding.
+        first_tokens = []
+        late = None
+        killed = False
+        async for out in engine.generate(
+            "victim", prompt_token_ids=[1, 2, 3], sampling_params=sp
+        ):
+            first_tokens = list(out.outputs[0].token_ids)
+            if not killed and len(first_tokens) >= 2:
+                agents.kill_current()
+                killed = True
+                late = asyncio.create_task(
+                    _collect_gen(
+                        engine.generate(
+                            "late",
+                            prompt_token_ids=[7, 7, 7, 7],
+                            sampling_params=sp.clone(),
+                        )
+                    )
+                )
+        assert first_tokens == list(range(3, 9))
+        late_out = await asyncio.wait_for(late, timeout=30)
+        assert late_out.finished
+        # Position-deterministic: 4-token prompt -> tokens 4..9.
+        assert list(late_out.outputs[0].token_ids) == list(range(4, 10))
+
+    _serve(engine, go)
+    engine.shutdown()
+    _assert_no_new_vdt_threads(baseline)
+
+
+async def _collect_gen(gen):
+    last = None
+    async for out in gen:
+        last = out
+    return last
+
+
+# ---------------------------------------------------------------------
+# chaos soak (CI satellite): a 2-cycle smoke runs in the fault suite;
+# longer loops carry the `soak` marker and stay out of tier-1.
+# ---------------------------------------------------------------------
+def test_chaos_soak_smoke(tmp_path):
+    from vllm_distributed_tpu.testing import write_llama_config as _wlc
+
+    report = run_soak(
+        cycles=2, model_dir=_wlc(str(tmp_path / "soak-m"))
+    )
+    assert report["cycles"] == 2
+    assert report["replay_failures"] == 0
+    assert report["restarts_total"] >= 2
+    assert report["recovery_seconds"]["max"] > 0
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+def test_chaos_soak_long(tmp_path):
+    from vllm_distributed_tpu.testing import write_llama_config as _wlc
+
+    report = run_soak(
+        cycles=10, model_dir=_wlc(str(tmp_path / "soak-m"))
+    )
+    assert report["replay_failures"] == 0

@@ -1,0 +1,434 @@
+"""Unit + single-host engine tests for supervised recovery (ISSUE 4):
+restart-policy bounds, journal replay math and output splicing, the
+_run_aux death race (aux futures must never hang), and the
+stuck-engine-thread shutdown contract.  The multihost kill→recover
+end-to-end lives in tests/test_fault_injection.py."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from tests.utils import make_tiny_llama
+from vllm_distributed_tpu.config import EngineArgs
+from vllm_distributed_tpu.distributed.failure import (
+    PHASE_CONNECT,
+    PHASE_HEARTBEAT,
+    HostFailure,
+)
+from vllm_distributed_tpu.engine.async_llm import AsyncLLM, EngineDeadError
+from vllm_distributed_tpu.engine.supervisor import (
+    EngineSupervisor,
+    JournalEntry,
+    RestartPolicy,
+)
+from vllm_distributed_tpu.outputs import CompletionOutput, RequestOutput
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+# ---------------------------------------------------------------------
+# restart policy
+# ---------------------------------------------------------------------
+def test_backoff_schedule_is_exponential_and_capped():
+    policy = RestartPolicy(
+        max_restarts=5, backoff_base=1.0, backoff_cap=4.0, window=300
+    )
+    assert [policy.backoff(i) for i in range(5)] == [1, 2, 4, 4, 4]
+
+
+def test_can_recover_bounds_and_window():
+    policy = RestartPolicy(
+        max_restarts=2, backoff_base=0.1, backoff_cap=1.0, window=5.0
+    )
+    sup = EngineSupervisor(None, policy=policy)
+    failure = HostFailure(1, "('h', 1)", PHASE_HEARTBEAT, "missed")
+    assert sup.can_recover(failure)
+    # Non-control-plane deaths are never recovered.
+    assert not sup.can_recover(None)
+    # Attribution-free connect collapse: rebuild would just repeat it.
+    assert not sup.can_recover(
+        HostFailure(-1, "", PHASE_CONNECT, "0/3 agents")
+    )
+    # Budget spent within the window -> terminal.
+    now = time.monotonic()
+    sup._restart_times.extend([now, now])
+    assert not sup.can_recover(failure)
+    # Restarts older than the window are forgotten.
+    sup._restart_times.clear()
+    sup._restart_times.extend([now - 100.0, now - 99.0])
+    assert sup.can_recover(failure)
+
+
+def test_zero_max_restarts_disables_recovery():
+    policy = RestartPolicy(
+        max_restarts=0, backoff_base=1.0, backoff_cap=1.0, window=300
+    )
+    sup = EngineSupervisor(None, policy=policy)
+    assert not sup.can_recover(
+        HostFailure(1, "a", PHASE_HEARTBEAT, "missed")
+    )
+
+
+def test_retry_after_tracks_backoff():
+    policy = RestartPolicy(
+        max_restarts=3, backoff_base=0.2, backoff_cap=8.0, window=300
+    )
+    sup = EngineSupervisor(None, policy=policy)
+    assert sup.retry_after_seconds() == 1  # never below 1s
+    sup._current_backoff = 6.4
+    assert sup.retry_after_seconds() == 7
+
+
+# ---------------------------------------------------------------------
+# request journal: replay as synthetic preemption-resume
+# ---------------------------------------------------------------------
+def _entry(**kw):
+    defaults = dict(
+        request_id="r",
+        prompt=None,
+        prompt_token_ids=[1, 2, 3],
+        sampling_params=SamplingParams(
+            temperature=0.0, max_tokens=10, min_tokens=5, ignore_eos=True
+        ),
+    )
+    defaults.update(kw)
+    return JournalEntry(**defaults)
+
+
+def _out(req_id, token_ids, text="", prompt_ids=(1, 2, 3),
+         finished=False):
+    return RequestOutput(
+        request_id=req_id,
+        prompt=None,
+        prompt_token_ids=list(prompt_ids),
+        outputs=[
+            CompletionOutput(
+                index=0,
+                text=text,
+                token_ids=list(token_ids),
+                finish_reason="length" if finished else None,
+            )
+        ],
+        finished=finished,
+    )
+
+
+class _StubAsyncLLM:
+    """Just enough AsyncLLM surface for EngineSupervisor._replay."""
+
+    def __init__(self):
+        self._journal = {}
+        self.errors = []
+
+    def _to_request_queue(self, request_id, e):
+        self.errors.append((request_id, e))
+
+
+def _tiny_engine(tmp_path, name="m"):
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+
+    return LLMEngine(
+        EngineArgs(
+            model=make_tiny_llama(str(tmp_path / name)),
+            skip_tokenizer_init=True,
+            num_kv_pages=64,
+            max_model_len=128,
+            num_decode_steps=1,
+        ).create_engine_config()
+    )
+
+
+def test_replay_restores_output_state_not_prompt(tmp_path):
+    """Replay must preserve the prompt/output boundary: emitted tokens
+    re-enter as OUTPUT tokens via the preemption-resume path, so
+    penalties/stop/EOS/budget see the same request an uninterrupted
+    engine would."""
+    from vllm_distributed_tpu.engine.request import RequestStatus
+
+    engine = _tiny_engine(tmp_path)
+    try:
+        stub = _StubAsyncLLM()
+        sup = EngineSupervisor(
+            stub,
+            policy=RestartPolicy(
+                max_restarts=3, backoff_base=0.1, backoff_cap=1.0,
+                window=300,
+            ),
+        )
+        entry = _entry()
+        entry.admitted = True
+        entry.observe(_out("r", [10, 11, 12, 13]))
+        stub._journal["r"] = entry
+        assert sup._replay(engine) == 1
+        req = engine.scheduler.requests["r"]
+        assert req.prompt_token_ids == [1, 2, 3]  # original boundary
+        assert req.output_token_ids == [10, 11, 12, 13]
+        assert req.resume_target == 7  # re-prefill prompt + emitted
+        assert req.status == RequestStatus.PREEMPTED
+        # Budget untouched: 10 max_tokens, 4 already produced.
+        assert req.max_total_tokens == 13
+        assert entry.sampling_params.max_tokens == 10  # original intact
+        assert not stub.errors
+    finally:
+        engine.shutdown()
+
+
+def test_replay_skips_finished_and_unadmitted(tmp_path):
+    engine = _tiny_engine(tmp_path)
+    try:
+        stub = _StubAsyncLLM()
+        sup = EngineSupervisor(
+            stub,
+            policy=RestartPolicy(
+                max_restarts=3, backoff_base=0.1, backoff_cap=1.0,
+                window=300,
+            ),
+        )
+        done = _entry(request_id="done")
+        done.admitted = True
+        done.observe(_out("done", [10], finished=True))
+        pending = _entry(request_id="pending")  # add still in intake
+        stub._journal = {"done": done, "pending": pending}
+        assert sup._replay(engine) == 0
+        assert "done" not in engine.scheduler.requests
+        assert "pending" not in engine.scheduler.requests
+    finally:
+        engine.shutdown()
+
+
+def _drain_engine(engine, request_id):
+    tokens = None
+    while engine.has_unfinished_requests():
+        for out in engine.step():
+            if out.request_id == request_id:
+                tokens = list(out.outputs[0].token_ids)
+    return tokens
+
+
+def test_replay_equivalence_with_penalties(tmp_path):
+    """End-to-end determinism on the real tiny model WITH output-token
+    penalties — the case that breaks if replay folds emitted tokens
+    into the prompt: run greedy+penalties to completion (reference),
+    run a twin engine only partway ("host died"), replay the journal
+    onto a third engine, and require bit-identical final output."""
+    sp = SamplingParams(
+        temperature=0.0,
+        max_tokens=10,
+        ignore_eos=True,
+        repetition_penalty=1.3,
+        frequency_penalty=0.6,
+        presence_penalty=0.2,
+    )
+    prompt = [1, 5, 9]
+
+    ref_engine = _tiny_engine(tmp_path, "ref")
+    try:
+        ref_engine.add_request(
+            "x", prompt_token_ids=list(prompt), sampling_params=sp.clone()
+        )
+        reference = _drain_engine(ref_engine, "x")
+    finally:
+        ref_engine.shutdown()
+    assert reference is not None and len(reference) == 10
+
+    # Interrupted run: stop after ~4 tokens, as if the host died there.
+    cut_engine = _tiny_engine(tmp_path, "cut")
+    emitted = []
+    try:
+        cut_engine.add_request(
+            "x", prompt_token_ids=list(prompt), sampling_params=sp.clone()
+        )
+        while len(emitted) < 4:
+            for out in cut_engine.step():
+                emitted = list(out.outputs[0].token_ids)
+    finally:
+        cut_engine.shutdown()
+    assert reference[: len(emitted)] == emitted
+
+    new_engine = _tiny_engine(tmp_path, "new")
+    try:
+        stub = _StubAsyncLLM()
+        sup = EngineSupervisor(
+            stub,
+            policy=RestartPolicy(
+                max_restarts=3, backoff_base=0.1, backoff_cap=1.0,
+                window=300,
+            ),
+        )
+        entry = _entry(
+            prompt_token_ids=list(prompt), sampling_params=sp.clone()
+        )
+        entry.request_id = "x"
+        entry.admitted = True
+        entry.emitted_token_ids = list(emitted)
+        stub._journal["x"] = entry
+        assert sup._replay(new_engine) == 1
+        final = _drain_engine(new_engine, "x")
+    finally:
+        new_engine.shutdown()
+    assert final == reference, (final, reference)
+
+
+# ---------------------------------------------------------------------
+# aux death race + shutdown contract (engine-level, uniproc)
+# ---------------------------------------------------------------------
+@pytest.fixture()
+def engine(tmp_path):
+    eng = AsyncLLM.from_engine_args(
+        EngineArgs(
+            model=make_tiny_llama(str(tmp_path / "m")),
+            skip_tokenizer_init=True,
+            num_kv_pages=64,
+            max_model_len=128,
+        )
+    )
+    yield eng
+    eng.shutdown()
+
+
+async def _consume(agen):
+    out = None
+    async for item in agen:
+        out = item
+    return out
+
+
+def test_aux_after_death_raises_instead_of_hanging(engine):
+    """Satellite regression: an aux call that reaches a dead engine —
+    even one enqueued after the engine thread's post-death intake sweep
+    already ran — must resolve with a typed error, never hang."""
+
+    async def go():
+        # Non-control-plane failure (no HostFailure): the supervisor
+        # will not absorb it, so the death is terminal.
+        engine.engine.executor._notify_failure(None)
+        for _ in range(100):
+            if engine._dead is not None:
+                break
+            await asyncio.sleep(0.05)
+        assert engine._dead is not None
+        engine._thread.join(timeout=5)
+        assert not engine._thread.is_alive()
+        # The engine thread (and its sweep) are gone; this aux can only
+        # be resolved by the re-check / event-loop sweep.
+        with pytest.raises(EngineDeadError):
+            await asyncio.wait_for(engine.embed([1, 2, 3]), timeout=5)
+
+    asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_fail_all_queues_sweeps_intake_aux(engine):
+    """The event-loop sweep itself: an aux future sitting in the intake
+    when _fail_all_queues runs is failed, not orphaned."""
+
+    async def go():
+        loop = asyncio.get_running_loop()
+        engine._loop = loop
+        # Kill the engine first so its own drain can't race us for the
+        # queued aux — this models the exact satellite scenario: the
+        # enqueue lands after the engine thread's post-death sweep.
+        engine.engine.executor._notify_failure(None)
+        while engine._dead is None:
+            await asyncio.sleep(0.05)
+        engine._thread.join(timeout=5)
+        fut = loop.create_future()
+        engine._intake.put(("aux", (lambda: None, (), fut)))
+        engine._fail_all_queues(EngineDeadError("dead"))
+        with pytest.raises(EngineDeadError):
+            await asyncio.wait_for(fut, timeout=2)
+
+    asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_clean_shutdown_resolves_queued_aux(engine):
+    """An aux enqueued while the engine thread is mid-step when
+    shutdown lands is failed by the clean-shutdown sweep."""
+
+    async def go():
+        gate = threading.Event()
+        real_step = engine.engine.step
+
+        def blocking_step():
+            gate.wait(10)
+            return real_step()
+
+        engine.engine.step = blocking_step
+        sp = SamplingParams(
+            temperature=0.0, max_tokens=8, ignore_eos=True
+        )
+        task = asyncio.create_task(
+            _consume(
+                engine.generate(
+                    "a", prompt_token_ids=[1, 2], sampling_params=sp
+                )
+            )
+        )
+        await asyncio.sleep(0.3)  # engine thread is inside blocking_step
+        aux = asyncio.ensure_future(engine.embed([1, 2]))
+        await asyncio.sleep(0.05)
+        engine._shutdown = True
+        engine._wake.set()
+        gate.set()
+        with pytest.raises(EngineDeadError, match="shutting down"):
+            await asyncio.wait_for(aux, timeout=5)
+        task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, EngineDeadError):
+            pass
+
+    asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_shutdown_stuck_thread_skips_device_teardown(tmp_path):
+    """Satellite: a failed 5s join must not fall through into
+    engine.shutdown() and race the stuck thread for the device — it
+    logs the stuck phase and skips teardown."""
+    engine = AsyncLLM.from_engine_args(
+        EngineArgs(
+            model=make_tiny_llama(str(tmp_path / "m")),
+            skip_tokenizer_init=True,
+            num_kv_pages=64,
+            max_model_len=128,
+        )
+    )
+    engine.SHUTDOWN_JOIN_SECONDS = 0.5  # test-sized join budget
+    release = threading.Event()
+
+    def wedged_step():
+        release.wait(30)
+        return []
+
+    engine.engine.step = wedged_step
+    teardowns = []
+    real_engine_shutdown = engine.engine.shutdown
+    engine.engine.shutdown = lambda: teardowns.append(1)
+
+    async def go():
+        sp = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+        task = asyncio.create_task(
+            _consume(
+                engine.generate(
+                    "w", prompt_token_ids=[1, 2], sampling_params=sp
+                )
+            )
+        )
+        await asyncio.sleep(0.3)  # engine thread is now wedged in step
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+
+    asyncio.new_event_loop().run_until_complete(go())
+    t0 = time.monotonic()
+    engine.shutdown()
+    assert time.monotonic() - t0 < 5
+    assert teardowns == []  # device teardown skipped
+    assert engine._thread.is_alive()  # the wedge is real
+    assert engine._phase == "step"  # the warning names this phase
+    # Unwedge and clean up for real.
+    release.set()
+    engine._thread.join(timeout=5)
+    real_engine_shutdown()

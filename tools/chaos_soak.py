@@ -1,0 +1,286 @@
+"""Chaos soak for supervised engine recovery (ISSUE 4 CI satellite).
+
+Loops N kill→recover cycles against a loopback mock multi-host
+deployment: each cycle kills the remote agent mid-generation, a
+compose-style respawner restarts it, the in-process EngineSupervisor
+rebuilds the executor and replays the interrupted request, and the tool
+checks the stream completed with the exact greedy token sequence an
+uninterrupted run produces (the mock worker's VDT_MOCK_TOKEN_SEQ mode
+makes that falsifiable).  Reports recovery-latency percentiles and
+replay-correctness failures as one JSON line.
+
+Run from the repo root:
+
+    JAX_PLATFORMS=cpu python tools/chaos_soak.py --cycles 5
+
+A 2-cycle smoke runs inside the fault suite
+(tests/test_fault_injection.py::test_chaos_soak_smoke); longer loops
+carry the ``soak`` pytest marker and stay out of tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing
+import os
+import statistics
+import sys
+import tempfile
+import threading
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+AGENT_ENV = {
+    "VDT_ADVERTISE_NUM_CHIPS": "4",
+    "VDT_ADVERTISE_PLATFORM": "cpu",
+    "VDT_MOCK_TOKEN_SEQ": "1",
+    "VDT_MOCK_EXECUTE_SLEEP_SECONDS": "0.05",
+}
+
+
+def _agent_main(port: int, env: dict[str, str]) -> None:
+    for k, v in env.items():
+        os.environ[k] = v
+    from vllm_distributed_tpu.distributed.agent import remote_main
+
+    remote_main("127.0.0.1", port)
+
+
+def spawn_agent(port: int, extra_env: dict | None = None):
+    proc = multiprocessing.Process(
+        target=_agent_main,
+        args=(port, {**AGENT_ENV, **(extra_env or {})}),
+        daemon=True,
+    )
+    proc.start()
+    return proc
+
+
+class RespawningAgent:
+    """Compose-style supervisor for one mock agent process: whenever the
+    agent exits (killed by a cycle, or fail-fast after a driver-side
+    teardown), start a fresh one that redials — exactly the external
+    restart loop a real deployment's `restart: unless-stopped` runs."""
+
+    def __init__(self, port: int, extra_env: dict | None = None,
+                 spawn=spawn_agent):
+        self._port = port
+        self._env = extra_env
+        self._spawn = spawn
+        self._stop = threading.Event()
+        self.current = spawn(port, extra_env)
+        self.respawns = 0
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread.start()
+
+    def _watch(self) -> None:
+        while not self._stop.is_set():
+            self.current.join()
+            if self._stop.is_set():
+                return
+            time.sleep(0.1)
+            if self._stop.is_set():
+                return
+            self.current = self._spawn(self._port, self._env)
+            self.respawns += 1
+
+    def kill_current(self) -> None:
+        self.current.terminate()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self.current.is_alive():
+            self.current.terminate()
+        self._thread.join(timeout=10)
+        # The watcher may have respawned one last agent before it saw
+        # the stop flag; reap whatever is current now.
+        if self.current.is_alive():
+            self.current.terminate()
+        self.current.join(timeout=5)
+
+
+def _percentile(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
+    return ordered[idx]
+
+
+def run_soak(
+    cycles: int = 5,
+    *,
+    model_dir: str | None = None,
+    prompt: list[int] | None = None,
+    max_tokens: int = 14,
+    kill_after_tokens: int = 3,
+    hb_interval: float = 0.5,
+    backoff: float = 0.2,
+) -> dict:
+    """Run the kill→recover loop; returns the report dict.  Mutates (and
+    restores) os.environ — call from a dedicated process or a test that
+    tolerates env churn."""
+    import asyncio
+
+    from vllm_distributed_tpu.config import EngineArgs
+    from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+    from vllm_distributed_tpu.executor.multihost import MultiHostExecutor
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+    from vllm_distributed_tpu.testing import write_llama_config
+    from vllm_distributed_tpu.utils import get_open_port
+
+    class SoakExecutor(MultiHostExecutor):
+        worker_cls = "tests.mock_worker.MockWorker"
+
+    prompt = prompt or [1, 2, 3]
+    port = get_open_port()
+    env = {
+        "VDT_SERVER_PORT": str(port),
+        "VDT_HEARTBEAT_INTERVAL_SECONDS": str(hb_interval),
+        "VDT_HEARTBEAT_MISS_THRESHOLD": "3",
+        "VDT_EXECUTE_MODEL_TIMEOUT_SECONDS": "5",
+        "VDT_CONNECT_TIMEOUT_SECONDS": "30",
+        "VDT_MAX_ENGINE_RESTARTS": str(cycles + 2),
+        "VDT_ENGINE_RESTART_BACKOFF_SECONDS": str(backoff),
+        "VDT_ENGINE_RESTART_BACKOFF_CAP_SECONDS": "2",
+        # Generous window: the budget above covers every cycle anyway.
+        "VDT_CRASH_LOOP_WINDOW_SECONDS": "3600",
+        "VDT_MOCK_TOKEN_SEQ": "1",
+        "VDT_MOCK_EXECUTE_SLEEP_SECONDS": "0.05",
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    agents = None
+    engine = None
+    # The mock's deterministic sequence: token i = absolute position.
+    expected = list(range(len(prompt), len(prompt) + max_tokens))
+    sp = SamplingParams(
+        temperature=0.0, max_tokens=max_tokens, ignore_eos=True
+    )
+
+    async def one_cycle(idx: int, kill: bool):
+        tokens: list[int] = []
+        killed = False
+        last_arrival = time.monotonic()
+        worst_stall = 0.0
+        async for out in engine.generate(
+            f"soak-{idx}",
+            prompt_token_ids=list(prompt),
+            sampling_params=sp.clone(),
+        ):
+            now = time.monotonic()
+            if killed:
+                worst_stall = max(worst_stall, now - last_arrival)
+            last_arrival = now
+            tokens = list(out.outputs[0].token_ids)
+            if kill and not killed and len(tokens) >= kill_after_tokens:
+                agents.kill_current()
+                killed = True
+        return tokens, worst_stall
+
+    # A hung replay is exactly the failure class this harness hunts —
+    # bound each cycle so it reports instead of stalling CI forever.
+    cycle_timeout = 60.0
+
+    async def go():
+        latencies: list[float] = []
+        failures = 0
+        # Cycle 0: uninterrupted sanity run (also warms the deployment).
+        tokens, _ = await asyncio.wait_for(
+            one_cycle(-1, kill=False), timeout=cycle_timeout
+        )
+        if tokens != expected:
+            raise RuntimeError(
+                f"baseline run wrong: {tokens} != {expected}"
+            )
+        for i in range(cycles):
+            tokens, stall = await asyncio.wait_for(
+                one_cycle(i, kill=True), timeout=cycle_timeout
+            )
+            latencies.append(stall)
+            if tokens != expected:
+                failures += 1
+                print(
+                    f"cycle {i}: REPLAY MISMATCH {tokens} != {expected}",
+                    file=sys.stderr,
+                )
+        return latencies, failures
+
+    # Setup happens inside the try so a failed boot (port race, connect
+    # timeout) still reaps the respawner and restores the env — a leaked
+    # RespawningAgent would redial a dead port for the rest of the
+    # process, and the env mutations would bleed into later tests.
+    try:
+        if model_dir is None:
+            tmpdir = tempfile.mkdtemp(prefix="vdt_soak_")
+            model_dir = write_llama_config(os.path.join(tmpdir, "m"))
+        agents = RespawningAgent(port)
+        engine = AsyncLLM.from_engine_args(
+            EngineArgs(
+                model=model_dir,
+                skip_tokenizer_init=True,
+                load_format="dummy",
+                num_hosts=2,
+                num_decode_steps=1,
+                max_model_len=512,
+                distributed_executor_backend=SoakExecutor,
+            )
+        )
+        latencies, failures = (
+            asyncio.new_event_loop().run_until_complete(go())
+        )
+        return {
+            "cycles": cycles,
+            "replay_failures": failures,
+            "recovery_seconds": {
+                "p50": round(_percentile(latencies, 0.5), 3),
+                "p90": round(_percentile(latencies, 0.9), 3),
+                "max": round(max(latencies), 3) if latencies else 0.0,
+                "mean": (
+                    round(statistics.fmean(latencies), 3)
+                    if latencies else 0.0
+                ),
+            },
+            "restarts_total": engine.supervisor.restarts_total,
+            "agent_respawns": agents.respawns,
+        }
+    finally:
+        try:
+            if engine is not None:
+                engine.shutdown()
+        finally:
+            try:
+                if agents is not None:
+                    agents.stop()
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--cycles", type=int, default=5)
+    parser.add_argument("--max-tokens", type=int, default=14)
+    parser.add_argument("--kill-after-tokens", type=int, default=3)
+    parser.add_argument("--backoff", type=float, default=0.2)
+    args = parser.parse_args()
+    report = run_soak(
+        cycles=args.cycles,
+        max_tokens=args.max_tokens,
+        kill_after_tokens=args.kill_after_tokens,
+        backoff=args.backoff,
+    )
+    print(json.dumps(report))
+    if report["replay_failures"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,16 @@ class HostFailure:
     cause: str = ""  # flattened exception chain, innermost last
     timestamp: float = field(default_factory=time.time)
 
+    @property
+    def recoverable(self) -> bool:
+        """Whether an in-process engine rebuild (engine/supervisor.py)
+        can plausibly clear this failure.  True for failures pinned on a
+        live deployment member — a lost/wedged host comes back when its
+        agent redials.  False for attribution-free connect collapses
+        (``host_rank == -1``: the deployment never assembled, so a
+        rebuild just repeats the same boot timeout)."""
+        return self.host_rank >= 0 or self.phase != PHASE_CONNECT
+
     def describe(self) -> str:
         where = (
             f"host {self.host_rank}" if self.host_rank >= 0 else "deployment"

@@ -10,6 +10,15 @@ The event loop NEVER takes a lock shared with the engine thread: intake
 drains between steps, so a multi-second prefill can't freeze /health or
 other SSE streams (ADVICE r1 #1 / VERDICT r2 weak #3).  Results stream
 to per-request asyncio queues via call_soon_threadsafe.
+
+Engine death is no longer always terminal: a control-plane HostFailure
+hands the engine thread to the EngineSupervisor (engine/supervisor.py),
+which tears down the dead executor, waits for the agents to redial,
+rebuilds the engine, and replays interrupted requests from the request
+journal — in-flight generate() streams keep yielding across the blip.
+Only when the restart policy is exhausted (or the death is not a
+control-plane failure) does the engine reach the terminal dead state:
+every queued/in-flight/new request gets a typed EngineDeadError.
 """
 
 from __future__ import annotations
@@ -21,6 +30,10 @@ from typing import AsyncIterator
 
 from vllm_distributed_tpu.config import EngineArgs, EngineConfig
 from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.engine.supervisor import (
+    EngineSupervisor,
+    JournalEntry,
+)
 from vllm_distributed_tpu.logger import init_logger
 from vllm_distributed_tpu.outputs import RequestOutput
 from vllm_distributed_tpu.sampling_params import SamplingParams
@@ -38,18 +51,42 @@ class EngineDeadError(RuntimeError):
         self.failure = failure
 
 
+class EngineRecoveringError(RuntimeError):
+    """The engine died but the supervisor is rebuilding it in-process.
+    Distinct from EngineDeadError so /health can answer 503 with the
+    RECOVERING state and a Retry-After derived from the backoff."""
+
+    def __init__(self, message: str, failure=None, retry_after: int = 1):
+        super().__init__(message)
+        self.failure = failure
+        self.retry_after = retry_after
+
+
 class AsyncLLM:
+    # How long shutdown() waits for the engine thread before concluding
+    # it is wedged and skipping the device teardown it still owns.
+    SHUTDOWN_JOIN_SECONDS = 5.0
+
     def __init__(self, config: EngineConfig) -> None:
-        self.engine = LLMEngine(config)
         self.config = config
         self._loop: asyncio.AbstractEventLoop | None = None
         self._queues: dict[str, asyncio.Queue] = {}
+        # Request journal (engine/supervisor.py): per live request, the
+        # prompt, params, and client-visible cumulative output — what a
+        # recovery replays.  Written on the event loop, snapshotted by
+        # the supervisor on the engine thread after a flush barrier.
+        self._journal: dict[str, JournalEntry] = {}
         # Thread-safe intake: ("add", kwargs) / ("abort", request_id),
         # applied by the engine thread between steps.
         self._intake: _queue.SimpleQueue = _queue.SimpleQueue()
         self._wake = threading.Event()
         self._dead: BaseException | None = None
         self._shutdown = False
+        # Coarse engine-thread location, for the stuck-shutdown warning:
+        # boot | intake | idle | step | recovering | dead | stopped.
+        self._phase = "boot"
+        self.engine = LLMEngine(config)
+        self.supervisor = EngineSupervisor(self)
         self._thread = threading.Thread(
             target=self._run_engine_loop, daemon=True, name="vdt-engine"
         )
@@ -69,6 +106,11 @@ class AsyncLLM:
                 return
             if op == "add":
                 request_id = payload["request_id"]
+                entry = self._journal.get(request_id)
+                if entry is not None:
+                    # Consumed from the intake: from here on, recovery
+                    # must replay this request (the op won't re-run).
+                    entry.admitted = True
                 try:
                     self.engine.add_request(**payload)
                 except Exception as e:  # noqa: BLE001 — per-request error
@@ -98,6 +140,8 @@ class AsyncLLM:
     def _resolve_aux(fut, result, err) -> None:
         if fut.cancelled():
             return
+        if fut.done():
+            return  # already failed by a concurrent sweep
         if err is not None:
             fut.set_exception(err)
         else:
@@ -111,8 +155,14 @@ class AsyncLLM:
         fut = loop.create_future()
         self._intake.put(("aux", (fn, args, fut)))
         self._wake.set()
+        # Death-race fix (ISSUE 4 satellite): an aux enqueued after the
+        # engine thread's post-death/post-shutdown intake sweep would
+        # otherwise await forever.  The terminal sweep now also runs from
+        # _fail_all_queues (event-loop side), and this re-check covers
+        # a put that lands after BOTH sweeps.
+        if self._shutdown and not fut.done():
+            raise EngineDeadError("AsyncLLM is shutting down")
         if self._dead is not None and not fut.done():
-            # Raced the engine death after its intake drain.
             raise self._dead_error()
         return await fut
 
@@ -129,48 +179,94 @@ class AsyncLLM:
             q.put_nowait(item)
 
     def _run_engine_loop(self) -> None:
-        try:
-            while not self._shutdown:
-                self._drain_intake()
-                if self.engine.errored:
-                    # An idle deployment with a dead executor must not
-                    # look healthy: heartbeat/disconnect failures are
-                    # surfaced here even when no request is in flight
-                    # (step() would never run to notice them).
-                    raise RuntimeError(self.engine._dead_message())
-                if not self.engine.has_unfinished_requests():
-                    self._wake.wait(timeout=0.2)
-                    self._wake.clear()
-                    continue
-                outputs = self.engine.step()
-                if outputs and self._loop is not None:
-                    self._loop.call_soon_threadsafe(
-                        self._dispatch_outputs, outputs
-                    )
-        except BaseException as e:  # noqa: BLE001
-            logger.exception("engine loop died")
-            self._dead = e
-            if self._loop is not None:
-                self._loop.call_soon_threadsafe(
-                    self._fail_all_queues, self._dead_error()
-                )
-            # Aux ops already queued (or racing the death) would await
-            # forever — fail them too.
-            while True:
+        while True:
+            try:
+                self._serve_until_shutdown()
+            except BaseException as e:  # noqa: BLE001
+                logger.exception("engine loop died")
                 try:
-                    op, payload = self._intake.get_nowait()
-                except _queue.Empty:
-                    break
-                if op == "aux" and self._loop is not None:
-                    self._loop.call_soon_threadsafe(
-                        self._resolve_aux,
-                        payload[2],
-                        None,
-                        self._dead_error(),
+                    recovered = (
+                        not self._shutdown and self.supervisor.recover(e)
                     )
+                except BaseException:  # noqa: BLE001
+                    # A recovery-cycle bug must still land in the
+                    # terminal drain below — never a silent thread death
+                    # that leaves every stream hanging.
+                    logger.exception("engine recovery itself failed")
+                    recovered = False
+                if recovered:
+                    continue  # fresh engine installed; keep serving
+                self._phase = "dead"
+                self._dead = e
+                if self._loop is not None:
+                    self._loop.call_soon_threadsafe(
+                        self._fail_all_queues, self._dead_error()
+                    )
+                # Belt and braces: _fail_all_queues sweeps the intake on
+                # the event loop, but if the loop is gone (or a put races
+                # both sweeps) resolve from here too.
+                self._sweep_intake(self._dead_error())
+                return
+            # Clean shutdown: anything still queued (aux futures in
+            # particular) must not leave its caller hanging.
+            self._phase = "stopped"
+            self._sweep_intake(
+                EngineDeadError("AsyncLLM is shutting down")
+            )
+            return
+
+    def _serve_until_shutdown(self) -> None:
+        while not self._shutdown:
+            self._phase = "intake"
+            self._drain_intake()
+            if self.engine.errored:
+                # An idle deployment with a dead executor must not
+                # look healthy: heartbeat/disconnect failures are
+                # surfaced here even when no request is in flight
+                # (step() would never run to notice them).
+                raise RuntimeError(self.engine._dead_message())
+            if not self.engine.has_unfinished_requests():
+                self._phase = "idle"
+                self._wake.wait(timeout=0.2)
+                self._wake.clear()
+                continue
+            self._phase = "step"
+            outputs = self.engine.step()
+            if outputs and self._loop is not None:
+                self._loop.call_soon_threadsafe(
+                    self._dispatch_outputs, outputs
+                )
+
+    def _sweep_intake(self, error: BaseException) -> None:
+        """Fail work still sitting in the intake: aux futures (callers
+        await them and nothing else will ever resolve them) and "add"
+        ops (a generate() racing shutdown would otherwise await its
+        queue forever — on terminal death _fail_all_queues also covers
+        it, but clean shutdown has no fail-all pass)."""
+        while True:
+            try:
+                op, payload = self._intake.get_nowait()
+            except _queue.Empty:
+                return
+            if self._loop is None:
+                continue
+            try:
+                if op == "aux":
+                    self._loop.call_soon_threadsafe(
+                        self._resolve_aux, payload[2], None, error
+                    )
+                elif op == "add":
+                    self._to_request_queue(payload["request_id"], error)
+            except RuntimeError:
+                return  # event loop already closed; nobody awaits
 
     def _dispatch_outputs(self, outputs: list[RequestOutput]) -> None:
         for out in outputs:
+            entry = self._journal.get(out.request_id)
+            if entry is not None:
+                # Journal what the client is about to see — what a
+                # recovery would restore as output state on replay.
+                entry.observe(out)
             q = self._queues.get(out.request_id)
             if q is not None:
                 q.put_nowait(out)
@@ -178,6 +274,10 @@ class AsyncLLM:
     def _fail_all_queues(self, e: BaseException) -> None:
         for q in self._queues.values():
             q.put_nowait(e)
+        # Satellite fix: sweep the intake from the event loop too — an
+        # aux future enqueued after the engine thread's own post-death
+        # sweep must still be resolved, never left hanging.
+        self._sweep_intake(e)
 
     def _dead_error(self) -> EngineDeadError:
         """Typed death with the structured HostFailure attached (drain
@@ -200,11 +300,39 @@ class AsyncLLM:
 
     @property
     def failure_info(self):
-        """Structured HostFailure from the control plane, if any."""
-        return getattr(self.engine, "failure_info", None)
+        """Structured HostFailure from the control plane, if any.  After
+        a failed recovery the current engine may be a half-built one, so
+        fall back to the supervisor's originating failure."""
+        return (
+            getattr(self.engine, "failure_info", None)
+            or self.supervisor.last_failure
+        )
+
+    def _recovery_pending(self) -> bool:
+        """True while the supervisor is (or is about to start)
+        rebuilding: the engine errored but the death will be absorbed,
+        so callers should wait/503-with-Retry-After, not fail."""
+        sup = self.supervisor
+        if sup.recovering:
+            return True
+        return (
+            self._dead is None
+            and self.engine.errored
+            and sup.can_recover(getattr(self.engine, "failure_info", None))
+        )
 
     async def check_health(self) -> None:
-        if self._dead is not None or self.engine.errored:
+        if self._dead is not None:
+            raise self._dead_error()
+        if self._recovery_pending():
+            failure = self.failure_info
+            raise EngineRecoveringError(
+                "engine is recovering"
+                + (f": {failure.describe()}" if failure is not None else ""),
+                failure=failure,
+                retry_after=self.supervisor.retry_after_seconds(),
+            )
+        if self.engine.errored:
             raise self._dead_error()
 
     async def generate(
@@ -215,12 +343,35 @@ class AsyncLLM:
         sampling_params: SamplingParams | None = None,
     ) -> AsyncIterator[RequestOutput]:
         """Feed a request and yield cumulative RequestOutputs until
-        finished.  Cancellation (client disconnect) aborts the request."""
-        if self._dead is not None or self.engine.errored:
+        finished.  Cancellation (client disconnect) aborts the request.
+        A request submitted while the engine is RECOVERING waits in the
+        intake and is admitted by the rebuilt engine."""
+        if self._dead is not None or (
+            self.engine.errored and not self._recovery_pending()
+        ):
             raise self._dead_error()
         self._loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
         self._queues[request_id] = q
+        if self.supervisor.policy.max_restarts > 0 and getattr(
+            self.engine.executor, "supports_recovery", False
+        ):
+            # Journaling exists solely for replay; when recovery is
+            # disabled — or the executor can never produce a
+            # recoverable HostFailure (uniproc) — skip the per-output
+            # cumulative copies entirely.
+            self._journal[request_id] = JournalEntry(
+                request_id=request_id,
+                prompt=prompt,
+                prompt_token_ids=(
+                    list(prompt_token_ids)
+                    if prompt_token_ids is not None
+                    else None
+                ),
+                sampling_params=(
+                    sampling_params or SamplingParams()
+                ).clone(),
+            )
         try:
             if self._dead is not None:
                 # Raced the death after the check above: the fail-all
@@ -238,6 +389,11 @@ class AsyncLLM:
                 )
             )
             self._wake.set()
+            if self._shutdown:
+                # Raced shutdown(): the engine thread's final sweep may
+                # have run before our put (mirror of the _run_aux
+                # re-check — never leave the stream awaiting forever).
+                raise EngineDeadError("AsyncLLM is shutting down")
             while True:
                 item = await q.get()
                 if isinstance(item, BaseException):
@@ -247,6 +403,7 @@ class AsyncLLM:
                     return
         finally:
             self._queues.pop(request_id, None)
+            self._journal.pop(request_id, None)
             self._intake.put(("abort", request_id))
             self._wake.set()
 
@@ -254,14 +411,19 @@ class AsyncLLM:
         self._intake.put(("abort", request_id))
         self._wake.set()
         self._queues.pop(request_id, None)
+        self._journal.pop(request_id, None)
 
     async def embed(self, prompt_token_ids: list[int]) -> list[float]:
         """Runs on the engine thread between steps (_drain_intake), so
         the aux collective is ordered with step dispatches mesh-wide."""
-        return await self._run_aux(self.engine.embed, prompt_token_ids)
+        return await self._run_aux(
+            lambda ids: self.engine.embed(ids), prompt_token_ids
+        )
 
     async def score(self, prompt_token_ids: list[int]) -> list:
-        return await self._run_aux(self.engine.score, prompt_token_ids)
+        return await self._run_aux(
+            lambda ids: self.engine.score(ids), prompt_token_ids
+        )
 
     # Introspection for the API layer.
     @property
@@ -278,5 +440,17 @@ class AsyncLLM:
     def shutdown(self) -> None:
         self._shutdown = True
         self._wake.set()
-        self._thread.join(timeout=5)
+        self.supervisor.interrupt()
+        self._thread.join(timeout=self.SHUTDOWN_JOIN_SECONDS)
+        if self._thread.is_alive():
+            # Satellite fix: a failed join used to fall through into
+            # engine.shutdown(), racing the stuck thread for the device.
+            logger.warning(
+                "engine thread did not exit within %.0fs (stuck in phase "
+                "%r); skipping engine teardown — the stuck thread still "
+                "owns the device",
+                self.SHUTDOWN_JOIN_SECONDS,
+                self._phase,
+            )
+            return
         self.engine.shutdown()

@@ -35,11 +35,21 @@ class LLMEngine:
         self,
         config: EngineConfig,
         executor_class: type[Executor] | None = None,
+        metrics=None,
     ) -> None:
         self.config = config
         executor_class = executor_class or Executor.get_class(config)
         self.executor = executor_class(config)
+        try:
+            self._init_engine(config, metrics)
+        except Exception:
+            # A half-built engine must not leak its executor (listener
+            # socket, loop thread, pools) — the supervisor's crash-loop
+            # rebuild attempts would otherwise pile them up.
+            self.executor.shutdown()
+            raise
 
+    def _init_engine(self, config: EngineConfig, metrics) -> None:
         num_pages = self.executor.determine_num_pages()
         self.executor.initialize_cache(num_pages)
         if config.scheduler_config.warmup_decode:
@@ -50,12 +60,16 @@ class LLMEngine:
             config.scheduler_config, config.cache_config, num_pages
         )
 
-        from vllm_distributed_tpu.metrics import EngineMetrics
+        if metrics is None:
+            from vllm_distributed_tpu.metrics import EngineMetrics
 
-        self.metrics = EngineMetrics(
-            config.model_config.model,
-            enabled=config.observability_config.collect_metrics,
-        )
+            metrics = EngineMetrics(
+                config.model_config.model,
+                enabled=config.observability_config.collect_metrics,
+            )
+        # A rebuilt engine (engine/supervisor.py) inherits the previous
+        # engine's EngineMetrics so counters/histograms span restarts.
+        self.metrics = metrics
         # Liveness instruments (host_up, heartbeat latency) are emitted
         # from the executor's heartbeat loop.
         self.executor.metrics = self.metrics

@@ -1,0 +1,352 @@
+"""Supervised in-process engine recovery (ISSUE 4 tentpole).
+
+PR 2 made failure *detection* first-class; this module makes recovery
+in-process.  Instead of one transient host blip permanently killing the
+engine until an external supervisor (compose/systemd) restarts the whole
+server process, the ``EngineSupervisor`` turns a fatal ``HostFailure``
+into a bounded recovery cycle, run on the engine thread itself:
+
+1. tear down the dead executor (synchronous — the listening port must be
+   released so the rebuilt executor can re-listen on it);
+2. back off (exponential, capped), letting the agents redial: a deployed
+   agent exits on disconnect by design and its own supervisor restarts
+   it, so the rebuilt ``MultiHostExecutor`` blocks in its constructor
+   until ``num_hosts`` slots refill — the same boot path as cold start,
+   but warm (AOT artifact cache + XLA disk cache skip trace/compile);
+3. rebuild ``LLMEngine`` (reusing the ``EngineMetrics`` instance so
+   Prometheus counters span restarts);
+4. **replay** interrupted work from the request journal as a synthetic
+   preemption-resume: each live request is re-admitted with its original
+   prompt, the already-delivered tokens restored as OUTPUT tokens, and
+   ``resume_target`` covering them — the same recompute path a
+   preempted request takes, so the prompt/output boundary (penalties,
+   stop strings, EOS, token budgets) is preserved exactly, the client's
+   SSE stream continues across the blip without observing an error, and
+   greedy outputs are bit-identical to an uninterrupted run.
+
+Recovery is bounded by a restart policy (``VDT_MAX_ENGINE_RESTARTS``
+within ``VDT_CRASH_LOOP_WINDOW_SECONDS``); exhausting it falls back to
+the pre-supervisor terminal-death behavior (typed ``EngineDeadError``,
+503 with attribution).  Only control-plane deaths (a recorded
+``HostFailure``) are recovered — an engine bug would just crash-loop.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from vllm_distributed_tpu import envs
+from vllm_distributed_tpu.engine.request import RequestStatus
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.outputs import RequestOutput
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class JournalEntry:
+    """What AsyncLLM remembers about one live request: enough to
+    re-admit it after an engine rebuild with the already-delivered
+    tokens restored as output state (preemption-resume semantics)."""
+
+    request_id: str
+    prompt: str | None
+    prompt_token_ids: list[int] | None
+    sampling_params: SamplingParams
+    # Client-visible cumulative state, updated on every dispatched
+    # output (event-loop side).
+    emitted_token_ids: list[int] = field(default_factory=list)
+    emitted_logprobs: list[dict[int, float]] | None = None
+    emitted_cumulative_logprob: float = 0.0
+    finished: bool = False
+    # Set by the engine thread when the "add" op is consumed from the
+    # intake.  Replay only covers admitted requests: a request whose add
+    # is still queued reaches the rebuilt engine through the intake
+    # drain, and replaying it too would admit it twice.
+    admitted: bool = False
+    replays: int = 0
+
+    def observe(self, out: RequestOutput) -> None:
+        """Record one cumulative output about to be handed to the
+        client.  Event-loop only.  Replayed requests need no splicing:
+        the rebuilt engine's outputs are cumulative across the blip
+        because the emitted tokens are restored as output tokens.
+
+        Outputs are cumulative, so only the delta is appended — a full
+        copy per output would make journaling O(n^2) over a request's
+        lifetime, on the event loop."""
+        comp = out.outputs[0]
+        n = len(self.emitted_token_ids)
+        if len(comp.token_ids) < n:
+            # Stop-string truncation shrank the output; resync.
+            self.emitted_token_ids = list(comp.token_ids)
+        else:
+            self.emitted_token_ids.extend(comp.token_ids[n:])
+        if comp.logprobs is not None:
+            if (
+                self.emitted_logprobs is None
+                or len(comp.logprobs) < len(self.emitted_logprobs)
+            ):
+                self.emitted_logprobs = list(comp.logprobs)
+            else:
+                self.emitted_logprobs.extend(
+                    comp.logprobs[len(self.emitted_logprobs):]
+                )
+            self.emitted_cumulative_logprob = comp.cumulative_logprob or 0.0
+        self.finished = out.finished
+
+    def replay_into(self, engine) -> None:
+        """Re-admit this request on a rebuilt engine as a synthetic
+        preemption-resume: original prompt and params, emitted tokens
+        restored as OUTPUT tokens, ``resume_target`` covering them.  The
+        scheduler then re-prefills prompt+outputs exactly like a
+        preempted request, preserving the prompt/output boundary —
+        penalties, stop strings (including ones spanning the blip), EOS
+        and token budgets behave as in an uninterrupted run; greedy
+        outputs are bit-identical.  Sampled (temperature>0) requests
+        continue but may diverge after the blip (the PRNG restarts)."""
+        self.replays += 1
+        engine.add_request(
+            request_id=self.request_id,
+            prompt=self.prompt,
+            prompt_token_ids=(
+                list(self.prompt_token_ids)
+                if self.prompt_token_ids is not None
+                else None
+            ),
+            sampling_params=self.sampling_params.clone(),
+        )
+        if not self.emitted_token_ids:
+            return
+        req = engine.scheduler.requests[self.request_id]
+        req.output_token_ids.extend(self.emitted_token_ids)
+        req.resume_target = req.num_tokens
+        # PREEMPTED makes admission resend prompt+outputs with the true
+        # num_prompt_tokens boundary (scheduler.schedule's resumed path).
+        req.status = RequestStatus.PREEMPTED
+        if req.logprobs is not None and self.emitted_logprobs is not None:
+            req.logprobs.extend(self.emitted_logprobs)
+            req.cumulative_logprob = self.emitted_cumulative_logprob
+        detok = engine.detokenizers.get(self.request_id)
+        if detok is not None:
+            # Pre-feed the delivered tokens so post-recovery text stays
+            # cumulative and stop strings spanning the blip still match.
+            detok.append(list(self.emitted_token_ids))
+
+
+@dataclass
+class RestartPolicy:
+    """Bounded exponential-backoff restarts within a crash-loop window."""
+
+    max_restarts: int
+    backoff_base: float
+    backoff_cap: float
+    window: float
+
+    @classmethod
+    def from_env(cls) -> "RestartPolicy":
+        return cls(
+            max_restarts=envs.VDT_MAX_ENGINE_RESTARTS,
+            backoff_base=envs.VDT_ENGINE_RESTART_BACKOFF_SECONDS,
+            backoff_cap=envs.VDT_ENGINE_RESTART_BACKOFF_CAP_SECONDS,
+            window=envs.VDT_CRASH_LOOP_WINDOW_SECONDS,
+        )
+
+    def backoff(self, attempt: int) -> float:
+        return min(self.backoff_cap, self.backoff_base * 2**attempt)
+
+
+class EngineSupervisor:
+    """Owns the restart policy and runs the recovery cycle.  All state
+    transitions happen on the AsyncLLM engine thread; the event loop
+    only reads (``recovering``, ``last_failure``, ``retry_after``)."""
+
+    def __init__(self, async_llm, policy: RestartPolicy | None = None):
+        self.async_llm = async_llm
+        self.policy = policy or RestartPolicy.from_env()
+        self.recovering = False
+        self.last_failure = None  # originating HostFailure of the cycle
+        self.restarts_total = 0
+        self._restart_times: deque[float] = deque()
+        # Guards _restart_times: can_recover is called from the event
+        # loop (health checks, generate admission) while recover()
+        # prunes/appends on the engine thread.
+        self._times_lock = threading.Lock()
+        self._current_backoff = self.policy.backoff_base
+        self._interrupt = threading.Event()
+
+    # ---- policy (also read from the event loop) ----
+    def _prune(self, now: float) -> None:
+        with self._times_lock:
+            while (
+                self._restart_times
+                and now - self._restart_times[0] > self.policy.window
+            ):
+                self._restart_times.popleft()
+
+    def _window_count(self) -> int:
+        self._prune(time.monotonic())
+        with self._times_lock:
+            return len(self._restart_times)
+
+    def _record_attempt(self, now: float) -> None:
+        with self._times_lock:
+            self._restart_times.append(now)
+
+    def can_recover(self, failure) -> bool:
+        """Would a death attributed to ``failure`` enter recovery (vs
+        terminal)?  Only control-plane HostFailures are recoverable, and
+        only while the crash-loop window has restart budget left."""
+        if self.policy.max_restarts <= 0:
+            return False
+        if failure is None or not getattr(failure, "recoverable", False):
+            return False
+        return self._window_count() < self.policy.max_restarts
+
+    def retry_after_seconds(self) -> int:
+        """/health Retry-After while RECOVERING, derived from the
+        backoff schedule (never below 1s)."""
+        return max(1, math.ceil(self._current_backoff))
+
+    def interrupt(self) -> None:
+        """Abort backoff waits (AsyncLLM.shutdown during recovery)."""
+        self._interrupt.set()
+
+    # ---- the cycle (engine thread only) ----
+    def recover(self, cause: BaseException) -> bool:
+        """Attempt to bring the engine back.  Returns True with
+        ``async_llm.engine`` swapped to a fresh engine and interrupted
+        requests replayed, or False to fall through to terminal death."""
+        llm = self.async_llm
+        failure = getattr(llm.engine, "failure_info", None)
+        if not self.can_recover(failure):
+            return False
+        self.last_failure = failure
+        self.recovering = True
+        llm._phase = "recovering"
+        metrics = llm.engine.metrics
+        t0 = time.monotonic()
+        try:
+            # Settle the event loop first: outputs dispatched before the
+            # death must land in the journal before we snapshot it.
+            self._flush_event_loop()
+            while True:
+                if llm._shutdown or self._interrupt.is_set():
+                    return False
+                now = time.monotonic()
+                attempt = self._window_count()
+                if attempt >= self.policy.max_restarts:
+                    logger.error(
+                        "crash loop: %d engine restarts within %.0fs — "
+                        "giving up, engine is permanently dead",
+                        self.policy.max_restarts,
+                        self.policy.window,
+                    )
+                    return False
+                self._record_attempt(now)
+                self.restarts_total += 1
+                metrics.record_restart()
+                delay = self.policy.backoff(attempt)
+                self._current_backoff = delay
+                logger.warning(
+                    "engine recovery: tearing down dead executor, "
+                    "rebuild attempt %d/%d in %.1fs (%s)",
+                    attempt + 1,
+                    self.policy.max_restarts,
+                    delay,
+                    failure.describe() if failure is not None else cause,
+                )
+                self._teardown_old()
+                if self._interrupt.wait(timeout=delay):
+                    return False
+                try:
+                    from vllm_distributed_tpu.engine.llm_engine import (
+                        LLMEngine,
+                    )
+
+                    new_engine = LLMEngine(llm.config, metrics=metrics)
+                except Exception:  # noqa: BLE001 — retried per policy
+                    logger.exception(
+                        "engine rebuild attempt %d failed", attempt + 1
+                    )
+                    continue
+                if llm._shutdown or self._interrupt.is_set():
+                    # shutdown() raced the rebuild (its join gave up
+                    # mid-constructor and nobody else will ever tear
+                    # this engine down) — dismantle it here instead of
+                    # leaking its listener/loop/pools into a dead
+                    # process.
+                    try:
+                        new_engine.shutdown()
+                    except Exception:  # noqa: BLE001
+                        logger.exception(
+                            "teardown of mid-shutdown rebuild raised"
+                        )
+                    return False
+                llm.engine = new_engine
+                replayed = self._replay(new_engine)
+                metrics.record_engine_recovered()
+                metrics.record_replayed(replayed)
+                elapsed = time.monotonic() - t0
+                metrics.record_recovery_seconds(elapsed)
+                logger.warning(
+                    "engine recovered in %.1fs (restart %d, %d request(s) "
+                    "replayed)",
+                    elapsed,
+                    self.restarts_total,
+                    replayed,
+                )
+                # The incident is closed: a LATER unrelated death must
+                # not inherit this attribution via the failure_info
+                # fallback.
+                self.last_failure = None
+                return True
+        finally:
+            self.recovering = False
+
+    def _flush_event_loop(self) -> None:
+        """Barrier: every callback the dead engine scheduled with
+        call_soon_threadsafe (output dispatches -> journal updates) has
+        run once this returns."""
+        loop = self.async_llm._loop
+        if loop is None:
+            return
+        settled = threading.Event()
+        try:
+            loop.call_soon_threadsafe(settled.set)
+        except RuntimeError:
+            return  # loop closed: nothing to settle
+        settled.wait(timeout=2.0)
+
+    def _teardown_old(self) -> None:
+        try:
+            self.async_llm.engine.shutdown()
+        except Exception:  # noqa: BLE001 — a dead deployment tears down
+            # as far as it can; the rebuild re-listens regardless.
+            logger.exception("teardown of dead engine raised")
+
+    def _replay(self, engine) -> int:
+        """Re-admit journaled live requests on the rebuilt engine, in
+        admission order.  Runs before the intake queue drains, so
+        interrupted requests keep priority over work that arrived while
+        recovering."""
+        llm = self.async_llm
+        replayed = 0
+        for entry in list(llm._journal.values()):
+            if entry.finished or not entry.admitted:
+                # finished: final output already delivered.  not
+                # admitted: the "add" op still sits in the intake and
+                # will reach this engine through the normal drain.
+                continue
+            try:
+                entry.replay_into(engine)
+            except Exception as e:  # noqa: BLE001 — per-request error
+                llm._to_request_queue(entry.request_id, e)
+            else:
+                replayed += 1
+        return replayed

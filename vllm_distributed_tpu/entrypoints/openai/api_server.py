@@ -21,7 +21,11 @@ from typing import Any
 from aiohttp import web
 
 from vllm_distributed_tpu import envs
-from vllm_distributed_tpu.engine.async_llm import AsyncLLM, EngineDeadError
+from vllm_distributed_tpu.engine.async_llm import (
+    AsyncLLM,
+    EngineDeadError,
+    EngineRecoveringError,
+)
 from vllm_distributed_tpu.entrypoints.openai.protocol import (
     EmbeddingData,
     EmbeddingRequest,
@@ -183,6 +187,21 @@ async def health(request: web.Request) -> web.Response:
     state: ServerState = request.app["state"]
     try:
         await state.engine.check_health()
+    except EngineRecoveringError as e:
+        # Third engine state: the supervisor is rebuilding in-process.
+        # Still 503 (don't route new traffic here yet), but the body
+        # says RECOVERING and Retry-After tracks the backoff schedule,
+        # so a load balancer knows this backend is coming back.
+        body = {"status": "recovering", "error": str(e)}
+        failure = getattr(e, "failure", None)
+        if failure is not None:
+            # The originating HostFailure that triggered the recovery.
+            body["failure"] = failure.to_dict()
+        return web.json_response(
+            body,
+            status=503,
+            headers={"Retry-After": str(e.retry_after)},
+        )
     except EngineDeadError as e:
         body = {"status": "dead", "error": str(e)}
         failure = getattr(e, "failure", None)

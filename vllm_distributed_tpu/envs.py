@@ -51,6 +51,28 @@ environment_variables: dict[str, Callable[[], Any]] = {
     "VDT_RETRY_AFTER_SECONDS": lambda: int(
         os.environ.get("VDT_RETRY_AFTER_SECONDS", "30")
     ),
+    # In-process engine recovery (engine/supervisor.py): how many
+    # executor rebuilds are attempted within the crash-loop window
+    # before a control-plane death becomes terminal.  0 disables
+    # recovery entirely (every HostFailure is fatal, the pre-supervisor
+    # behavior).
+    "VDT_MAX_ENGINE_RESTARTS": lambda: int(
+        os.environ.get("VDT_MAX_ENGINE_RESTARTS", "3")
+    ),
+    # Exponential backoff between rebuild attempts: base * 2^attempt,
+    # capped.  The /health Retry-After during RECOVERING derives from
+    # the current delay.
+    "VDT_ENGINE_RESTART_BACKOFF_SECONDS": lambda: float(
+        os.environ.get("VDT_ENGINE_RESTART_BACKOFF_SECONDS", "1")
+    ),
+    "VDT_ENGINE_RESTART_BACKOFF_CAP_SECONDS": lambda: float(
+        os.environ.get("VDT_ENGINE_RESTART_BACKOFF_CAP_SECONDS", "30")
+    ),
+    # Restarts older than this window are forgotten; more than
+    # VDT_MAX_ENGINE_RESTARTS *within* it is a crash loop -> give up.
+    "VDT_CRASH_LOOP_WINDOW_SECONDS": lambda: float(
+        os.environ.get("VDT_CRASH_LOOP_WINDOW_SECONDS", "300")
+    ),
     # --- engine ---
     "VDT_LOG_LEVEL": lambda: os.environ.get("VDT_LOG_LEVEL", "INFO"),
     "VDT_COMPILE_CACHE_DIR": lambda: os.environ.get(

@@ -26,6 +26,10 @@ class Executor:
     """Subclasses implement _init_executor + collective_rpc."""
 
     uses_ray = False
+    # Whether this executor's deaths can carry a recoverable HostFailure
+    # the engine supervisor (engine/supervisor.py) may rebuild from.
+    # AsyncLLM skips request journaling entirely when False.
+    supports_recovery = False
 
     def __init__(self, config: EngineConfig) -> None:
         self.config = config

@@ -20,7 +20,10 @@ one worker slot per host, then drives init/load/execute via
 
 Failure contract (§3.5/§5.3): a lost agent after deployment kills the
 executor (fail-fast); engine learns via register_failure_callback; the
-supervisor (compose restart / systemd) reforms the deployment.  Every
+in-process EngineSupervisor (engine/supervisor.py) tears this executor
+down and builds a fresh one that re-listens on the same port while the
+agents redial — the external supervisor (compose restart / systemd) is
+only the fallback once the restart policy is exhausted.  Every
 kill path produces a ``HostFailure`` naming the host and lifecycle phase
 (connect/init/execute/heartbeat); the FIRST one recorded is the root
 attribution surfaced on /health.  Liveness does not wait for traffic:
@@ -87,6 +90,9 @@ class MultiHostExecutor(Executor):
 
     # Overridable in tests to install a mock worker class on all hosts.
     worker_cls: str | None = None
+    # Deaths carry per-host HostFailure attribution the supervisor can
+    # recover from (agents redial, the executor rebuilds in-process).
+    supports_recovery = True
 
     def _init_executor(self) -> None:
         pc = self.parallel_config
@@ -593,10 +599,17 @@ class MultiHostExecutor(Executor):
         self._teardown(drain_workers=True)
 
     def _teardown(self, drain_workers: bool) -> None:
+        """Restartable teardown: by the time this returns, the listening
+        socket is released (awaited on the executor loop, not merely
+        scheduled) and the loop thread has been joined — so a supervisor
+        rebuilding the executor (engine/supervisor.py) can immediately
+        re-listen on the same port.  Safe to call more than once."""
         self._cancel_heartbeats()
-        if drain_workers:
+        if drain_workers and not self.is_failed:
             # Clean jax.distributed teardown on every host BEFORE dropping
             # the control plane (the shutdown barrier needs all tasks).
+            # Pointless on a failed deployment: the collective would just
+            # raise "Executor failed" immediately.
             try:
                 self.collective_rpc("shutdown", timeout=15.0)
             except Exception:  # noqa: BLE001 — failed/partial deployments
@@ -612,11 +625,23 @@ class MultiHostExecutor(Executor):
                 logger.debug("peer teardown failed: %s", e)
         server = getattr(self, "_server", None)
         if server is not None:
-            self._loop.call_soon_threadsafe(server.close)
+            self._server = None
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    self._close_server(server), self._loop
+                ).result(timeout=5)
+            except Exception as e:  # noqa: BLE001
+                logger.debug("listener close failed: %s", e)
         self._loop.call_soon_threadsafe(self._loop.stop)
+        self._loop_thread.join(timeout=5)
         self._local_pool.shutdown(wait=False)
         self._local_fetch_pool.shutdown(wait=False)
         self._gather_pool.shutdown(wait=False)
+
+    @staticmethod
+    async def _close_server(server) -> None:
+        server.close()
+        await server.wait_closed()
 
 
 def method_desc(phase: str) -> str:

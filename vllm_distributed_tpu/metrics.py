@@ -34,6 +34,12 @@ _HEARTBEAT_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0,
 )
+# One recovery = teardown + backoff + agent redial + executor rebuild +
+# replay; sub-second with warm AOT caches on mocks, tens of seconds on a
+# real pod slice (device init + weight load dominate).
+_RECOVERY_BUCKETS = (
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 40.0, 80.0, 160.0, 320.0,
+)
 
 
 class EngineMetrics:
@@ -155,6 +161,26 @@ class EngineMetrics:
             ["model_name", "phase", "host_rank"],
             registry=self.registry,
         )
+        # ---- supervised recovery (engine/supervisor.py).  These live in
+        # the same EngineMetrics instance, which is carried ACROSS engine
+        # rebuilds — counters must not reset when the engine recovers.
+        self.engine_restarts = counter(
+            "vllm:engine_restarts_total",
+            "In-process engine recovery attempts started by the "
+            "supervisor (teardown + executor rebuild)",
+        )
+        self.requests_replayed = counter(
+            "vllm:requests_replayed_total",
+            "Interrupted requests re-admitted from the request journal "
+            "after an engine recovery",
+        )
+        self.recovery_seconds = histogram(
+            "vllm:engine_recovery_seconds",
+            "Engine death to recovered-and-replayed, per successful "
+            "recovery cycle",
+            _RECOVERY_BUCKETS,
+        )
+        self._dead_labels: tuple[str, str] | None = None
         self._model_name = model_name
 
     # ---- engine-loop hooks ----
@@ -232,9 +258,33 @@ class EngineMetrics:
             return
         phase = failure.phase if failure is not None else "unknown"
         host = str(failure.host_rank) if failure is not None else ""
+        self._dead_labels = (phase, host)
         self._engine_dead.labels(
             model_name=self._model_name, phase=phase, host_rank=host
         ).set(1)
+
+    # ---- supervised recovery hooks ----
+    def record_restart(self) -> None:
+        if self.enabled:
+            self.engine_restarts.inc()
+
+    def record_replayed(self, n: int) -> None:
+        if self.enabled and n:
+            self.requests_replayed.inc(n)
+
+    def record_recovery_seconds(self, seconds: float) -> None:
+        if self.enabled:
+            self.recovery_seconds.observe(seconds)
+
+    def record_engine_recovered(self) -> None:
+        """Clear the dead gauge set by record_engine_dead (same label
+        set, so dashboards see the incident close, not a new series)."""
+        if not self.enabled or self._dead_labels is None:
+            return
+        phase, host = self._dead_labels
+        self._engine_dead.labels(
+            model_name=self._model_name, phase=phase, host_rank=host
+        ).set(0)
 
     def record_finished(self, req_metrics, reason: str | None) -> None:
         if not self.enabled:
