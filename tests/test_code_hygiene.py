@@ -1,20 +1,23 @@
-"""Control-plane code hygiene (ISSUE 2 satellite): the distributed/
-package is the layer whose job is failure DETECTION, so broad
-exception-swallowing there hides exactly the signals the fault-tolerance
-layer exists to surface.  This AST lint fails on any new
-``except Exception: pass`` / bare ``except: pass`` block in
-``vllm_distributed_tpu/distributed/`` — swallowed teardown errors must
-at least be logged at debug (see rpc_transport close()).
+"""Code-hygiene AST lints.
+
+- ISSUE 2 satellite: the distributed/ package is the layer whose job is
+  failure DETECTION, so broad exception-swallowing there hides exactly
+  the signals the fault-tolerance layer exists to surface.  Fails on any
+  new ``except Exception: pass`` / bare ``except: pass`` block in
+  ``vllm_distributed_tpu/distributed/`` — swallowed teardown errors must
+  at least be logged at debug (see rpc_transport close()).
+- ISSUE 5 satellite: every span opened in ``vllm_distributed_tpu/`` must
+  use the context-manager form (``with tracer.span(...)``) — a manual
+  ``start_span`` call outside a ``with`` item or a try/finally that
+  ``.end()``s it is orphanable (the span leaks open if the code between
+  open and close raises).
 """
 
 import ast
 from pathlib import Path
 
-DISTRIBUTED = (
-    Path(__file__).resolve().parent.parent
-    / "vllm_distributed_tpu"
-    / "distributed"
-)
+PACKAGE = Path(__file__).resolve().parent.parent / "vllm_distributed_tpu"
+DISTRIBUTED = PACKAGE / "distributed"
 
 _BROAD = {"Exception", "BaseException"}
 
@@ -46,4 +49,67 @@ def test_no_silent_broad_except_in_distributed():
     assert not offenders, (
         "silent broad except blocks in distributed/ (log at debug "
         f"instead of swallowing): {offenders}"
+    )
+
+
+def _calls_named(node: ast.AST, name: str):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            callee = (
+                fn.attr
+                if isinstance(fn, ast.Attribute)
+                else getattr(fn, "id", None)
+            )
+            if callee == name:
+                yield sub
+
+
+def _guarded_start_spans(tree: ast.AST) -> set[int]:
+    """start_span calls that cannot leak open: used as a `with` item, or
+    assigned immediately before a try whose finally calls .end()."""
+    ok: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                for call in _calls_named(item.context_expr, "start_span"):
+                    ok.add(id(call))
+        body = getattr(node, "body", None)
+        if not isinstance(body, list):
+            continue
+        for stmt, nxt in zip(body, body[1:]):
+            if not (
+                isinstance(stmt, (ast.Assign, ast.AnnAssign))
+                and isinstance(nxt, ast.Try)
+                and nxt.finalbody
+            ):
+                continue
+            if any(
+                True
+                for fin in nxt.finalbody
+                for _ in _calls_named(fin, "end")
+            ):
+                for call in _calls_named(stmt, "start_span"):
+                    ok.add(id(call))
+    return ok
+
+
+def test_spans_use_context_manager_form():
+    """ISSUE 5 satellite: no orphanable manual start_span anywhere in
+    the package — use `with tracer.span(...)` (or try/finally + .end())
+    so a raise between open and close can never leak an open span."""
+    offenders = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        guarded = _guarded_start_spans(tree)
+        for call in _calls_named(tree, "start_span"):
+            # The definition site (tracing.py's `start_span = span`
+            # alias) is an assignment, not a call, so it never trips.
+            if id(call) not in guarded:
+                offenders.append(
+                    f"{path.relative_to(PACKAGE)}:{call.lineno}"
+                )
+    assert not offenders, (
+        "manual start_span without with/try-finally (orphanable open "
+        f"span): {offenders}"
     )

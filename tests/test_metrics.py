@@ -22,14 +22,16 @@ from vllm_distributed_tpu.sampling_params import SamplingParams
 
 def test_engine_metrics_records():
     m = EngineMetrics("m", enabled=True)
-    rm = RequestMetrics(arrival_time=100.0)
-    rm.first_token_time = 100.5
+    # Intervals come from the monotonic stamps; the wall-clock fields
+    # exist only for span-start timestamps.
+    rm = RequestMetrics(arrival_time=100.0, arrival_time_mono=100.0)
+    rm.first_token_time_mono = 100.5
     m.record_prompt_tokens(7)
     m.record_new_tokens(rm, 1, now=100.5)  # first token -> TTFT
     m.record_new_tokens(rm, 4, now=100.9)  # fused batch -> 4 ITL obs
     m.record_queues(3, 2)
     m.record_preemptions(1)
-    rm.finished_time = 101.0
+    rm.finished_time_mono = 101.0
     m.record_finished(rm, "stop")
     text = m.render().decode()
     assert 'vllm:time_to_first_token_seconds_count{model_name="m"} 1.0' in text
@@ -44,6 +46,80 @@ def test_engine_metrics_records():
     )
     # TTFT observed value lands in the right bucket neighborhood.
     assert 'vllm:time_to_first_token_seconds_sum{model_name="m"} 0.5' in text
+
+
+def test_intervals_use_monotonic_clock():
+    """ISSUE 5 satellite: an NTP wall-clock step (even a big backwards
+    one) must not produce negative/garbage TTFT, ITL, or e2e — interval
+    math reads only the monotonic stamps."""
+    m = EngineMetrics("m", enabled=True)
+    rm = RequestMetrics(
+        arrival_time=2_000_000_000.0,  # wall clock, about to step back
+        arrival_time_mono=50.0,
+    )
+    # Wall clock stepped back 1000s before the first token; monotonic
+    # keeps counting.
+    rm.first_token_time = 1_999_999_000.0
+    rm.first_token_time_mono = 50.25
+    m.record_new_tokens(rm, 1, now=50.25)
+    m.record_new_tokens(rm, 2, now=50.45)
+    rm.finished_time = 1_999_999_001.0
+    rm.finished_time_mono = 50.5
+    m.record_finished(rm, "stop")
+    text = m.render().decode()
+    assert 'vllm:time_to_first_token_seconds_sum{model_name="m"} 0.25' in text
+    # 2 ITL observations of 0.1s each.
+    assert 'vllm:time_per_output_token_seconds_count{model_name="m"} 2.0' in text
+    assert (
+        'vllm:time_per_output_token_seconds_sum{model_name="m"} 0.2' in text
+    )
+    assert 'vllm:e2e_request_latency_seconds_sum{model_name="m"} 0.5' in text
+
+
+def test_metric_registry_matches_documented_names(tmp_path):
+    """ISSUE 5 satellite: registry-drift guard.  After an engine run,
+    render() must expose every documented vllm:* family exactly once —
+    and nothing undocumented."""
+    import re
+
+    from vllm_distributed_tpu.metrics import DOCUMENTED_METRICS
+
+    engine = LLMEngine.from_engine_args(
+        EngineArgs(
+            model=make_tiny_llama(str(tmp_path / "mdrift")),
+            skip_tokenizer_init=True,
+            num_kv_pages=64,
+            max_model_len=128,
+        )
+    )
+    engine.add_request(
+        "r0",
+        prompt_token_ids=[1, 5, 9],
+        sampling_params=SamplingParams(
+            temperature=0.0, max_tokens=4, ignore_eos=True
+        ),
+    )
+    while engine.has_unfinished_requests():
+        engine.step()
+    engine.shutdown()
+    text = engine.metrics.render().decode()
+    families = re.findall(r"^# TYPE (vllm:\S+) ", text, flags=re.M)
+    # prometheus_client emits a companion `<name>_created` gauge per
+    # counter/histogram once samples exist; those track the documented
+    # family implicitly and are not part of the contract.
+    vllm_families = [
+        f
+        for f in families
+        if f.startswith("vllm:") and not f.endswith("_created")
+    ]
+    assert sorted(vllm_families) == sorted(set(vllm_families)), (
+        "duplicate metric families rendered"
+    )
+    assert set(vllm_families) == set(DOCUMENTED_METRICS), (
+        "metric registry drifted from DOCUMENTED_METRICS: "
+        f"undocumented={set(vllm_families) - set(DOCUMENTED_METRICS)}, "
+        f"missing={set(DOCUMENTED_METRICS) - set(vllm_families)}"
+    )
 
 
 def test_metrics_disabled_noop():

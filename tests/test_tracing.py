@@ -1,0 +1,571 @@
+"""End-to-end request tracing (ISSUE 5).
+
+Unit level: tracer semantics (ids, nesting, ring bound, clock-offset
+adoption, the allocation-free no-op path) and trace integrity (children
+nest inside parents, per-host monotonic timestamps, no span leaks open).
+
+E2E level (the acceptance scenario): an OpenAI-API request served by an
+AsyncLLM over the mocked 2-host MultiHostExecutor produces ONE trace
+containing api → queue → prefill → decode → rpc-dispatch →
+worker-execute spans with consistent parent/child links across the RPC
+boundary; /debug/traces serves it as JSON and as Chrome trace-event
+format; the trace id is echoed in a response header; the per-stage
+Prometheus histograms are fed from the same spans.  With VDT_TRACING
+unset the engine loop runs the no-op tracer and /debug/traces is 404.
+"""
+
+import asyncio
+import json
+import multiprocessing
+import os
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from tests.mock_worker import MockWorker  # noqa: F401 (import check)
+from tests.utils import make_tiny_llama
+from vllm_distributed_tpu.config import EngineArgs
+from vllm_distributed_tpu.distributed.agent import remote_main
+from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.entrypoints.openai.api_server import (
+    TRACE_HEADER,
+    ServerState,
+    build_app,
+    init_app_state,
+)
+from vllm_distributed_tpu.executor.multihost import MultiHostExecutor
+from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.testing import write_llama_config
+from vllm_distributed_tpu.tracing import (
+    NOOP_SPAN,
+    Tracer,
+    get_tracer,
+)
+from vllm_distributed_tpu.utils import get_open_port
+
+EPS = 0.1  # interval-nesting tolerance (separate wall-clock reads)
+
+
+# ---------------------------------------------------------------------
+# tracer unit tests
+# ---------------------------------------------------------------------
+def test_disabled_tracer_is_allocation_free_noop():
+    t = Tracer()
+    assert not t.enabled
+    # Every open returns the SAME shared object: no per-call allocation.
+    assert t.span("a") is NOOP_SPAN
+    assert t.span("b", trace_root=True) is NOOP_SPAN
+    with t.span("c") as sp:
+        sp.set_attribute("k", "v")  # all no-ops
+    t.record_span("d", 0.0, 1.0, parent=("t", "s"))
+    t.event(("t", "s"), "e")
+    assert t.snapshot() == []
+    assert t.num_open_spans == 0
+
+
+def test_ids_are_w3c_sized():
+    t = Tracer().configure(True)
+    with t.span("root", trace_root=True) as root:
+        pass
+    assert len(root.trace_id) == 32  # 128-bit hex
+    assert len(root.span_id) == 16  # 64-bit hex
+    int(root.trace_id, 16), int(root.span_id, 16)
+
+
+def test_context_nesting_and_finalize():
+    t = Tracer().configure(True, ring_size=8)
+    with t.span("root", trace_root=True) as root:
+        with t.span("child") as child:
+            with t.span("grandchild") as grand:
+                pass
+        # Sibling opened after child closed inherits root again.
+        with t.span("sibling") as sib:
+            pass
+    assert child.parent_id == root.span_id
+    assert grand.parent_id == child.span_id
+    assert sib.parent_id == root.span_id
+    snap = t.snapshot()
+    assert len(snap) == 1 and snap[0]["complete"]
+    assert snap[0]["root_span_id"] == root.span_id
+    assert t.num_open_spans == 0
+
+
+def test_span_without_context_is_dropped():
+    """Untraced work stays untraced: a child span with no parent and no
+    root flag is the no-op singleton, not an orphan trace."""
+    t = Tracer().configure(True)
+    assert t.span("orphan") is NOOP_SPAN
+    assert t.snapshot() == []
+
+
+def test_ring_buffer_bounds_completed_traces():
+    t = Tracer().configure(True, ring_size=4)
+    ids = []
+    for _ in range(10):
+        with t.span("root", trace_root=True) as root:
+            pass
+        ids.append(root.trace_id)
+    snap = t.snapshot()
+    assert len(snap) == 4
+    assert [tr["trace_id"] for tr in snap] == ids[-4:]  # oldest evicted
+    assert t.get_trace(ids[0]) is None
+
+
+def test_ring_shrink_reindexes_finished_traces():
+    """Reconfiguring to a smaller ring must evict from the id index too:
+    get_trace() and snapshot() stay in sync and dropped traces are freed."""
+    t = Tracer().configure(True, ring_size=8)
+    ids = []
+    for _ in range(8):
+        with t.span("root", trace_root=True) as root:
+            pass
+        ids.append(root.trace_id)
+    t.configure(True, ring_size=2)
+    assert [tr["trace_id"] for tr in t.snapshot()] == ids[-2:]
+    for tid in ids[:-2]:
+        assert t.get_trace(tid) is None
+    for tid in ids[-2:]:
+        assert t.get_trace(tid) is not None
+
+
+def test_metrics_sink_cleared_only_for_owner():
+    """clear_metrics_sink detaches the caller's sink but never a newer
+    engine's: the slot must not outlive the engine that installed it."""
+    t = Tracer()
+    sink_a: list = []
+    sink_b: list = []
+    t.set_metrics_sink(sink_a.append)
+    t.clear_metrics_sink(sink_b.append)
+    assert t._metrics_sink is not None  # someone else's sink survives
+    t.clear_metrics_sink(sink_a.append)
+    assert t._metrics_sink is None
+
+
+def test_overflow_evicted_trace_not_duplicated_on_root_close():
+    """A trace force-evicted from the active set (too many in flight)
+    whose root span closes afterwards must not enter the ring twice or
+    desync the trace_id index."""
+    t = Tracer().configure(True, ring_size=4)
+    # The active set caps at max(ring_size, 64): hold 70 roots open.
+    roots = [t.span(f"root{i}", trace_root=True) for i in range(70)]
+    for r in roots:
+        r.__enter__()
+    for r in reversed(roots):
+        r.__exit__(None, None, None)
+    snap = t.snapshot()
+    ids = [tr["trace_id"] for tr in snap]
+    assert len(ids) == len(set(ids)) == 4  # no duplicates, ring bound
+    for tid in ids:
+        assert t.get_trace(tid) is not None  # index consistent
+    assert t.num_open_spans == 0
+
+
+def test_adopt_applies_clock_offset():
+    t = Tracer().configure(True)
+    with t.span("root", trace_root=True) as root:
+        pass
+    # Remote host's clock runs 5s ahead; a low-RTT sample established it.
+    t.set_clock_offset("host1", 5.0, rtt=0.001)
+    t.adopt(
+        [
+            {
+                "name": "worker.execute",
+                "trace_id": root.trace_id,
+                "span_id": "aa" * 8,
+                "parent_id": root.span_id,
+                "host": "host1",
+                "start": root.start + 5.0 + 0.01,
+                "duration": 0.002,
+                "attributes": {},
+            }
+        ]
+    )
+    trace = t.get_trace(root.trace_id)
+    adopted = [s for s in trace["spans"] if s["name"] == "worker.execute"]
+    assert len(adopted) == 1
+    # Mapped back onto the local timeline: ~10ms after root start.
+    assert abs(adopted[0]["start"] - (root.start + 0.01)) < 1e-6
+
+
+def test_clock_offset_prefers_low_rtt_samples():
+    t = Tracer().configure(True)
+    t.set_clock_offset("h", 1.0, rtt=0.001)
+    t.set_clock_offset("h", 99.0, rtt=0.5)  # congested sample: rejected
+    assert t.clock_offset("h") == 1.0
+    t.set_clock_offset("h", 2.0, rtt=0.0009)  # better sample: accepted
+    assert t.clock_offset("h") == 2.0
+
+
+def test_metrics_sink_fed_from_spans():
+    observed = []
+    t = Tracer().configure(True)
+    t.set_metrics_sink(lambda name, dur: observed.append((name, dur)))
+    with t.span("root", trace_root=True):
+        pass
+    t.record_span("scheduler.schedule", 0.0, 0.25, parent=None)
+    t.set_metrics_sink(None)
+    names = [n for n, _ in observed]
+    assert "root" in names
+    # record_span without a trace context still feeds the sink (stage
+    # histograms populate even for untraced engine-level callers).
+    assert ("scheduler.schedule", 0.25) in observed
+
+
+def test_chrome_export_is_valid_trace_event_json():
+    t = Tracer().configure(True)
+    with t.span("root", trace_root=True, rid="r1") as root:
+        with t.span("child"):
+            pass
+        t.event(root.ctx, "engine.preempted", request_id="r1")
+    chrome = json.loads(t.to_chrome_json())
+    events = chrome["traceEvents"]
+    assert isinstance(events, list) and events
+    complete = [e for e in events if e.get("ph") == "X"]
+    instants = [e for e in events if e.get("ph") == "i"]
+    meta = [e for e in events if e.get("ph") == "M"]
+    assert len(complete) == 2 and len(instants) == 1 and meta
+    for e in complete:
+        assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert e["args"]["trace_id"] == root.trace_id
+    assert any(
+        m["args"]["name"] == "driver"
+        for m in meta
+        if m["name"] == "process_name"
+    )
+
+
+def test_otlp_degrades_silently_without_sdk(monkeypatch):
+    """Trace finalization must not raise when the opentelemetry SDK is
+    absent (prometheus_client parallel: missing optional dep = silently
+    off).  The SDK import is blocked explicitly so the test holds even
+    on machines that have it installed."""
+    import sys
+
+    monkeypatch.setitem(sys.modules, "opentelemetry.sdk", None)
+    t = Tracer().configure(True)
+    with t.span("root", trace_root=True):
+        pass
+    assert t.snapshot()  # finalized fine
+    assert t._otlp is False  # resolved to permanently-off
+
+
+def test_open_span_accounting_survives_errors():
+    """A raise inside a with-span must close it (no leaked open span) —
+    the property the code-hygiene start_span lint protects."""
+    t = Tracer().configure(True)
+    with pytest.raises(ValueError):
+        with t.span("root", trace_root=True):
+            with t.span("child"):
+                raise ValueError("boom")
+    assert t.num_open_spans == 0
+    snap = t.snapshot()
+    child = next(
+        s for s in snap[0]["spans"] if s["name"] == "child"
+    )
+    assert child["attributes"]["error"] == "ValueError"
+
+
+# ---------------------------------------------------------------------
+# trace_summary CLI
+# ---------------------------------------------------------------------
+def test_trace_summary_cli(tmp_path, capsys):
+    from tools.trace_summary import main, summarize
+
+    t = Tracer().configure(True)
+    for _ in range(3):
+        with t.span("root", trace_root=True) as root:
+            t.record_span(
+                "engine.queue", root.start, 0.01, parent=root.ctx
+            )
+            t.record_span(
+                "engine.decode", root.start, 0.10, parent=root.ctx
+            )
+            t.event(root.ctx, "engine.preempted")  # instant: excluded
+    traces = t.snapshot()
+    stats = summarize(traces)
+    assert stats["engine.queue"]["count"] == 3
+    assert abs(stats["engine.decode"]["p50"] - 0.10) < 1e-9
+    dump = tmp_path / "traces.json"
+    dump.write_text(json.dumps({"traces": traces}))
+    assert main([str(dump)]) == 0
+    out = capsys.readouterr().out
+    assert "engine.queue" in out and "p99(ms)" in out
+    assert "3 trace(s)" in out
+
+
+# ---------------------------------------------------------------------
+# engine no-op path + /debug/traces while disabled
+# ---------------------------------------------------------------------
+def test_engine_step_loop_runs_noop_tracer_when_unset(tmp_path, monkeypatch):
+    monkeypatch.delenv("VDT_TRACING", raising=False)
+    engine = LLMEngine.from_engine_args(
+        EngineArgs(
+            model=make_tiny_llama(str(tmp_path / "m")),
+            skip_tokenizer_init=True,
+            num_kv_pages=64,
+            max_model_len=128,
+        )
+    )
+    tracer = get_tracer()
+    assert engine.tracer is tracer and not tracer.enabled
+    tracer.reset()
+    engine.add_request(
+        "r0",
+        prompt_token_ids=[1, 5, 9],
+        sampling_params=SamplingParams(
+            temperature=0.0, max_tokens=4, ignore_eos=True
+        ),
+    )
+    while engine.has_unfinished_requests():
+        engine.step()
+    engine.shutdown()
+    # The whole run went through the no-op path: same singleton span,
+    # nothing recorded, nothing open.
+    assert tracer.span("x") is NOOP_SPAN
+    assert tracer.snapshot() == []
+    assert tracer.num_open_spans == 0
+
+
+def test_debug_traces_404_when_disabled():
+    get_tracer().configure(False)
+    state = ServerState(engine=None, model_name="x", max_model_len=1)
+
+    async def run():
+        server = TestServer(build_app(state))
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            r = await client.get("/debug/traces")
+            assert r.status == 404
+            body = await r.json()
+            assert "VDT_TRACING" in body["message"]
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(run())
+
+
+def test_debug_traces_rejects_negative_limit():
+    tracer = get_tracer().configure(True)
+    tracer.reset()
+    state = ServerState(engine=None, model_name="x", max_model_len=1)
+
+    async def run():
+        server = TestServer(build_app(state))
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            r = await client.get("/debug/traces?limit=-1")
+            assert r.status == 400
+            body = await r.json()
+            assert "non-negative" in body["message"]
+        finally:
+            await client.close()
+
+    try:
+        asyncio.new_event_loop().run_until_complete(run())
+    finally:
+        tracer.configure(False)
+
+
+# ---------------------------------------------------------------------
+# E2E acceptance: api → queue → prefill → decode → dispatch → worker
+# ---------------------------------------------------------------------
+class TracedMultiHostExecutor(MultiHostExecutor):
+    worker_cls = "tests.mock_worker.MockWorker"
+
+
+def _agent_with_env(port, env):
+    for k, v in (env or {}).items():
+        os.environ[k] = v
+    remote_main("127.0.0.1", port)
+
+
+@pytest.fixture
+def traced_app(tmp_path, monkeypatch):
+    """OpenAI app over AsyncLLM over the mocked 2-host executor with
+    tracing on; VDT_TRACING reaches the agent via env replication."""
+    port = get_open_port()
+    monkeypatch.setenv("VDT_SERVER_PORT", str(port))
+    monkeypatch.setenv("VDT_TRACING", "1")
+    monkeypatch.setenv("VDT_COMPILE_CACHE_DIR", str(tmp_path / "cc"))
+    get_tracer().reset()
+    agent = multiprocessing.Process(
+        target=_agent_with_env,
+        args=(
+            port,
+            {"VDT_ADVERTISE_NUM_CHIPS": "4", "VDT_ADVERTISE_PLATFORM": "cpu"},
+        ),
+        daemon=True,
+    )
+    agent.start()
+    engine = AsyncLLM.from_engine_args(
+        EngineArgs(
+            model=write_llama_config(str(tmp_path / "m")),
+            skip_tokenizer_init=True,
+            load_format="dummy",
+            num_hosts=2,
+            num_decode_steps=1,
+            max_model_len=512,
+            distributed_executor_backend=TracedMultiHostExecutor,
+        )
+    )
+    state = init_app_state(engine, served_model_name="tiny")
+    yield lambda: build_app(state)
+    engine.shutdown()
+    if agent.is_alive():
+        agent.terminate()
+    agent.join(timeout=5)
+    # Don't leak an enabled global tracer into later test files.
+    get_tracer().configure(False)
+    get_tracer().set_metrics_sink(None)
+    get_tracer().reset()
+
+
+def _span_index(trace):
+    by_name = {}
+    for span in trace["spans"]:
+        by_name.setdefault(span["name"], []).append(span)
+    return by_name
+
+
+def test_request_produces_one_linked_trace(traced_app):
+    async def go(client):
+        r = await client.post(
+            "/v1/completions",
+            json={"model": "tiny", "prompt": [1, 5, 9], "max_tokens": 4},
+        )
+        assert r.status == 200
+        trace_id = r.headers.get(TRACE_HEADER)
+        assert trace_id and len(trace_id) == 32
+
+        r = await client.get("/debug/traces")
+        assert r.status == 200
+        traces = (await r.json())["traces"]
+        trace = next(
+            t for t in traces if t["trace_id"] == trace_id
+        )
+        assert trace["complete"]
+        by_name = _span_index(trace)
+
+        # The acceptance chain, all in ONE trace.
+        for name in (
+            "api.request",
+            "engine.queue",
+            "engine.prefill",
+            "engine.decode",
+            "scheduler.schedule",
+            "executor.dispatch",
+            "executor.gather",
+            "worker.execute",
+        ):
+            assert name in by_name, (name, sorted(by_name))
+
+        root = by_name["api.request"][0]
+        assert root["parent_id"] is None
+        assert root["span_id"] == trace["root_span_id"]
+        span_ids = {s["span_id"] for s in trace["spans"]}
+
+        # queue/prefill/decode parent to the root; stages tile the
+        # request: queue ends where prefill starts, prefill where
+        # decode starts, all inside the root interval.
+        stages = {}
+        for name in ("engine.queue", "engine.prefill", "engine.decode"):
+            (span,) = by_name[name]
+            assert span["parent_id"] == root["span_id"]
+            stages[name] = span
+        q, p, d = (
+            stages["engine.queue"],
+            stages["engine.prefill"],
+            stages["engine.decode"],
+        )
+        assert abs(q["start"] + q["duration"] - p["start"]) < EPS
+        assert abs(p["start"] + p["duration"] - d["start"]) < EPS
+        root_end = root["start"] + root["duration"]
+        for s in (q, p, d):
+            assert s["start"] >= root["start"] - EPS
+            assert s["start"] + s["duration"] <= root_end + EPS
+
+        # Cross-RPC links: every worker-side span's parent is a
+        # driver-side dispatch span in this same trace.
+        dispatch_ids = {
+            s["span_id"] for s in by_name["executor.dispatch"]
+        }
+        workers = by_name["worker.execute"]
+        assert all(w["host"] == "host1" for w in workers)
+        assert all(w["parent_id"] in dispatch_ids for w in workers)
+        # Worker replies also landed (serialize + reply marker).
+        assert "worker.serialize" in by_name
+        assert "worker.reply" in by_name
+
+        # Step spans parent to the root (and dispatch carries the
+        # control-message payload size).
+        for s in by_name["scheduler.schedule"]:
+            assert s["parent_id"] == root["span_id"]
+            assert s["trace_id"] == trace_id
+        assert any(
+            s["attributes"].get("payload_bytes", 0) > 0
+            for s in by_name["executor.dispatch"]
+        )
+
+        # Timestamps are monotonic per host: sorting any host's spans
+        # by start gives non-negative durations and ordered starts.
+        for host in {s["host"] for s in trace["spans"]}:
+            spans = sorted(
+                (s for s in trace["spans"] if s["host"] == host),
+                key=lambda s: s["start"],
+            )
+            assert all((s["duration"] or 0.0) >= 0.0 for s in spans)
+
+        # Every span id referenced as a parent exists in the trace
+        # (except the root's None) — no dangling links across the
+        # RPC boundary.
+        for s in trace["spans"]:
+            if s["parent_id"] is not None and s["name"] not in (
+                "api.request",
+            ):
+                assert s["parent_id"] in span_ids, s
+
+        # Chrome export: valid trace-event JSON with both hosts.
+        r = await client.get("/debug/traces?format=chrome")
+        assert r.status == 200
+        chrome = json.loads(await r.text())
+        assert chrome["traceEvents"]
+        process_names = {
+            e["args"]["name"]
+            for e in chrome["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "process_name"
+        }
+        assert {"driver", "host1"} <= process_names
+
+        # Per-stage Prometheus histograms fed from the same spans.
+        r = await client.get("/metrics")
+        text = await r.text()
+        for family in (
+            "vllm:request_queue_time_seconds_count",
+            "vllm:request_prefill_time_seconds_count",
+            "vllm:request_decode_time_seconds_count",
+            "vllm:step_schedule_time_seconds_count",
+            "vllm:step_dispatch_time_seconds_count",
+            "vllm:step_gather_time_seconds_count",
+        ):
+            line = next(
+                ln for ln in text.splitlines() if ln.startswith(family)
+            )
+            assert float(line.split()[-1]) > 0, line
+
+        # No span leaked open once the request finished.
+        assert get_tracer().num_open_spans == 0
+
+    async def run():
+        server = TestServer(traced_app())
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            await go(client)
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(run())

@@ -1,0 +1,123 @@
+"""Offline per-stage latency summary of a /debug/traces dump.
+
+The command-line companion to Perfetto (ISSUE 5 satellite): point it at
+a saved ``/debug/traces`` JSON dump — or straight at a live server's
+endpoint URL — and it prints a per-stage p50/p90/p99 table, so "where
+did the latency go" is answerable from a terminal without loading a
+trace UI.
+
+    python tools/trace_summary.py traces.json
+    python tools/trace_summary.py http://localhost:8000/debug/traces
+
+Pure stdlib; the input is the ``{"traces": [...]}`` shape served by the
+API server (tracing.Tracer.snapshot).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+# Stages printed first, in pipeline order; any other span names found in
+# the dump follow alphabetically.
+_STAGE_ORDER = [
+    "api.request",
+    "engine.queue",
+    "engine.prefill",
+    "engine.decode",
+    "scheduler.schedule",
+    "executor.dispatch",
+    "executor.gather",
+    "worker.execute",
+    "worker.serialize",
+]
+
+
+def load_traces(source: str) -> list[dict]:
+    """Read a dump from a file path or an http(s) URL."""
+    if source.startswith(("http://", "https://")):
+        with urllib.request.urlopen(source, timeout=30) as resp:
+            payload = json.load(resp)
+    elif source == "-":
+        payload = json.load(sys.stdin)
+    else:
+        with open(source) as f:
+            payload = json.load(f)
+    if isinstance(payload, dict):
+        return payload.get("traces", [])
+    return payload
+
+
+def percentile(sorted_values: list[float], q: float) -> float:
+    """Nearest-rank percentile on an already-sorted list."""
+    if not sorted_values:
+        return 0.0
+    idx = min(
+        len(sorted_values) - 1, max(0, round(q * (len(sorted_values) - 1)))
+    )
+    return sorted_values[idx]
+
+
+def summarize(traces: list[dict]) -> dict[str, dict[str, float]]:
+    """Aggregate span durations by name: count/p50/p90/p99/max (s)."""
+    by_name: dict[str, list[float]] = {}
+    for trace in traces:
+        for span in trace.get("spans", []):
+            duration = span.get("duration")
+            if duration is None:
+                continue  # instant event (preemption/replay marker)
+            by_name.setdefault(span["name"], []).append(float(duration))
+    stats: dict[str, dict[str, float]] = {}
+    for name, durations in by_name.items():
+        durations.sort()
+        stats[name] = {
+            "count": len(durations),
+            "p50": percentile(durations, 0.50),
+            "p90": percentile(durations, 0.90),
+            "p99": percentile(durations, 0.99),
+            "max": durations[-1],
+        }
+    return stats
+
+
+def format_table(stats: dict[str, dict[str, float]]) -> str:
+    names = [n for n in _STAGE_ORDER if n in stats]
+    names += sorted(set(stats) - set(_STAGE_ORDER))
+    header = (
+        f"{'stage':<22} {'count':>7} {'p50(ms)':>10} {'p90(ms)':>10} "
+        f"{'p99(ms)':>10} {'max(ms)':>10}"
+    )
+    lines = [header, "-" * len(header)]
+    for name in names:
+        s = stats[name]
+        lines.append(
+            f"{name:<22} {int(s['count']):>7} {s['p50'] * 1e3:>10.2f} "
+            f"{s['p90'] * 1e3:>10.2f} {s['p99'] * 1e3:>10.2f} "
+            f"{s['max'] * 1e3:>10.2f}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="per-stage p50/p90/p99 from a /debug/traces dump"
+    )
+    parser.add_argument(
+        "source",
+        help="dump file, '-' for stdin, or a /debug/traces URL",
+    )
+    args = parser.parse_args(argv)
+    traces = load_traces(args.source)
+    if not traces:
+        print("no traces in dump (is tracing enabled on the server?)")
+        return 1
+    stats = summarize(traces)
+    print(f"{len(traces)} trace(s)")
+    print(format_table(stats))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

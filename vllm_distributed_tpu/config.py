@@ -18,6 +18,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Any
 
+from vllm_distributed_tpu import envs
 from vllm_distributed_tpu.logger import init_logger
 
 logger = init_logger(__name__)
@@ -299,6 +300,13 @@ class DeviceConfig:
 class ObservabilityConfig:
     collect_metrics: bool = True
     profile_dir: str | None = None
+    # Per-request tracing (tracing.py): root span per API request,
+    # queue/prefill/decode spans, per-step schedule/dispatch/gather
+    # spans, and worker-side RPC spans merged across hosts.  Default
+    # off: the engine loop runs the no-op tracer path.
+    enable_tracing: bool = False
+    # Completed traces kept in the in-memory ring (/debug/traces).
+    trace_ring_size: int = 256
 
 
 @dataclass
@@ -379,6 +387,9 @@ class EngineArgs:
     device: str = "auto"
     profile_dir: str | None = None
     disable_log_stats: bool = False
+    # None -> resolved late from VDT_TRACING so the env var works on
+    # both the CLI and the programmatic path.
+    enable_tracing: bool | None = None
 
     @staticmethod
     def add_cli_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
@@ -469,6 +480,14 @@ class EngineArgs:
         parser.add_argument("--profile-dir", type=str, default=None)
         parser.add_argument("--disable-log-stats", action="store_true")
         parser.add_argument(
+            "--enable-tracing",
+            action="store_true",
+            default=None,
+            help="per-request tracing: /debug/traces (JSON + Perfetto), "
+            "per-stage latency histograms, cross-host RPC spans "
+            "(default: $VDT_TRACING or off)",
+        )
+        parser.add_argument(
             "--kv-transfer-config",
             type=str,
             default=None,
@@ -543,6 +562,12 @@ class EngineArgs:
             observability_config=ObservabilityConfig(
                 collect_metrics=not self.disable_log_stats,
                 profile_dir=self.profile_dir,
+                enable_tracing=(
+                    envs.VDT_TRACING
+                    if self.enable_tracing is None
+                    else self.enable_tracing
+                ),
+                trace_ring_size=envs.VDT_TRACE_RING_SIZE,
             ),
             kv_transfer_config=kv_transfer,
         )

@@ -183,16 +183,24 @@ async def agent_async_main(server_ip: str, port: int | None = None) -> None:
         }
 
     def ping(payload: Any = None) -> Any:
-        """Driver liveness probe: echoes the payload so the driver can
-        measure RTT, and refreshes the server-silence watchdog."""
+        """Driver liveness probe: echoes the payload (RTT measurement)
+        plus this host's wall clock, so the driver can estimate the
+        per-host clock offset used to place worker-side trace spans on
+        its own timeline; refreshes the server-silence watchdog."""
         hb["last_contact"] = time.monotonic()
-        return payload
+        return (payload, time.time())
 
     async def create_worker(
         config, rank, num_hosts, distributed_init_method, env, worker_cls
     ):
         for key, value in (env or {}).items():
             os.environ[key] = value
+        # The driver's tracing config just arrived with the replicated
+        # env: spans recorded while serving its RPCs are labeled with
+        # this host's rank and shipped back inside reply frames.
+        from vllm_distributed_tpu.tracing import configure_from_env
+
+        configure_from_env(host=f"host{rank}")
         cls = _resolve_worker_cls(worker_cls)
         worker = cls(
             config,

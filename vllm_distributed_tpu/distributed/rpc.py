@@ -24,6 +24,7 @@ import traceback
 import weakref
 from typing import Any, Callable
 
+from vllm_distributed_tpu import tracing
 from vllm_distributed_tpu.logger import init_logger
 
 logger = init_logger(__name__)
@@ -189,8 +190,18 @@ class RpcPeer:
             "kwargs": self._serialize(kwargs),
         }
         if oneway:
+            # No reply frame, so no trace context either: worker spans
+            # could never ship back.
             msg["oneway"] = True
             return self._send(msg)
+        # Trace propagation (tracing.py): the caller's active span
+        # context rides inside the frame, so the remote side's
+        # execute/serialize/reply spans land in the SAME trace with
+        # parent/child links across the RPC boundary.  One contextvar
+        # read when tracing is off.
+        ctx = tracing.current_ctx()
+        if ctx is not None and tracing.get_tracer().enabled:
+            msg["trace"] = [ctx[0], ctx[1]]
         reply_id = self._next_id()
         msg["id"] = reply_id
 
@@ -279,18 +290,59 @@ class RpcPeer:
     async def _handle_apply(self, msg: dict) -> None:
         oneway = msg.get("oneway", False)
         reply = {"type": "result", "id": msg.get("id")}
+        # Inbound trace context (see _apply): wrap the local execution
+        # in worker-side spans and ship them back inside the reply frame
+        # — they are `record=False` so this process accumulates no
+        # orphan traces for work it performed on another host's behalf.
+        tracer = tracing.get_tracer()
+        trace = msg.get("trace") if not oneway else None
+        parent = (
+            (trace[0], trace[1])
+            if trace is not None and tracer.enabled
+            else None
+        )
+        spans = []
         try:
             target = self._local_proxied[msg["proxyId"]]
             method = msg.get("method")
             fn = getattr(target, method) if method else target
             args = self._deserialize(msg.get("args") or [])
             kwargs = self._deserialize(msg.get("kwargs") or {})
-            value = fn(*args, **kwargs)
-            if inspect.isawaitable(value):
-                value = await value
+            if parent is not None:
+                # try/finally so a raising call still ships its (error-
+                # annotated) span back to the driver in the error reply.
+                sp = None
+                try:
+                    with tracer.span(
+                        "worker.execute",
+                        parent=parent,
+                        record=False,
+                        method=str(method or "__call__"),
+                    ) as sp:
+                        value = fn(*args, **kwargs)
+                        if inspect.isawaitable(value):
+                            value = await value
+                finally:
+                    if sp is not None:
+                        spans.append(sp)
+            else:
+                value = fn(*args, **kwargs)
+                if inspect.isawaitable(value):
+                    value = await value
             if oneway:
                 return
-            reply["result"] = self._serialize(value)
+            if parent is not None:
+                sp = None
+                try:
+                    with tracer.span(
+                        "worker.serialize", parent=parent, record=False
+                    ) as sp:
+                        reply["result"] = self._serialize(value)
+                finally:
+                    if sp is not None:
+                        spans.append(sp)
+            else:
+                reply["result"] = self._serialize(value)
         except Exception as e:  # noqa: BLE001
             if oneway:
                 logger.exception(
@@ -298,9 +350,18 @@ class RpcPeer:
                 )
                 return
             reply.update(_serialize_error(e))
+        if parent is not None:
+            reply["trace_spans"] = [s.to_wire() for s in spans] + [
+                tracer.stamp("worker.reply", parent)
+            ]
         await self._send(reply)
 
     def _handle_result(self, msg: dict) -> None:
+        spans = msg.get("trace_spans")
+        if spans:
+            # Worker-side spans riding the reply frame: merge them into
+            # the local trace (clock-offset corrected per host).
+            tracing.get_tracer().adopt(spans)
         fut = self._pending.pop(msg.get("id"), None)
         if fut is None or fut.done():
             return

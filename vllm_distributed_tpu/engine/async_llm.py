@@ -341,6 +341,7 @@ class AsyncLLM:
         prompt: str | None = None,
         prompt_token_ids: list[int] | None = None,
         sampling_params: SamplingParams | None = None,
+        trace_ctx: tuple | None = None,
     ) -> AsyncIterator[RequestOutput]:
         """Feed a request and yield cumulative RequestOutputs until
         finished.  Cancellation (client disconnect) aborts the request.
@@ -371,6 +372,7 @@ class AsyncLLM:
                 sampling_params=(
                     sampling_params or SamplingParams()
                 ).clone(),
+                trace_ctx=trace_ctx,
             )
         try:
             if self._dead is not None:
@@ -385,6 +387,7 @@ class AsyncLLM:
                         prompt=prompt,
                         prompt_token_ids=prompt_token_ids,
                         sampling_params=sampling_params,
+                        trace_ctx=trace_ctx,
                     ),
                 )
             )

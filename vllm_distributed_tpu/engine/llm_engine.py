@@ -26,6 +26,7 @@ from vllm_distributed_tpu.tokenizer import (
     IncrementalDetokenizer,
     get_tokenizer,
 )
+from vllm_distributed_tpu.tracing import get_tracer
 
 logger = init_logger(__name__)
 
@@ -70,6 +71,16 @@ class LLMEngine:
         # A rebuilt engine (engine/supervisor.py) inherits the previous
         # engine's EngineMetrics so counters/histograms span restarts.
         self.metrics = metrics
+        # Tracing (tracing.py): the global tracer is configured from
+        # ObservabilityConfig; with tracing off every call below is the
+        # allocation-free no-op path.  The metrics sink is a single slot,
+        # so supervisor rebuilds re-register the same EngineMetrics
+        # without stacking.
+        obs = config.observability_config
+        self.tracer = get_tracer().configure(
+            enabled=obs.enable_tracing, ring_size=obs.trace_ring_size
+        )
+        self.tracer.set_metrics_sink(self.metrics.observe_span)
         # Liveness instruments (host_up, heartbeat latency) are emitted
         # from the executor's heartbeat loop.
         self.executor.metrics = self.metrics
@@ -126,6 +137,7 @@ class LLMEngine:
         sampling_params: SamplingParams | None = None,
         prompt_token_ids: list[int] | None = None,
         arrival_time: float | None = None,
+        trace_ctx: tuple | None = None,
     ) -> None:
         sampling_params = sampling_params or SamplingParams()
         if prompt_token_ids is None:
@@ -150,6 +162,7 @@ class LLMEngine:
             sampling_params=sampling_params,
             prompt=prompt,
             eos_token_id=eos,
+            trace_ctx=trace_ctx,
         )
         self.scheduler.add_request(req)
         if (
@@ -251,7 +264,7 @@ class LLMEngine:
         outputs.extend(self._finalize_done())
         if self._pending and not self._pipeline_safe():
             outputs.extend(self._drain_pending())
-        scheduler_output = self.scheduler.schedule()
+        scheduler_output = self._schedule()
         if scheduler_output.is_empty:
             # Typically every request's remaining budget is in flight:
             # block on the HEAD dispatch only, so tokens keep streaming
@@ -273,6 +286,94 @@ class LLMEngine:
         outputs.extend(self._process(scheduler_output, runner_output))
         return outputs
 
+    def _schedule(self):
+        """One scheduler pass, wrapped in a per-step schedule span with
+        the batch composition attached (parented to the first traced
+        request in the batch; tracing off = plain call)."""
+        if not self.tracer.enabled:
+            return self.scheduler.schedule()
+        start_wall = time.time()
+        t0 = time.monotonic()
+        scheduler_output = self.scheduler.schedule()
+        self.tracer.record_span(
+            "scheduler.schedule",
+            start_wall,
+            time.monotonic() - t0,
+            parent=scheduler_output.trace_ctx,
+            step_id=scheduler_output.step_id,
+            num_new=len(scheduler_output.new_requests),
+            num_cached=len(scheduler_output.cached_requests),
+            num_preempted=len(scheduler_output.preempted_req_ids),
+            decode_steps=scheduler_output.decode_steps,
+            total_tokens=scheduler_output.total_num_scheduled_tokens,
+            batch=",".join(
+                f"{rid}:{n}"
+                for rid, n in scheduler_output.num_scheduled_tokens.items()
+            ),
+        )
+        return scheduler_output
+
+    def _record_stage(
+        self, req: Request, name: str, start_mono: float, end_mono: float
+    ) -> None:
+        """Synthesize one request-stage span from monotonic stamps.  The
+        wall-clock start is derived from the arrival anchor + monotonic
+        delta, so span starts are NTP-consistent with the durations."""
+        m = req.metrics
+        self.tracer.record_span(
+            name,
+            m.arrival_time + (start_mono - m.arrival_time_mono),
+            max(end_mono - start_mono, 0.0),
+            parent=req.trace_ctx,
+            request_id=req.request_id,
+        )
+
+    def _record_request_spans(
+        self, req: Request, now_mono: float, finished: bool
+    ) -> None:
+        """Stage spans at the two request milestones: queue+prefill when
+        the first token lands, decode at finish.  A request finishing
+        without ever producing a token (e.g. stop-string truncation to
+        zero) still gets its earlier stages recorded at finish."""
+        m = req.metrics
+        first_sched = (
+            m.first_scheduled_time_mono
+            if m.first_scheduled_time_mono is not None
+            else now_mono
+        )
+        if m.first_token_time_mono == now_mono and not finished:
+            self._record_stage(
+                req, "engine.queue", m.arrival_time_mono, first_sched
+            )
+            self._record_stage(req, "engine.prefill", first_sched, now_mono)
+            return
+        if not finished:
+            return
+        if m.first_token_time_mono is None:
+            self._record_stage(
+                req, "engine.queue", m.arrival_time_mono, first_sched
+            )
+            self._record_stage(req, "engine.prefill", first_sched, now_mono)
+        else:
+            if m.first_token_time_mono == now_mono:
+                # First token and finish in the same step.
+                self._record_stage(
+                    req, "engine.queue", m.arrival_time_mono, first_sched
+                )
+                self._record_stage(
+                    req, "engine.prefill", first_sched, m.first_token_time_mono
+                )
+            self._record_stage(
+                req, "engine.decode", m.first_token_time_mono, now_mono
+            )
+        self.tracer.event(
+            req.trace_ctx,
+            "engine.finished",
+            request_id=req.request_id,
+            finish_reason=FINISH_REASON.get(req.status, "?"),
+            num_output_tokens=req.num_output_tokens,
+        )
+
     def _process(
         self, scheduler_output, runner_output
     ) -> list[RequestOutput]:
@@ -280,6 +381,7 @@ class LLMEngine:
             scheduler_output, runner_output.sampled_token_ids
         )
         now = time.time()
+        now_mono = time.monotonic()
         self.metrics.record_queues(
             len(self.scheduler.running), len(self.scheduler.waiting)
         )
@@ -319,6 +421,7 @@ class LLMEngine:
                 self.metrics.record_prompt_tokens(n_prefill)
             if new_tokens and req.metrics.first_token_time is None:
                 req.metrics.first_token_time = now
+                req.metrics.first_token_time_mono = now_mono
                 # The final prefill chunk samples a token and reports no
                 # num_prompt_tokens_processed: count the remainder here.
                 rest = req.num_prompt_tokens - req.metrics.prompt_tokens_counted
@@ -326,7 +429,7 @@ class LLMEngine:
                     req.metrics.prompt_tokens_counted += rest
                     self.metrics.record_prompt_tokens(rest)
             self.metrics.record_new_tokens(
-                req.metrics, len(new_tokens), now
+                req.metrics, len(new_tokens), now_mono
             )
             if req_id in runner_output.logprobs and req.logprobs is not None:
                 lps = runner_output.logprobs[req_id]
@@ -356,6 +459,11 @@ class LLMEngine:
 
             if req.status.is_finished:
                 req.metrics.finished_time = now
+                req.metrics.finished_time_mono = now_mono
+            if self.tracer.enabled:
+                self._record_request_spans(
+                    req, now_mono, req.status.is_finished
+                )
             outputs.append(self._make_output(req, detok))
 
         for req in finished:
@@ -408,6 +516,7 @@ class LLMEngine:
         )
 
     def shutdown(self) -> None:
+        self.tracer.clear_metrics_sink(self.metrics.observe_span)
         self.executor.shutdown()
 
     # Introspection used by the API layer.

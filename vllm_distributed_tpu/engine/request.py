@@ -62,9 +62,14 @@ class Request:
     # Cumulative logprobs bookkeeping (filled only when requested).
     logprobs: list[dict[int, float]] | None = None
     cumulative_logprob: float = 0.0
+    # (trace_id, span_id) of the caller's root span (tracing.py); the
+    # engine parents this request's queue/prefill/decode spans and
+    # preemption/replay events to it.  None = untraced.
+    trace_ctx: tuple | None = None
 
     def __post_init__(self) -> None:
         self.metrics.arrival_time = time.time()
+        self.metrics.arrival_time_mono = self.arrival_time
         if self.sampling_params.logprobs is not None:
             self.logprobs = []
 

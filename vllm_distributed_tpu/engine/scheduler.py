@@ -20,6 +20,7 @@ what CustomExecutor.execute_model receives at launch.py:322).  Design:
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -32,6 +33,7 @@ from vllm_distributed_tpu.engine.block_manager import (
 from vllm_distributed_tpu.engine.request import Request, RequestStatus
 from vllm_distributed_tpu.logger import init_logger
 from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.tracing import get_tracer
 
 logger = init_logger(__name__)
 
@@ -74,6 +76,11 @@ class SchedulerOutput:
     # >1 = every scheduled request is a decode and the worker runs this
     # many fused decode micro-steps on device (one sampled token each).
     decode_steps: int = 1
+    # Trace context of the first scheduled traced request, if any: the
+    # parent for this step's schedule/dispatch/gather spans (a step
+    # serves a batch, so one trace adopts the step; the others link via
+    # the schedule span's batch attributes).
+    trace_ctx: tuple | None = None
 
     @property
     def is_empty(self) -> bool:
@@ -246,6 +253,8 @@ class Scheduler:
             out.num_scheduled_tokens[req.request_id] = num_new
             out.total_num_scheduled_tokens += num_new
             token_budget -= num_new
+            if out.trace_ctx is None:
+                out.trace_ctx = req.trace_ctx
             out.cached_requests.append(
                 CachedRequestData(
                     req_id=req.request_id,
@@ -313,15 +322,16 @@ class Scheduler:
                     req.num_computed_tokens = hit_tokens
             new_pages = self.allocator.allocate(req, num_new)
             if req.status == RequestStatus.WAITING:
-                import time as _time
-
-                req.metrics.first_scheduled_time = _time.time()
+                req.metrics.first_scheduled_time = time.time()
+                req.metrics.first_scheduled_time_mono = time.monotonic()
             resumed = req.status == RequestStatus.PREEMPTED
             req.status = RequestStatus.RUNNING
             self.running.append(req)
             out.num_scheduled_tokens[req.request_id] = num_new
             out.total_num_scheduled_tokens += num_new
             token_budget -= num_new
+            if out.trace_ctx is None:
+                out.trace_ctx = req.trace_ctx
             out.new_requests.append(
                 NewRequestData(
                     req_id=req.request_id,
@@ -378,6 +388,12 @@ class Scheduler:
     def _preempt(self, req: Request, preempted: set[str]) -> None:
         logger.debug("preempting request %s", req.request_id)
         self.num_preemptions += 1
+        get_tracer().event(
+            req.trace_ctx,
+            "engine.preempted",
+            request_id=req.request_id,
+            num_tokens=req.num_tokens,
+        )
         self.allocator.free(req)
         req.status = RequestStatus.PREEMPTED
         req.num_computed_tokens = 0

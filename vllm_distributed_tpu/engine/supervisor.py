@@ -44,6 +44,7 @@ from vllm_distributed_tpu.engine.request import RequestStatus
 from vllm_distributed_tpu.logger import init_logger
 from vllm_distributed_tpu.outputs import RequestOutput
 from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.tracing import get_tracer
 
 logger = init_logger(__name__)
 
@@ -70,6 +71,9 @@ class JournalEntry:
     # drain, and replaying it too would admit it twice.
     admitted: bool = False
     replays: int = 0
+    # Root trace context (tracing.py): the replayed request keeps
+    # tracing into the same trace, and the replay itself is an event.
+    trace_ctx: tuple | None = None
 
     def observe(self, out: RequestOutput) -> None:
         """Record one cumulative output about to be handed to the
@@ -120,6 +124,7 @@ class JournalEntry:
                 else None
             ),
             sampling_params=self.sampling_params.clone(),
+            trace_ctx=self.trace_ctx,
         )
         if not self.emitted_token_ids:
             return
@@ -349,4 +354,11 @@ class EngineSupervisor:
                 llm._to_request_queue(entry.request_id, e)
             else:
                 replayed += 1
+                get_tracer().event(
+                    entry.trace_ctx,
+                    "engine.replayed",
+                    request_id=entry.request_id,
+                    replays=entry.replays,
+                    emitted_tokens=len(entry.emitted_token_ids),
+                )
         return replayed

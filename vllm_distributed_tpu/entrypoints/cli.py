@@ -267,8 +267,14 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                     # Token-bearing chunks: anything before the finish
                     # marker ("text" may be empty when the server runs
                     # without a tokenizer, e.g. dummy-weight benches).
-                    if choice is not None and not choice.get(
-                        "finish_reason"
+                    # A request whose whole completion lands in ONE
+                    # finish-bearing chunk (stream starved while the
+                    # engine raced ahead) still delivered its first
+                    # token THEN — count it, or cold requests silently
+                    # vanish from the client TTFT distribution.
+                    if choice is not None and (
+                        not choice.get("finish_reason")
+                        or not chunk_times
                     ):
                         chunk_times.append(time.perf_counter())
         if chunk_times:

@@ -56,10 +56,15 @@ from vllm_distributed_tpu.entrypoints.openai.tool_parsers import (
 )
 from vllm_distributed_tpu.logger import init_logger
 from vllm_distributed_tpu.outputs import RequestOutput
+from vllm_distributed_tpu.tracing import get_tracer
 from vllm_distributed_tpu.utils import Counter
 from vllm_distributed_tpu.version import __version__
 
 logger = init_logger(__name__)
+
+# Response header echoing the request's 128-bit trace id; look it up in
+# /debug/traces (or your OTLP backend) to see where the latency went.
+TRACE_HEADER = "X-VDT-Trace-Id"
 
 
 @dataclass
@@ -79,6 +84,10 @@ class ServerState:
 # same split vLLM's build_app auth middleware makes.
 _UNAUTHENTICATED = {"/health", "/ping", "/version", "/metrics"}
 
+# Probe/scrape endpoints never open a root span (they would drown the
+# trace ring in noise and trace nothing request-shaped).
+_UNTRACED = {"/health", "/ping", "/version", "/metrics", "/debug/traces"}
+
 
 @web.middleware
 async def auth_middleware(request: web.Request, handler):
@@ -90,6 +99,32 @@ async def auth_middleware(request: web.Request, handler):
         if not hmac.compare_digest(got, expect):
             return _error("invalid or missing API key", 401)
     return await handler(request)
+
+
+@web.middleware
+async def trace_middleware(request: web.Request, handler):
+    """Root span per API request (tracing.py).  The trace id is echoed
+    in the X-VDT-Trace-Id response header; handlers pick the context up
+    from ``request['trace_ctx']`` and thread it through the engine so
+    queue/prefill/decode/RPC spans share the trace.  With tracing off
+    this is one attribute read per request."""
+    tracer = get_tracer()
+    if not tracer.enabled or request.path in _UNTRACED:
+        return await handler(request)
+    with tracer.span(
+        "api.request",
+        trace_root=True,
+        method=request.method,
+        path=request.path,
+    ) as span:
+        request["trace_ctx"] = span.ctx
+        response = await handler(request)
+        span.set_attribute("status", response.status)
+    if not response.prepared:
+        # Streamed (SSE) responses set the header themselves before
+        # prepare(); everything else gets it stamped here.
+        response.headers[TRACE_HEADER] = span.ctx[0]
+    return response
 
 
 # ---- helpers ----
@@ -290,6 +325,7 @@ async def chat_completions(request: web.Request) -> web.Response:
                         prompt=None if prompt_ids else prompt,
                         prompt_token_ids=prompt_ids,
                         sampling_params=params.clone(),
+                        trace_ctx=request.get("trace_ctx"),
                     )
                 )
                 for i in range(req.n)
@@ -332,13 +368,15 @@ async def chat_completions(request: web.Request) -> web.Response:
 async def _stream_chat(
     request, state, req, request_id, prompt_ids, prompt, params
 ) -> web.StreamResponse:
-    response = web.StreamResponse(
-        headers={
-            "Content-Type": "text/event-stream",
-            "Cache-Control": "no-cache",
-            "Connection": "keep-alive",
-        }
-    )
+    headers = {
+        "Content-Type": "text/event-stream",
+        "Cache-Control": "no-cache",
+        "Connection": "keep-alive",
+    }
+    trace_ctx = request.get("trace_ctx")
+    if trace_ctx is not None:
+        headers[TRACE_HEADER] = trace_ctx[0]
+    response = web.StreamResponse(headers=headers)
     await response.prepare(request)
 
     async def send(obj) -> None:
@@ -390,6 +428,7 @@ async def _stream_chat(
             prompt=None if prompt_ids else prompt,
             prompt_token_ids=prompt_ids,
             sampling_params=params.clone(),
+            trace_ctx=trace_ctx,
         ):
             comp = out.outputs[0]
             delta_text = comp.text[sent:]
@@ -498,6 +537,7 @@ async def completions(request: web.Request) -> web.Response:
                         prompt=text,
                         prompt_token_ids=ids,
                         sampling_params=params.clone(),
+                        trace_ctx=request.get("trace_ctx"),
                     )
                 )
             )
@@ -559,12 +599,14 @@ async def completions(request: web.Request) -> web.Response:
 async def _stream_completion(
     request, state, req, request_id, resolved, params
 ) -> web.StreamResponse:
-    response = web.StreamResponse(
-        headers={
-            "Content-Type": "text/event-stream",
-            "Cache-Control": "no-cache",
-        }
-    )
+    headers = {
+        "Content-Type": "text/event-stream",
+        "Cache-Control": "no-cache",
+    }
+    trace_ctx = request.get("trace_ctx")
+    if trace_ctx is not None:
+        headers[TRACE_HEADER] = trace_ctx[0]
+    response = web.StreamResponse(headers=headers)
     await response.prepare(request)
 
     async def send_json(payload: str) -> None:
@@ -584,6 +626,7 @@ async def _stream_completion(
             prompt=text,
             prompt_token_ids=ids,
             sampling_params=params.clone(),
+            trace_ctx=trace_ctx,
         ):
             comp = out.outputs[0]
             delta = comp.text[sent:]
@@ -649,6 +692,38 @@ async def metrics(request: web.Request) -> web.Response:
     return web.Response(
         body=state.engine.metrics.render(), content_type="text/plain"
     )
+
+
+async def debug_traces(request: web.Request) -> web.Response:
+    """Recent completed request traces (tracing.py ring buffer).
+
+    ``?format=chrome`` returns Chrome trace-event JSON that loads
+    directly in Perfetto (https://ui.perfetto.dev) or chrome://tracing;
+    the default JSON form is what tools/trace_summary.py consumes.
+    ``?trace_id=<id>`` fetches one trace; ``?limit=N`` bounds the dump.
+    404 with a documented body while tracing is disabled."""
+    tracer = get_tracer()
+    if not tracer.enabled:
+        return _error(
+            "tracing disabled: start with --enable-tracing or "
+            "VDT_TRACING=1 to populate /debug/traces",
+            404,
+        )
+    trace_id = request.query.get("trace_id")
+    if trace_id:
+        trace = tracer.get_trace(trace_id)
+        if trace is None:
+            return _error(f"trace {trace_id!r} not found", 404)
+        return web.json_response({"traces": [trace]})
+    try:
+        limit = int(request.query.get("limit", "0")) or None
+    except ValueError:
+        return _error("limit must be an integer")
+    if limit is not None and limit < 0:
+        return _error("limit must be a non-negative integer")
+    if request.query.get("format") == "chrome":
+        return web.json_response(tracer.to_chrome(limit))
+    return web.json_response({"traces": tracer.snapshot(limit)})
 
 
 async def embeddings(request: web.Request) -> web.Response:
@@ -743,7 +818,8 @@ async def tokenizer_info(request: web.Request) -> web.Response:
 # ---- app assembly ----
 def build_app(state: ServerState) -> web.Application:
     app = web.Application(
-        client_max_size=64 * 2**20, middlewares=[auth_middleware]
+        client_max_size=64 * 2**20,
+        middlewares=[auth_middleware, trace_middleware],
     )
     app["state"] = state
     app.router.add_get("/health", health)
@@ -757,6 +833,7 @@ def build_app(state: ServerState) -> web.Application:
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/embeddings", embeddings)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/traces", debug_traces)
     return app
 
 

@@ -73,6 +73,17 @@ environment_variables: dict[str, Callable[[], Any]] = {
     "VDT_CRASH_LOOP_WINDOW_SECONDS": lambda: float(
         os.environ.get("VDT_CRASH_LOOP_WINDOW_SECONDS", "300")
     ),
+    # --- observability ---
+    # Per-request tracing (tracing.py): default off; the engine step
+    # loop runs the no-op tracer path and /debug/traces answers 404.
+    # Replicated to agents so worker-side RPC spans land in the same
+    # trace as the driver's.
+    "VDT_TRACING": lambda: os.environ.get("VDT_TRACING", "0").lower()
+    not in ("", "0", "false", "off"),
+    # Completed traces kept in memory (bounded ring; oldest evicted).
+    "VDT_TRACE_RING_SIZE": lambda: int(
+        os.environ.get("VDT_TRACE_RING_SIZE", "256")
+    ),
     # --- engine ---
     "VDT_LOG_LEVEL": lambda: os.environ.get("VDT_LOG_LEVEL", "INFO"),
     "VDT_COMPILE_CACHE_DIR": lambda: os.environ.get(

@@ -60,6 +60,7 @@ from vllm_distributed_tpu.distributed.rpc_transport import (
 )
 from vllm_distributed_tpu.executor.abstract import Executor
 from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.tracing import NOOP_SPAN, get_tracer
 from vllm_distributed_tpu.utils import (
     get_distributed_init_method,
     get_ip,
@@ -323,6 +324,16 @@ class MultiHostExecutor(Executor):
 
     async def _create_remote_workers(self) -> None:
         env = envs.replication_env()
+        # The driver's RESOLVED tracing config wins over whatever
+        # VDT_TRACING literal happens to sit in its environment (e.g.
+        # VDT_TRACING=0 + --enable-tracing): agents must agree with the
+        # driver or every trace silently loses its worker-side spans.
+        obs = self.config.observability_config
+        if getattr(obs, "enable_tracing", False):
+            env["VDT_TRACING"] = "1"
+            env.setdefault(
+                "VDT_TRACE_RING_SIZE", str(obs.trace_ring_size)
+            )
         for host in self._remote_hosts:
             # Left pointing at the failing host on exception: _boot reads
             # it AFTER .result() re-raises, so no finally-clear here (it
@@ -375,15 +386,30 @@ class MultiHostExecutor(Executor):
             return
         misses = 0
         seq = 0
+        tracer = get_tracer()
         while not host.peer.killed:
             t0 = time.monotonic()
+            wall0 = time.time()
             seq += 1
             try:
-                await apply_with_timeout(ping, interval, seq)
+                pong = await apply_with_timeout(ping, interval, seq)
+                rtt = time.monotonic() - t0
                 misses = 0
                 if self.metrics is not None:
-                    self.metrics.record_heartbeat(
-                        host.host_rank, time.monotonic() - t0
+                    self.metrics.record_heartbeat(host.host_rank, rtt)
+                if (
+                    tracer.enabled
+                    and isinstance(pong, (list, tuple))
+                    and len(pong) == 2
+                ):
+                    # The pong carries the agent's wall clock; assuming
+                    # a symmetric path, it was read mid-RTT.  Low-RTT
+                    # samples give the per-host offset used to place
+                    # worker-side trace spans on the driver's timeline.
+                    tracer.set_clock_offset(
+                        f"host{host.host_rank}",
+                        pong[1] - (wall0 + rtt / 2.0),
+                        rtt,
                     )
             except asyncio.TimeoutError:
                 misses += 1
@@ -450,26 +476,36 @@ class MultiHostExecutor(Executor):
         kwargs = kwargs or {}
         timeout = timeout or self.execute_timeout
 
-        local_fut = self._local_pool.submit(
-            run_method, self._local_worker, method, args, kwargs
-        )
-        live = [h for h in self._remote_hosts if h.worker is not None]
-        remote_futs = [
-            asyncio.run_coroutine_threadsafe(
-                host.worker.run(method, args, kwargs), self._loop
+        trace_ctx = self._step_trace_ctx(method, args)
+        payload = self._payload_bytes(args) if trace_ctx is not None else None
+        with self._dispatch_span(trace_ctx, 0, method, payload):
+            local_fut = self._local_pool.submit(
+                run_method, self._local_worker, method, args, kwargs
             )
-            for host in live
-        ]
+        live = [h for h in self._remote_hosts if h.worker is not None]
+        remote_futs = []
+        for host in live:
+            # The dispatch span is the parent the worker-side spans
+            # attach to: host.worker.run builds the RPC frame inside
+            # this block, so the frame carries the span's context.
+            with self._dispatch_span(
+                trace_ctx, host.host_rank, method, payload
+            ):
+                remote_futs.append(
+                    asyncio.run_coroutine_threadsafe(
+                        host.worker.run(method, args, kwargs), self._loop
+                    )
+                )
         futures = [local_fut, *remote_futs]
         origins = [_LOCAL_ORIGIN] + [(h.host_rank, h.address) for h in live]
 
         if non_block:
             return self._gather_pool.submit(
                 self._gather, futures, origins, unique_reply_rank, timeout,
-                _phase,
+                _phase, trace_ctx,
             )
         return self._gather(futures, origins, unique_reply_rank, timeout,
-                            _phase)
+                            _phase, trace_ctx)
 
     def execute_model(self, scheduler_output, non_block: bool = False):
         """Blocking path: one collective execute_model RPC.  Pipelined
@@ -489,21 +525,44 @@ class MultiHostExecutor(Executor):
         if self.is_failed:
             raise RuntimeError("Executor failed.")
         step_id = scheduler_output.step_id
-        local_d = self._local_pool.submit(
-            run_method,
-            self._local_worker,
-            "dispatch_model",
-            (scheduler_output,),
-            {},
+        trace_ctx = self._step_trace_ctx("dispatch_model", (scheduler_output,))
+        payload = (
+            self._payload_bytes((scheduler_output,))
+            if trace_ctx is not None
+            else None
         )
-        live = [h for h in self._remote_hosts if h.worker is not None]
-        remote_d = [
-            asyncio.run_coroutine_threadsafe(
-                host.worker.run("dispatch_model", (scheduler_output,), {}),
-                self._loop,
+        with self._dispatch_span(trace_ctx, 0, "dispatch_model", payload):
+            local_d = self._local_pool.submit(
+                run_method,
+                self._local_worker,
+                "dispatch_model",
+                (scheduler_output,),
+                {},
             )
-            for host in live
-        ]
+        live = [h for h in self._remote_hosts if h.worker is not None]
+        remote_d = []
+        remote_f = []
+        for host in live:
+            # Both phase RPCs of one host parent to its dispatch span
+            # (the frames are built inside this block), so worker-side
+            # dispatch AND fetch spans chain into the step's trace.
+            with self._dispatch_span(
+                trace_ctx, host.host_rank, "dispatch_model", payload
+            ):
+                remote_d.append(
+                    asyncio.run_coroutine_threadsafe(
+                        host.worker.run(
+                            "dispatch_model", (scheduler_output,), {}
+                        ),
+                        self._loop,
+                    )
+                )
+                remote_f.append(
+                    asyncio.run_coroutine_threadsafe(
+                        host.worker.run("fetch_results", (step_id,), {}),
+                        self._loop,
+                    )
+                )
 
         def _local_fetch():
             local_d.result()  # dispatch errors surface here, in order
@@ -512,12 +571,6 @@ class MultiHostExecutor(Executor):
             )
 
         local_f = self._local_fetch_pool.submit(_local_fetch)
-        remote_f = [
-            asyncio.run_coroutine_threadsafe(
-                host.worker.run("fetch_results", (step_id,), {}), self._loop
-            )
-            for host in live
-        ]
         remote_origins = [(h.host_rank, h.address) for h in live]
         return self._gather_pool.submit(
             self._gather,
@@ -526,24 +579,68 @@ class MultiHostExecutor(Executor):
             0,  # host 0 (local driver) holds the canonical output
             self.execute_timeout,
             PHASE_EXECUTE,
+            trace_ctx,
         )
 
-    def _gather(self, futures, origins, unique_reply_rank, timeout, phase):
+    def _step_trace_ctx(self, method: str, args: tuple):
+        """Trace context for a step-shaped collective: the scheduler
+        stamps SchedulerOutput.trace_ctx with the first traced request's
+        root context.  None (the common case: tracing off, untraced
+        request, init collectives) keeps every span below a no-op."""
+        if method not in ("execute_model", "dispatch_model") or not args:
+            return None
+        if not get_tracer().enabled:
+            return None
+        return getattr(args[0], "trace_ctx", None)
+
+    @staticmethod
+    def _payload_bytes(payload) -> int:
+        """Serialized control-message size attached to dispatch spans
+        (only computed while tracing; the transport pickles again)."""
+        import cloudpickle
+
+        try:
+            return len(cloudpickle.dumps(payload))
+        except Exception:  # noqa: BLE001 — attribute is best-effort
+            return -1
+
+    @staticmethod
+    def _dispatch_span(ctx, host_rank, method, payload_bytes=None):
+        if ctx is None:
+            return NOOP_SPAN
+        attrs = {"target_host": f"host{host_rank}", "method": method}
+        if payload_bytes is not None:
+            attrs["payload_bytes"] = payload_bytes
+        return get_tracer().span("executor.dispatch", parent=ctx, **attrs)
+
+    def _gather(self, futures, origins, unique_reply_rank, timeout, phase,
+                trace_ctx=None):
         # One overall deadline, not timeout × num_hosts; a blown deadline
         # or a failed reply is attributed to the offending host(s).
         deadline = (
             time.monotonic() + timeout if timeout is not None else None
         )
+        tracer = get_tracer()
         results = []
         for fut, (host_rank, address) in zip(futures, origins):
-            try:
-                results.append(
-                    fut.result(
-                        timeout=None
-                        if deadline is None
-                        else max(deadline - time.monotonic(), 0.0)
-                    )
+            span = (
+                tracer.span(
+                    "executor.gather",
+                    parent=trace_ctx,
+                    target_host=f"host{host_rank}",
                 )
+                if trace_ctx is not None
+                else NOOP_SPAN
+            )
+            try:
+                with span:
+                    results.append(
+                        fut.result(
+                            timeout=None
+                            if deadline is None
+                            else max(deadline - time.monotonic(), 0.0)
+                        )
+                    )
             except concurrent.futures.TimeoutError as e:
                 laggards = [
                     o for f, o in zip(futures, origins) if not f.done()

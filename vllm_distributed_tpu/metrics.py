@@ -40,6 +40,60 @@ _HEARTBEAT_BUCKETS = (
 _RECOVERY_BUCKETS = (
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 40.0, 80.0, 160.0, 320.0,
 )
+# Request stages (queue wait, prefill, decode) span the TTFT..e2e range.
+_STAGE_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    20.0, 40.0, 80.0, 160.0,
+)
+# Step phases (schedule on host CPU, dispatch fan-out, gather wait):
+# schedule/dispatch are sub-millisecond, gather bounds device time.
+_STEP_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+)
+
+# Span name (tracing.py) -> per-stage histogram attribute.  The tracer's
+# metrics sink feeds these, so the Prometheus histograms and the traces
+# derive from the SAME measurements and can never disagree.
+SPAN_METRIC_MAP = {
+    "engine.queue": "queue_time",
+    "engine.prefill": "prefill_time",
+    "engine.decode": "decode_time",
+    "scheduler.schedule": "step_schedule_time",
+    "executor.dispatch": "step_dispatch_time",
+    "executor.gather": "step_gather_time",
+}
+
+# Every vllm:* metric family this engine documents (README
+# "Observability"), as rendered in `# TYPE` lines (counters carry the
+# `_total` suffix there).  tests/test_metrics.py asserts render()
+# exposes exactly this set — additions/removals must update both.
+DOCUMENTED_METRICS = (
+    "vllm:num_requests_running",
+    "vllm:num_requests_waiting",
+    "vllm:prompt_tokens_total",
+    "vllm:generation_tokens_total",
+    "vllm:num_preemptions_total",
+    "vllm:prefix_cache_queries_total",
+    "vllm:prefix_cache_hits_total",
+    "vllm:gpu_cache_usage_perc",
+    "vllm:time_to_first_token_seconds",
+    "vllm:time_per_output_token_seconds",
+    "vllm:e2e_request_latency_seconds",
+    "vllm:request_queue_time_seconds",
+    "vllm:request_prefill_time_seconds",
+    "vllm:request_decode_time_seconds",
+    "vllm:step_schedule_time_seconds",
+    "vllm:step_dispatch_time_seconds",
+    "vllm:step_gather_time_seconds",
+    "vllm:request_success_total",
+    "vllm:host_up",
+    "vllm:heartbeat_latency_seconds",
+    "vllm:engine_dead_info",
+    "vllm:engine_restarts_total",
+    "vllm:requests_replayed_total",
+    "vllm:engine_recovery_seconds",
+)
 
 
 class EngineMetrics:
@@ -136,6 +190,39 @@ class EngineMetrics:
             "Request end-to-end latency",
             _E2E_BUCKETS,
         )
+        # ---- per-stage latencies, fed from span data (tracing.py) via
+        # observe_span so dashboards and traces can never disagree.
+        # Populated only while tracing is enabled.
+        self.queue_time = histogram(
+            "vllm:request_queue_time_seconds",
+            "Arrival to first schedule (admission queue wait)",
+            _STAGE_BUCKETS,
+        )
+        self.prefill_time = histogram(
+            "vllm:request_prefill_time_seconds",
+            "First schedule to first token ((chunked) prefill)",
+            _STAGE_BUCKETS,
+        )
+        self.decode_time = histogram(
+            "vllm:request_decode_time_seconds",
+            "First token to finish (decode)",
+            _STAGE_BUCKETS,
+        )
+        self.step_schedule_time = histogram(
+            "vllm:step_schedule_time_seconds",
+            "Scheduler time per engine step",
+            _STEP_BUCKETS,
+        )
+        self.step_dispatch_time = histogram(
+            "vllm:step_dispatch_time_seconds",
+            "Per-host RPC dispatch fan-out time per step",
+            _STEP_BUCKETS,
+        )
+        self.step_gather_time = histogram(
+            "vllm:step_gather_time_seconds",
+            "Per-host reply wait per step (bounds device time + DCN)",
+            _STEP_BUCKETS,
+        )
         self._success = Counter(
             "vllm:request_success",
             "Finished requests by finish reason",
@@ -211,28 +298,32 @@ class EngineMetrics:
             self.kv_cache_usage.set(frac)
 
     def record_new_tokens(self, req_metrics, n: int, now: float | None = None) -> None:
-        """n new tokens for one request: TTFT on the first, ITL after."""
+        """n new tokens for one request: TTFT on the first, ITL after.
+        ``now`` and every interval endpoint are MONOTONIC clock reads
+        (the *_mono RequestMetrics fields) — an NTP step must never
+        produce a negative/garbage TTFT, ITL, or e2e observation."""
         if not self.enabled or n <= 0:
             return
-        now = now if now is not None else time.time()
+        now = now if now is not None else time.monotonic()
         self.generation_tokens.inc(n)
-        last = req_metrics.last_token_time
-        if req_metrics.first_token_time is not None and last is None:
+        last = req_metrics.last_token_time_mono
+        if req_metrics.first_token_time_mono is not None and last is None:
             # first batch of tokens for this request
             self.ttft.observe(
-                req_metrics.first_token_time - req_metrics.arrival_time
+                req_metrics.first_token_time_mono
+                - req_metrics.arrival_time_mono
             )
             n_after_first = n - 1
             # A fused dispatch can deliver the first token WITH its
             # successors: their intervals start at the first token.
-            last = req_metrics.first_token_time
+            last = req_metrics.first_token_time_mono
         else:
             n_after_first = n
         if last is not None and n_after_first > 0:
             per_tok = max(now - last, 0.0) / n_after_first
             for _ in range(n_after_first):
                 self.itl.observe(per_tok)
-        req_metrics.last_token_time = now
+        req_metrics.last_token_time_mono = now
 
     # ---- control-plane liveness hooks (called from the executor's
     # heartbeat loop and the engine failure callback; every caller
@@ -289,13 +380,29 @@ class EngineMetrics:
     def record_finished(self, req_metrics, reason: str | None) -> None:
         if not self.enabled:
             return
-        if req_metrics.finished_time is not None:
+        if req_metrics.finished_time_mono is not None:
+            # Monotonic interval: immune to wall-clock (NTP) steps.
             self.e2e_latency.observe(
-                req_metrics.finished_time - req_metrics.arrival_time
+                req_metrics.finished_time_mono
+                - req_metrics.arrival_time_mono
+            )
+        elif req_metrics.finished_time is not None:
+            self.e2e_latency.observe(
+                max(req_metrics.finished_time - req_metrics.arrival_time, 0.0)
             )
         self._success.labels(
             model_name=self._model_name, finished_reason=reason or "unknown"
         ).inc()
+
+    def observe_span(self, name: str, duration: float) -> None:
+        """Tracer metrics sink (tracing.Tracer.set_metrics_sink): every
+        completed local span whose name maps to a per-stage histogram
+        feeds it, so /metrics and /debug/traces share one measurement."""
+        if not self.enabled:
+            return
+        attr = SPAN_METRIC_MAP.get(name)
+        if attr is not None:
+            getattr(self, attr).observe(max(duration, 0.0))
 
     def render(self) -> bytes:
         """Prometheus text exposition of this engine's registry."""

@@ -59,12 +59,22 @@ class RequestOutput:
 
 @dataclass
 class RequestMetrics:
+    """Per-request timing.  Wall-clock stamps (`*_time`) exist for span
+    start timestamps and human display; every INTERVAL (TTFT, ITL, e2e,
+    stage durations) is computed from the `*_time_mono` monotonic
+    counterparts so an NTP step can never produce negative or garbage
+    latency observations."""
+
     arrival_time: float = 0.0
     first_scheduled_time: float | None = None
     first_token_time: float | None = None
     finished_time: float | None = None
-    # Wall time of the most recent token delivery (ITL instrumentation).
-    last_token_time: float | None = None
+    # Monotonic counterparts, used for all interval math.
+    arrival_time_mono: float = 0.0
+    first_scheduled_time_mono: float | None = None
+    first_token_time_mono: float | None = None
+    finished_time_mono: float | None = None
+    last_token_time_mono: float | None = None
     # Prompt tokens already reported to vllm:prompt_tokens (prefill
     # progress is counted per processed step, remainder at first token).
     prompt_tokens_counted: int = 0
@@ -74,6 +84,8 @@ class RequestMetrics:
 
     @property
     def ttft(self) -> float | None:
+        if self.first_token_time_mono is not None and self.arrival_time_mono:
+            return self.first_token_time_mono - self.arrival_time_mono
         if self.first_token_time is None:
             return None
         return self.first_token_time - self.arrival_time
