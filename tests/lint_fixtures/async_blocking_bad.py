@@ -1,0 +1,15 @@
+"""Positive corpus for VDT001 async-blocking (never imported, only
+parsed).  Lines that must be flagged carry the EXPECT marker."""
+
+import socket
+import time
+
+
+async def handler(fut, conn, path):
+    time.sleep(1)  # EXPECT
+    sock = socket.create_connection(("host", 80))  # EXPECT
+    fut.result(timeout=5)  # EXPECT
+    conn.send_bytes(b"x")  # EXPECT
+    data = open(path).read()  # EXPECT
+    text = path.read_text()  # EXPECT
+    return sock, data, text

@@ -1,0 +1,25 @@
+"""Negative corpus for VDT001: the sanctioned patterns."""
+
+import asyncio
+import time
+
+
+def blocking_helper(path):
+    # Sync helpers may block: they run on executor threads.
+    time.sleep(1)
+    return open(path).read()
+
+
+async def handler(path):
+    await asyncio.sleep(1)
+    loop = asyncio.get_running_loop()
+    # The blocking call is handed to a pool, not made on the loop.
+    return await loop.run_in_executor(None, blocking_helper, path)
+
+
+async def nested_sync_def_is_exempt(path):
+    def inner():
+        return open(path).read()
+
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, inner)

@@ -1,0 +1,7 @@
+"""Positive corpus for VDT004 env-registry (per-file half)."""
+
+import os
+
+level = os.environ.get("VDT_LOG_LEVEL", "INFO")  # EXPECT
+port = os.getenv("VDT_SERVER_PORT")  # EXPECT
+ip = os.environ["VDT_HOST_IP"]  # EXPECT

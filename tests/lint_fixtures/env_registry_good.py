@@ -1,0 +1,11 @@
+"""Negative corpus for VDT004: registry reads and non-VDT vars."""
+
+import os
+
+from vllm_distributed_tpu import envs
+
+level = envs.VDT_LOG_LEVEL
+home = os.environ.get("HF_HOME", "")
+path = os.environ["PATH"]
+# Writes (env replication onto a worker) are not reads.
+os.environ["VDT_TRACING"] = "1"

@@ -1,0 +1,37 @@
+"""Positive corpus for VDT002 lock-across-await."""
+
+import asyncio
+import threading
+
+_lock = threading.Lock()
+
+
+async def critical(peer):
+    with _lock:  # EXPECT
+        await peer.call()
+
+
+class Guarded:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+
+    async def update(self):
+        with self._state_lock:  # EXPECT
+            await asyncio.sleep(0.1)
+
+
+async def inline_constructor():
+    with threading.RLock():  # EXPECT
+        await asyncio.sleep(0)
+
+
+async def suspends_in_async_for(stream):
+    with _lock:  # EXPECT
+        async for _ in stream:
+            pass
+
+
+async def suspends_in_async_with(peer):
+    with _lock:  # EXPECT
+        async with peer:
+            pass

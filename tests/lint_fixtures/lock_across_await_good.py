@@ -1,0 +1,28 @@
+"""Negative corpus for VDT002: release before awaiting, or use the
+async lock form."""
+
+import asyncio
+import threading
+
+_lock = threading.Lock()
+_alock = asyncio.Lock()
+
+
+async def read_then_await(peer):
+    # The FaultInjector.on_write pattern: read state under the lock,
+    # do the slow thing outside it.
+    with _lock:
+        value = 1
+    await peer.call(value)
+
+
+async def async_with_is_fine(peer):
+    async with _alock:
+        await peer.call()
+
+
+async def nested_def_not_held(peer):
+    with _lock:
+        async def later():
+            await peer.call()
+    return later

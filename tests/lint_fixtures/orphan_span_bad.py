@@ -1,0 +1,23 @@
+"""Positive corpus for VDT007 orphan-span."""
+
+
+def orphan(tracer, work):
+    span = tracer.start_span("stage")  # EXPECT
+    work()
+    span.end()
+
+
+def no_finally(tracer, work):
+    span = tracer.start_span("stage")  # EXPECT
+    try:
+        work()
+    except ValueError:
+        span.end()
+
+
+def finally_without_end(tracer, work):
+    span = tracer.start_span("stage")  # EXPECT
+    try:
+        work()
+    finally:
+        work()

@@ -1,0 +1,38 @@
+"""Negative corpus for VDT007, including the old checker's blind spot
+(ISSUE 6 satellite): tuple-unpacked and walrus bindings before a
+try/finally are guarded — the finally is what matters."""
+
+
+def with_form(tracer, work):
+    with tracer.start_span("stage"):
+        work()
+
+
+def with_as(tracer, work):
+    with tracer.start_span("stage") as span:
+        work(span)
+
+
+def try_finally(tracer, work):
+    span = tracer.start_span("stage")
+    try:
+        work()
+    finally:
+        span.end()
+
+
+def tuple_unpacked(tracer, work, clock):
+    t0, span = clock(), tracer.start_span("stage")
+    try:
+        work()
+    finally:
+        span.end(t0)
+
+
+def walrus(tracer, work):
+    if (span := tracer.start_span("stage")) is not None:
+        work()
+    try:
+        work()
+    finally:
+        span.end()

@@ -1,0 +1,17 @@
+"""Negative corpus for VDT006: narrow excepts may pass; broad ones
+must at least log."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def teardown(x):
+    try:
+        x.close()
+    except OSError:
+        pass  # narrow: fine
+    try:
+        x.flush()
+    except Exception as e:  # noqa: BLE001
+        logger.debug("teardown flush failed: %s", e)

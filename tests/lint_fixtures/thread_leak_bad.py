@@ -1,0 +1,16 @@
+"""Positive corpus for VDT005 thread-leak."""
+
+import threading
+
+
+def work():
+    pass
+
+
+class Owner:
+    def start(self):
+        self._t = threading.Thread(target=work)  # EXPECT
+        self._t.start()
+        threading.Thread(target=work).start()  # EXPECT
+        explicit = threading.Thread(target=work, daemon=False)  # EXPECT
+        explicit.start()

@@ -1,0 +1,21 @@
+"""Negative corpus for VDT005: daemons, joined threads, late daemon=."""
+
+import threading
+
+
+def work():
+    pass
+
+
+class Owner:
+    def start(self):
+        self._daemon = threading.Thread(target=work, daemon=True)
+        self._daemon.start()
+        self._joined = threading.Thread(target=work)
+        self._joined.start()
+        self._late = threading.Thread(target=work)
+        self._late.daemon = True
+        self._late.start()
+
+    def shutdown(self):
+        self._joined.join(timeout=5)

@@ -1,0 +1,15 @@
+"""Positive corpus for VDT003 unbounded-wait."""
+
+import asyncio
+
+
+async def waits(fut, peer, reader, proc):
+    await fut  # EXPECT
+    await peer.get_param("ping")  # EXPECT
+    await asyncio.wait({fut})  # EXPECT
+    await reader.readexactly(4)  # EXPECT
+    await proc.communicate()  # EXPECT
+
+
+def sync_result(fut):
+    return fut.result()  # EXPECT

@@ -1,0 +1,32 @@
+"""Negative corpus for VDT003: every wait carries a deadline (or is
+composition, whose callee owns it)."""
+
+import asyncio
+
+
+async def bounded(fut, peer, reader):
+    await asyncio.wait_for(fut, 5)
+    await asyncio.sleep(1)
+    await asyncio.wait({fut}, timeout=5)
+    await reader.readexactly(4, timeout=5)
+    # Composition: awaiting an ordinary coroutine call is the callee's
+    # (or its orchestrator's) deadline to own.
+    await helper(peer)
+
+
+async def helper(peer):
+    await asyncio.wait_for(peer.get_param("ping"), 5)
+
+
+async def nested_wait_for(fut, msg, send):
+    # The rpc.py send_and_wait pattern: every call of the nested def is
+    # wrapped in wait_for, so its inner awaits are bounded.
+    async def send_and_wait():
+        await send(msg)
+        return await fut
+
+    return await asyncio.wait_for(send_and_wait(), 5)
+
+
+def sync_result(fut):
+    return fut.result(timeout=5)

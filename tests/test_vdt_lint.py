@@ -1,0 +1,301 @@
+"""Unit tests for tools/vdt_lint (ISSUE 6): per-rule fixture corpus,
+waiver and baseline round-trips, the registry↔README cross-check, and
+the CLI contract (exit codes + rule id + file:line in the output).
+
+Fixture protocol (tests/lint_fixtures/): `<rule>_bad.py` lines that
+must be flagged carry a trailing `# EXPECT`; `<rule>_good.py` must
+produce zero findings of that rule.  Fixtures are parsed, never
+imported.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.vdt_lint import (
+    DEFAULT_BASELINE_PATH,
+    PACKAGE_ROOT,
+    REPO_ROOT,
+    load_baseline,
+    run_lint,
+    save_baseline,
+)
+
+pytestmark = pytest.mark.lint
+
+FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
+
+RULES = {
+    "async-blocking": "VDT001",
+    "lock-across-await": "VDT002",
+    "unbounded-wait": "VDT003",
+    "env-registry": "VDT004",
+    "thread-leak": "VDT005",
+    "silent-except": "VDT006",
+    "orphan-span": "VDT007",
+}
+
+
+def _seed(tmp_path: Path, fixture: str, transform=None) -> tuple[Path, Path]:
+    """Copy one fixture into a synthetic package tree under
+    ``distributed/`` (so every rule's scope applies — the acceptance
+    criterion seeds positives into distributed/)."""
+    pkg = tmp_path / "pkg"
+    (pkg / "distributed").mkdir(parents=True, exist_ok=True)
+    text = (FIXTURES / fixture).read_text()
+    if transform is not None:
+        text = transform(text)
+    dest = pkg / "distributed" / fixture
+    dest.write_text(text)
+    return pkg, dest
+
+
+def _expected_lines(path: Path) -> set[int]:
+    return {
+        i
+        for i, line in enumerate(path.read_text().splitlines(), 1)
+        if "# EXPECT" in line
+    }
+
+
+def _findings(pkg: Path, rule: str):
+    report = run_lint([pkg], baseline=None)
+    return [f for f in report.new if f.rule == rule]
+
+
+# ---- fixture corpus ----
+@pytest.mark.parametrize("rule", sorted(RULES))
+def test_positive_corpus_is_flagged(tmp_path, rule):
+    fixture = f"{rule.replace('-', '_')}_bad.py"
+    pkg, dest = _seed(tmp_path, fixture)
+    findings = _findings(pkg, rule)
+    assert {f.line for f in findings} == _expected_lines(dest), [
+        f.render() for f in findings
+    ]
+    assert all(f.code == RULES[rule] for f in findings)
+    # The finding names the file so the CLI/gate output is actionable.
+    assert all(f.path.endswith(fixture) for f in findings)
+
+
+@pytest.mark.parametrize("rule", sorted(RULES))
+def test_negative_corpus_is_clean(tmp_path, rule):
+    fixture = f"{rule.replace('-', '_')}_good.py"
+    pkg, _ = _seed(tmp_path, fixture)
+    assert _findings(pkg, rule) == []
+
+
+# ---- waivers ----
+def _waive_expects(marker: str):
+    def transform(text: str) -> str:
+        return text.replace("# EXPECT", f"# vdt-lint: disable={marker}")
+
+    return transform
+
+
+@pytest.mark.parametrize(
+    "marker", ["unbounded-wait", "VDT003", "all"]
+)
+def test_trailing_waiver_silences_by_rule_code_or_all(tmp_path, marker):
+    pkg, _ = _seed(
+        tmp_path, "unbounded_wait_bad.py", _waive_expects(marker)
+    )
+    report = run_lint([pkg], baseline=None)
+    assert [f for f in report.new if f.rule == "unbounded-wait"] == []
+    assert len(report.waived) == 6
+
+
+def test_wrong_rule_waiver_does_not_silence(tmp_path):
+    pkg, dest = _seed(
+        tmp_path, "unbounded_wait_bad.py", _waive_expects("orphan-span")
+    )
+    findings = _findings(pkg, "unbounded-wait")
+    assert len(findings) == 6
+
+
+def test_full_line_waiver_applies_to_next_code_line(tmp_path):
+    pkg = tmp_path / "pkg"
+    (pkg / "distributed").mkdir(parents=True)
+    (pkg / "distributed" / "mod.py").write_text(
+        "async def f(fut):\n"
+        "    # vdt-lint: disable=unbounded-wait — bounded by the caller\n"
+        "    await fut\n"
+    )
+    report = run_lint([pkg], baseline=None)
+    assert report.new == []
+    assert len(report.waived) == 1
+
+
+@pytest.mark.parametrize(
+    "comment",
+    [
+        # em-dash, ASCII hyphen, and plain-word justifications must all
+        # leave the rule name intact (only the first word is the rule).
+        "# vdt-lint: disable=unbounded-wait,thread-leak — already done",
+        "# vdt-lint: disable=unbounded-wait - bounded by the caller",
+        "# vdt-lint: disable=VDT003 because the caller bounds it",
+    ],
+)
+def test_waiver_with_justification_text_parses(tmp_path, comment):
+    pkg = tmp_path / "pkg"
+    (pkg / "distributed").mkdir(parents=True)
+    (pkg / "distributed" / "mod.py").write_text(
+        f"async def f(fut):\n    await fut  {comment}\n"
+    )
+    report = run_lint([pkg], baseline=None)
+    assert report.new == []
+    assert len(report.waived) == 1
+
+
+# ---- baseline ----
+def test_baseline_round_trip(tmp_path):
+    pkg, dest = _seed(tmp_path, "unbounded_wait_bad.py")
+    first = run_lint([pkg], baseline=None)
+    assert len(first.new) == 6
+    baseline_file = tmp_path / "baseline.json"
+    save_baseline(baseline_file, first.new)
+
+    second = run_lint([pkg], baseline=load_baseline(baseline_file))
+    assert second.new == []
+    assert len(second.baselined) == 6
+
+    # A NEW finding is not masked by the old baseline.
+    dest.write_text(
+        dest.read_text() + "\n\nasync def extra(fut):\n    await fut\n"
+    )
+    third = run_lint([pkg], baseline=load_baseline(baseline_file))
+    assert len(third.new) == 1
+    assert len(third.baselined) == 6
+
+
+def test_committed_baseline_loads_and_is_versioned():
+    entries = load_baseline(DEFAULT_BASELINE_PATH)
+    assert isinstance(entries, list)
+
+
+def test_parse_error_is_baselinable(tmp_path):
+    pkg = tmp_path / "pkg"
+    (pkg / "distributed").mkdir(parents=True)
+    (pkg / "distributed" / "vendored.py").write_text(
+        "def f(:\n    pass\n"  # unparseable on purpose
+    )
+    first = run_lint([pkg], baseline=None)
+    errors = [f for f in first.new if f.code == "VDT000"]
+    assert len(errors) == 1
+
+    # The escape hatch works: once baselined, the gate goes green.
+    baseline_file = tmp_path / "baseline.json"
+    save_baseline(baseline_file, first.new)
+    second = run_lint([pkg], baseline=load_baseline(baseline_file))
+    assert second.new == []
+    assert len(second.baselined) == 1
+
+
+# ---- env-registry project half ----
+def test_registry_readme_cross_check(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "envs.py").write_text(
+        "environment_variables = {\n"
+        '    "VDT_DOCUMENTED": lambda: 1,\n'
+        '    "VDT_MISSING": lambda: 2,\n'
+        '    "VDT_DOC": lambda: 3,\n'  # prefix of VDT_DOCUMENTED
+        "}\n"
+    )
+    (tmp_path / "README.md").write_text("docs mention VDT_DOCUMENTED only")
+    report = run_lint([pkg], baseline=None)
+    missing = [f for f in report.new if f.rule == "env-registry"]
+    # VDT_DOC must not pass on a substring hit inside VDT_DOCUMENTED.
+    assert sorted(f.message.split()[2] for f in missing) == [
+        "VDT_DOC",
+        "VDT_MISSING",
+    ]
+
+
+def test_real_registry_is_fully_documented():
+    report = run_lint()  # committed (empty) baseline
+    assert not any(f.rule == "env-registry" for f in report.new)
+
+
+# ---- acceptance criterion: seeding a positive into the real tree ----
+def test_seeded_positive_in_real_distributed_fails_gate(tmp_path):
+    tree = tmp_path / "vllm_distributed_tpu"
+    shutil.copytree(PACKAGE_ROOT, tree)
+    seeded = tree / "distributed" / "seeded_bad.py"
+    seeded.write_text((FIXTURES / "unbounded_wait_bad.py").read_text())
+    report = run_lint([tree])  # committed baseline, real waivers active
+    hits = [f for f in report.new if f.path.endswith("seeded_bad.py")]
+    assert len(hits) == 6
+    assert all(f.code == "VDT003" for f in hits)
+    # Everything that was clean stays clean: only the seed is new.
+    assert {f.path for f in report.new} == {hits[0].path}
+
+
+# ---- CLI ----
+def _run_cli(*argv: str):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.vdt_lint", *argv],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        timeout=120,
+    )
+
+
+def test_cli_exits_nonzero_with_rule_id_and_location(tmp_path):
+    pkg, dest = _seed(tmp_path, "silent_except_bad.py")
+    proc = _run_cli(str(pkg))
+    assert proc.returncode == 1
+    line = min(_expected_lines(dest))
+    assert "VDT006" in proc.stdout
+    assert f"silent_except_bad.py:{line}" in proc.stdout
+
+
+def test_cli_json_format(tmp_path):
+    pkg, _ = _seed(tmp_path, "thread_leak_bad.py")
+    proc = _run_cli(str(pkg), "--format", "json")
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert {f["code"] for f in payload["new"]} == {"VDT005"}
+    assert all(f["line"] for f in payload["new"])
+
+
+def test_cli_clean_on_merged_tree():
+    proc = _run_cli()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_list_rules():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for code in RULES.values():
+        assert code in proc.stdout
+
+
+def test_cli_broken_pipe_preserves_exit_code(tmp_path):
+    # `vdt-lint | head` under pipefail: a consumer closing stdout
+    # mid-report must not turn findings into exit 0.
+    pkg, _ = _seed(tmp_path, "silent_except_bad.py")
+    script = (
+        "import sys\n"
+        "from tools.vdt_lint.cli import main\n"
+        "class ClosedPipe:\n"
+        "    def write(self, s): raise BrokenPipeError\n"
+        "    def flush(self): pass\n"
+        "    def fileno(self): return 1\n"
+        "sys.stdout = ClosedPipe()\n"
+        f"sys.exit(main([{str(pkg)!r}]))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        timeout=120,
+    )
+    assert proc.returncode == 1, proc.stderr

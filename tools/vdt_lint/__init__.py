@@ -1,0 +1,34 @@
+"""vdt-lint: project-native static analysis (ISSUE 6 tentpole).
+
+An AST-based framework that machine-checks the concurrency, registry,
+and failure-handling invariants accumulated across PRs 1-4:
+
+- each ``Checker`` encodes one project invariant and reports
+  ``Finding``s against a shared, parsed-once ``FileContext``;
+- ``# vdt-lint: disable=<rule>`` inline comments waive a finding with a
+  human justification at the site;
+- a committed baseline file (``tools/vdt_lint/baseline.json``) holds
+  pre-existing findings that are tolerated but must not grow;
+- the CLI (``python -m tools.vdt_lint``) and the tier-1 pytest gate
+  (``tests/test_code_hygiene.py``) both fail on any NEW finding.
+
+Run: ``python -m tools.vdt_lint [--format json|text] [paths]``.
+"""
+
+from tools.vdt_lint.core import (  # noqa: F401
+    DEFAULT_BASELINE_PATH,
+    PACKAGE_ROOT,
+    REPO_ROOT,
+    Checker,
+    FileContext,
+    Finding,
+    Project,
+    Report,
+    all_checkers,
+    register,
+    run_lint,
+)
+from tools.vdt_lint.baseline import load_baseline, save_baseline  # noqa: F401
+
+# Importing the checkers package populates the registry.
+import tools.vdt_lint.checkers  # noqa: F401, E402

@@ -1,0 +1,71 @@
+"""Small AST helpers shared by the checkers."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+FUNCTION_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """Flatten ``a.b.c`` attribute chains to ``"a.b.c"``; None for
+    anything that is not a pure Name/Attribute chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def callee_last(call: ast.Call) -> str | None:
+    """The terminal name of a call target: ``x.y.wait_for(...)`` ->
+    ``"wait_for"``; ``open(...)`` -> ``"open"``."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def has_kwarg(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords)
+
+
+def calls_named(node: ast.AST, name: str) -> Iterator[ast.Call]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and callee_last(sub) == name:
+            yield sub
+
+
+def walk_skipping_functions(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a subtree without descending into nested function/lambda
+    definitions — "this code runs HERE, not in some deferred scope"."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if isinstance(child, FUNCTION_NODES):
+            continue
+        yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
+_SUSPENSION_NODES = (ast.Await, ast.AsyncFor, ast.AsyncWith)
+
+
+def contains_await(node: ast.AST) -> bool:
+    """True if executing ``node`` can suspend the coroutine: an
+    ``await``, ``async for`` (suspends at each __anext__), or ``async
+    with`` (suspends at __aenter__/__aexit__) in the same scope."""
+    if isinstance(node, FUNCTION_NODES):
+        return False  # a nested def's awaits run later, in its own scope
+    if isinstance(node, _SUSPENSION_NODES):
+        return True
+    return any(
+        isinstance(sub, _SUSPENSION_NODES)
+        for sub in walk_skipping_functions(node)
+    )

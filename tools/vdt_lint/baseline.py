@@ -1,0 +1,54 @@
+"""Committed-baseline support: pre-existing findings that are tolerated
+(grandfathered) but must not grow.
+
+Entries are (code, path, line) triples keyed by repo-root-relative
+paths; editing the offending code invalidates the entry, so baseline
+debt cannot silently survive a rewrite of the line it points at.  The
+ISSUE 6 contract keeps ``distributed/`` and ``executor/`` baseline-free
+(enforced by tests/test_code_hygiene.py).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Iterable
+
+from tools.vdt_lint.core import Finding
+
+_VERSION = 1
+
+
+def load_baseline(path: Path | str) -> list[dict]:
+    path = Path(path)
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text())
+    if data.get("version") != _VERSION:
+        raise ValueError(
+            f"unsupported baseline version {data.get('version')!r} in {path}"
+        )
+    return list(data.get("findings", []))
+
+
+def save_baseline(path: Path | str, findings: Iterable[Finding]) -> None:
+    entries = sorted(
+        (
+            {"code": f.code, "path": f.path, "line": f.line}
+            for f in findings
+        ),
+        key=lambda e: (e["path"], e["line"], e["code"]),
+    )
+    Path(path).write_text(
+        json.dumps({"version": _VERSION, "findings": entries}, indent=2)
+        + "\n"
+    )
+
+
+def match_baseline(finding: Finding, entries: list[dict]) -> bool:
+    return any(
+        e.get("code") == finding.code
+        and e.get("path") == finding.path
+        and e.get("line") == finding.line
+        for e in entries
+    )

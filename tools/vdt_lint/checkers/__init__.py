@@ -1,0 +1,22 @@
+"""Checker registry: importing this package registers every rule.
+
+| code   | rule             | invariant                                        |
+|--------|------------------|--------------------------------------------------|
+| VDT001 | async-blocking   | no blocking calls inside ``async def`` bodies    |
+| VDT002 | lock-across-await| no sync lock held across an ``await``            |
+| VDT003 | unbounded-wait   | control-plane waits carry a deadline             |
+| VDT004 | env-registry     | VDT_* env reads go through envs.py; registry ⊂ README |
+| VDT005 | thread-leak      | threads are daemons or joined on shutdown        |
+| VDT006 | silent-except    | no ``except Exception: pass``                    |
+| VDT007 | orphan-span      | spans open via ``with`` / try-finally ``.end()`` |
+"""
+
+from tools.vdt_lint.checkers import (  # noqa: F401
+    async_blocking,
+    env_registry,
+    lock_across_await,
+    orphan_span,
+    silent_except,
+    thread_leak,
+    unbounded_wait,
+)

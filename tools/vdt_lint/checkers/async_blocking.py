@@ -1,0 +1,88 @@
+"""VDT001 async-blocking: no blocking calls inside ``async def`` bodies.
+
+A blocking call on the event loop stalls every request, heartbeat, and
+SSE stream sharing that loop — vLLM's dominant serving-regression class
+(PAPERS.md, PagedAttention §6).  The fix is always the same: hop the
+work onto an executor (``loop.run_in_executor``), as
+``ConnectionRpcTransport`` and ``WorkerHost.run`` already do.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.vdt_lint.astutil import dotted_name, walk_skipping_functions
+from tools.vdt_lint.core import Checker, FileContext, Finding, register
+
+# Exact dotted call targets that block.
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep() stalls the event loop",
+    "subprocess.run": "synchronous subprocess wait",
+    "subprocess.call": "synchronous subprocess wait",
+    "subprocess.check_call": "synchronous subprocess wait",
+    "subprocess.check_output": "synchronous subprocess wait",
+}
+
+# Any call through the socket module is a sync network primitive (use
+# asyncio.open_connection / loop.sock_* instead).
+_SOCKET_MODULE = "socket."
+
+# Method names that block regardless of receiver: concurrent futures,
+# sync multiprocessing pipes, and path-object file I/O.
+_BLOCKING_METHODS = {
+    "result": "Future.result() blocks the loop (await it, or run_in_executor)",
+    "send_bytes": "sync pipe write (run_in_executor, like ConnectionRpcTransport)",
+    "recv_bytes": "sync pipe read (run_in_executor, like ConnectionRpcTransport)",
+    "read_text": "file I/O on the event loop",
+    "write_text": "file I/O on the event loop",
+    "read_bytes": "file I/O on the event loop",
+    "write_bytes": "file I/O on the event loop",
+}
+
+_OPEN_BUILTIN = "open"
+
+
+def _blocking_reason(call: ast.Call) -> str | None:
+    dotted = dotted_name(call.func)
+    if dotted is not None:
+        if dotted in _BLOCKING_DOTTED:
+            return _BLOCKING_DOTTED[dotted]
+        if dotted.startswith(_SOCKET_MODULE):
+            return f"sync socket call {dotted}()"
+    if isinstance(call.func, ast.Attribute):
+        reason = _BLOCKING_METHODS.get(call.func.attr)
+        if reason is not None:
+            return reason
+    if isinstance(call.func, ast.Name) and call.func.id == _OPEN_BUILTIN:
+        return "file I/O on the event loop"
+    return None
+
+
+@register
+class AsyncBlockingChecker(Checker):
+    code = "VDT001"
+    rule = "async-blocking"
+    description = "blocking call inside an async def body"
+    rationale = (
+        "a blocking call on the event loop stalls every request, "
+        "heartbeat, and SSE stream sharing it"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            # Nested sync defs/lambdas are excluded: they may be handed
+            # to run_in_executor, where blocking is the whole point.
+            for sub in walk_skipping_functions(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                reason = _blocking_reason(sub)
+                if reason is not None:
+                    yield ctx.finding(
+                        self,
+                        sub,
+                        f"blocking call in `async def {node.name}`: "
+                        f"{reason}",
+                    )

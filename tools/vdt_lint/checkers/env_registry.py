@@ -1,0 +1,112 @@
+"""VDT004 env-registry: VDT_* env vars live in envs.py, and the
+registry is documented.
+
+``envs.environment_variables`` is the single registry of recognized env
+vars AND the replication allowlist forwarded to remote workers
+(envs.py:1-9).  A ``VDT_*`` read that bypasses it is a correctness bug
+twice over: the var silently never reaches remote hosts, and operators
+cannot discover it.  The project half of the rule cross-checks the
+registry against README.md — every registered var must be documented.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from tools.vdt_lint.astutil import dotted_name
+from tools.vdt_lint.core import Checker, FileContext, Finding, Project, register
+
+_PREFIX = "VDT_"
+_READ_CALLS = {"os.environ.get", "os.getenv", "environ.get"}
+_SUBSCRIPT_BASES = {"os.environ", "environ"}
+
+
+def _vdt_literal(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, str)
+        and node.value.startswith(_PREFIX)
+    ):
+        return node.value
+    return None
+
+
+@register
+class EnvRegistryChecker(Checker):
+    code = "VDT004"
+    rule = "env-registry"
+    description = "VDT_* env read outside envs.py / registry not in README"
+    rationale = (
+        "a VDT_* read that bypasses envs.environment_variables is "
+        "invisible to operators and never replicated to remote hosts"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.scope_rel == "envs.py":
+            return
+        for node in ast.walk(ctx.tree):
+            name = None
+            if isinstance(node, ast.Call):
+                dotted = dotted_name(node.func)
+                if dotted in _READ_CALLS and node.args:
+                    name = _vdt_literal(node.args[0])
+            elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load
+            ):
+                if dotted_name(node.value) in _SUBSCRIPT_BASES:
+                    name = _vdt_literal(node.slice)
+            if name is not None:
+                yield ctx.finding(
+                    self,
+                    node,
+                    f"direct read of {name} — declare it in "
+                    "envs.environment_variables and read via "
+                    f"envs.{name}",
+                )
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        envs_ctx = project.get("envs.py")
+        if envs_ctx is None:
+            return  # fixture trees carry no registry to cross-check
+        readme = envs_ctx.path.parent.parent / "README.md"
+        if not readme.exists():
+            return
+        readme_text = readme.read_text()
+        for name_node in self._registry_keys(envs_ctx.tree):
+            # Word-boundary match: VDT_HEARTBEAT must not pass just
+            # because VDT_HEARTBEAT_INTERVAL_SECONDS is documented.
+            if not re.search(
+                rf"\b{re.escape(name_node.value)}\b", readme_text
+            ):
+                yield envs_ctx.finding(
+                    self,
+                    name_node,
+                    f"registry entry {name_node.value} is not documented "
+                    "in README.md (env-var table)",
+                )
+
+    @staticmethod
+    def _registry_keys(tree: ast.Module) -> Iterable[ast.Constant]:
+        for node in ast.walk(tree):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == "environment_variables"
+                for t in targets
+            ):
+                continue
+            if isinstance(value, ast.Dict):
+                for key in value.keys:
+                    if isinstance(key, ast.Constant) and isinstance(
+                        key.value, str
+                    ):
+                        yield key

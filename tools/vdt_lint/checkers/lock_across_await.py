@@ -1,0 +1,77 @@
+"""VDT002 lock-across-await: no sync lock held across an ``await``.
+
+A ``threading.Lock`` held across a suspension point wedges every other
+coroutine (and thread) contending for it until the awaited I/O returns
+— with a slow peer, that is a cross-host priority inversion the
+heartbeat watchdog then misattributes to the remote side.  Asyncio
+locks must use ``async with``; threading locks must release before
+awaiting (see ``FaultInjector.on_write``, which reads state under the
+lock and sleeps outside it).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.vdt_lint.astutil import callee_last, contains_await, dotted_name
+from tools.vdt_lint.core import Checker, FileContext, Finding, register
+
+_LOCKISH_SUBSTRINGS = ("lock", "mutex")
+_LOCK_CONSTRUCTORS = {
+    "Lock",
+    "RLock",
+    "Condition",
+    "Semaphore",
+    "BoundedSemaphore",
+}
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Call):
+        last = callee_last(expr)
+        if last in _LOCK_CONSTRUCTORS:
+            return True
+        # lock.acquire()-style context factories: x.some_lock()
+        expr = expr.func
+    name = dotted_name(expr)
+    if name is None:
+        return False
+    terminal = name.rsplit(".", 1)[-1].lower()
+    return any(s in terminal for s in _LOCKISH_SUBSTRINGS)
+
+
+@register
+class LockAcrossAwaitChecker(Checker):
+    code = "VDT002"
+    rule = "lock-across-await"
+    description = "sync lock held across an await"
+    rationale = (
+        "a threading lock held across a suspension point wedges every "
+        "contender until the awaited I/O returns"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            # Sync `with` only: `async with asyncio.Lock()` releasing at
+            # suspension points is the designed usage.
+            if not isinstance(node, ast.With):
+                continue
+            lock_items = [
+                item for item in node.items if _is_lockish(item.context_expr)
+            ]
+            if not lock_items:
+                continue
+            if any(contains_await(stmt) for stmt in node.body):
+                expr = lock_items[0].context_expr
+                name = dotted_name(expr)
+                if name is None and isinstance(expr, ast.Call):
+                    name = f"{dotted_name(expr.func) or '...'}()"
+                name = name or "a lock"
+                yield ctx.finding(
+                    self,
+                    node,
+                    f"`with {name}:` encloses an await — the lock is "
+                    "held across the suspension; release it before "
+                    "awaiting",
+                )

@@ -1,0 +1,79 @@
+"""VDT007 orphan-span: spans open via ``with`` or try/finally ``.end()``.
+
+Migrated from tests/test_code_hygiene.py (ISSUE 5 satellite).  A manual
+``start_span`` call outside a ``with`` item or a try/finally that
+``.end()``s it leaks the span open if the code between open and close
+raises — the trace ring then reports a phantom still-running stage.
+
+Blind-spot fix (ISSUE 6 satellite): the old ``_guarded_start_spans``
+only recognized a plain ``Assign``/``AnnAssign`` immediately before the
+try/finally, so a span bound by tuple-unpacking inside a larger
+statement or by a walrus (``if (sp := t.start_span(...)):``) was
+reported as orphanable even though the finally closed it.  The guard
+now accepts ANY statement immediately preceding a try whose finalbody
+calls ``.end()`` — what matters is the finally, not the binding syntax.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.vdt_lint.astutil import calls_named
+from tools.vdt_lint.core import Checker, FileContext, Finding, register
+
+_NAME = "start_span"
+
+
+def _guarded(tree: ast.Module) -> set[int]:
+    """ids of start_span Call nodes that cannot leak open: used as a
+    ``with`` item, or part of the statement immediately before a
+    try/finally whose finally calls ``.end()``."""
+    ok: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                for call in calls_named(item.context_expr, _NAME):
+                    ok.add(id(call))
+        body = getattr(node, "body", None)
+        if not isinstance(body, list):
+            continue
+        for stmt, nxt in zip(body, body[1:]):
+            if not (isinstance(nxt, ast.Try) and nxt.finalbody):
+                continue
+            if not any(
+                True
+                for fin in nxt.finalbody
+                for _ in calls_named(fin, "end")
+            ):
+                continue
+            # Any statement shape counts: plain assign, tuple-unpacking,
+            # walrus inside an expression/if — the finally is the guard.
+            for call in calls_named(stmt, _NAME):
+                ok.add(id(call))
+    return ok
+
+
+@register
+class OrphanSpanChecker(Checker):
+    code = "VDT007"
+    rule = "orphan-span"
+    description = "manual start_span without with/try-finally"
+    rationale = (
+        "a raise between open and close leaks the span open and the "
+        "trace ring reports a phantom still-running stage"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        guarded = _guarded(ctx.tree)
+        for call in calls_named(ctx.tree, _NAME):
+            # The definition site (tracing.py's `start_span = span`
+            # alias) is an assignment, not a call, so it never trips.
+            if id(call) not in guarded:
+                yield ctx.finding(
+                    self,
+                    call,
+                    "manual start_span outside with/try-finally — use "
+                    "`with tracer.span(...)` so a raise cannot leak an "
+                    "open span",
+                )

@@ -1,0 +1,67 @@
+"""VDT006 silent-except: no ``except Exception: pass``.
+
+Migrated from tests/test_code_hygiene.py (ISSUE 2 satellite), widened
+from ``distributed/`` to the whole package: the layers whose job is
+failure DETECTION must not swallow exactly the signals the
+fault-tolerance machinery exists to surface.  Teardown best-effort
+blocks log at debug instead (see rpc_transport.close()); genuinely
+expected errors carry an inline waiver saying why.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.vdt_lint.core import Checker, FileContext, Finding, register
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare `except:`
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in _BROAD for e in t.elts
+        )
+    return False
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    if len(handler.body) != 1:
+        return False
+    stmt = handler.body[0]
+    if isinstance(stmt, ast.Pass):
+        return True
+    return isinstance(stmt, ast.Expr) and isinstance(
+        stmt.value, ast.Constant
+    ) and stmt.value.value is ...
+
+
+@register
+class SilentExceptChecker(Checker):
+    code = "VDT006"
+    rule = "silent-except"
+    description = "broad except block that swallows silently"
+    rationale = (
+        "a silent broad except hides exactly the failure signals the "
+        "fault-tolerance layer exists to surface — log at debug at least"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.ExceptHandler)
+                and _is_broad(node)
+                and _is_silent(node)
+            ):
+                yield ctx.finding(
+                    self,
+                    node,
+                    "silent broad except — log at debug instead of "
+                    "swallowing (rpc_transport.close() is the pattern)",
+                )

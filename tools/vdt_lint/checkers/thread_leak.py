@@ -1,0 +1,109 @@
+"""VDT005 thread-leak: threads are daemons or joined on shutdown.
+
+The PR 3 leak class: a non-daemon thread with no reachable ``join()``
+keeps the process alive after the engine is torn down (chaos-soak's
+no-leaked-threads assertion exists because this bit us).  Every
+``threading.Thread`` must either be created ``daemon=True`` or have a
+``.join(...)`` on its binding somewhere in the same file (the shutdown
+path), mirroring ``MultiHostExecutor._teardown``'s loop-thread join.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.vdt_lint.astutil import dotted_name
+from tools.vdt_lint.core import Checker, FileContext, Finding, register
+
+_THREAD_TARGETS = {"threading.Thread", "Thread"}
+
+
+def _binding_of(call: ast.Call, parents: dict[int, ast.AST]) -> str | None:
+    """The name/attr a Thread(...) is assigned to, as a dotted string."""
+    node: ast.AST = call
+    while id(node) in parents:
+        parent = parents[id(node)]
+        if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                parent.targets
+                if isinstance(parent, ast.Assign)
+                else [parent.target]
+            )
+            for t in targets:
+                name = dotted_name(t)
+                if name is not None:
+                    return name
+            return None
+        if isinstance(parent, ast.NamedExpr):
+            return dotted_name(parent.target)
+        if not isinstance(parent, (ast.expr,)):
+            return None
+        node = parent
+    return None
+
+
+@register
+class ThreadLeakChecker(Checker):
+    code = "VDT005"
+    rule = "thread-leak"
+    description = "thread without daemon= or a reachable join()"
+    rationale = (
+        "a non-daemon thread with no join keeps a dead engine's process "
+        "alive and leaks across supervisor rebuilds"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        parents: dict[int, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+
+        joined: set[str] = set()
+        daemonized: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+            ):
+                name = dotted_name(node.func.value)
+                if name is not None:
+                    joined.add(name)
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and t.attr == "daemon":
+                        owner = dotted_name(t.value)
+                        if owner is not None and not (
+                            isinstance(node.value, ast.Constant)
+                            and node.value.value is False
+                        ):
+                            daemonized.add(owner)
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) not in _THREAD_TARGETS:
+                continue
+            daemon_kw = next(
+                (kw for kw in node.keywords if kw.arg == "daemon"), None
+            )
+            if daemon_kw is not None and not (
+                isinstance(daemon_kw.value, ast.Constant)
+                and daemon_kw.value.value is False
+            ):
+                continue
+            binding = _binding_of(node, parents)
+            if binding is not None and (
+                binding in joined or binding in daemonized
+            ):
+                continue
+            where = (
+                f"`{binding}`" if binding is not None else "an unbound thread"
+            )
+            yield ctx.finding(
+                self,
+                node,
+                f"Thread bound to {where} is neither daemon=True nor "
+                "joined in this file — it outlives shutdown",
+            )

@@ -1,0 +1,180 @@
+"""VDT003 unbounded-wait: control-plane waits must carry a deadline.
+
+The PR 2 "no leaked futures" rule, generalized: in the control plane
+(``distributed/``, ``executor/``, ``engine/supervisor.py``) any wait on
+a peer — a bare future, an RPC param fetch, a queue/event/stream
+primitive — must be bounded by ``asyncio.wait_for``/a ``timeout=``,
+because a silent host is a ROUTINE failure over DCN and an unbounded
+wait converts it into a wedged driver (SURVEY.md §5.3; Llumnix-style
+migration is only safe on a deadline-disciplined control plane).
+
+What counts as an unbounded leaf wait:
+
+- ``await fut`` / ``await task`` — a bare Name/Attribute future;
+- ``await x.<leaf>(...)`` with no ``timeout=`` for leaf primitives
+  (``wait``, ``gather``, ``get``, ``join``, ``acquire``, ``drain``,
+  ``read``/``readexactly``/``readuntil``/``readline``, ``recv``,
+  ``communicate``, ``open_connection``, ``connect``,
+  ``get_param``/``getParam``);
+- sync ``<expr>.result()`` with neither a positional timeout nor
+  ``timeout=`` (concurrent futures block forever).
+
+Awaiting an ordinary coroutine *call* is composition, not a leaf wait —
+deadline ownership belongs inside the callee or at the orchestration
+point wrapping it.  Awaits inside a nested function whose every call
+site sits in ``asyncio.wait_for(...)`` are recognized as bounded (the
+``send_and_wait`` pattern in rpc.py).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.vdt_lint.astutil import callee_last, has_kwarg
+from tools.vdt_lint.core import Checker, FileContext, Finding, register
+
+_BOUNDED_CALLEES = {"wait_for", "sleep"}
+_UNBOUNDED_LEAF_CALLEES = {
+    "wait",
+    "gather",
+    "get",
+    "join",
+    "acquire",
+    "drain",
+    "read",
+    "readexactly",
+    "readuntil",
+    "readline",
+    "recv",
+    "communicate",
+    "open_connection",
+    "connect",
+    "get_param",
+    "getParam",
+}
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, checker: "UnboundedWaitChecker", ctx: FileContext):
+        self.checker = checker
+        self.ctx = ctx
+        self.findings: list[Finding] = []
+        # Defs whose awaits are exempt: every call of the def appears
+        # inside an asyncio.wait_for(...) argument in the parent scope.
+        self._protected_defs: set[int] = set()
+        self._protection_depth = 0
+
+    # ---- wait_for-wrapped nested defs ----
+    def _mark_protected(self, func: ast.AST) -> None:
+        nested = {
+            n.name: n
+            for n in ast.walk(func)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not func
+        }
+        if not nested:
+            return
+        in_wait_for: set[int] = set()
+        all_calls: dict[str, list[int]] = {}
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            name = (
+                node.func.id if isinstance(node.func, ast.Name) else None
+            )
+            if name in nested:
+                all_calls.setdefault(name, []).append(id(node))
+            if callee_last(node) == "wait_for":
+                for arg in node.args:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Call) and isinstance(
+                            sub.func, ast.Name
+                        ):
+                            in_wait_for.add(id(sub))
+        for name, sites in all_calls.items():
+            if sites and all(s in in_wait_for for s in sites):
+                self._protected_defs.add(id(nested[name]))
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_func(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_func(node)
+
+    def _visit_func(self, node) -> None:
+        self._mark_protected(node)
+        protected = id(node) in self._protected_defs
+        if protected:
+            self._protection_depth += 1
+        self.generic_visit(node)
+        if protected:
+            self._protection_depth -= 1
+
+    # ---- awaits ----
+    def visit_Await(self, node: ast.Await) -> None:
+        self.generic_visit(node)
+        if self._protection_depth > 0:
+            return
+        value = node.value
+        if isinstance(value, (ast.Name, ast.Attribute)):
+            self.findings.append(
+                self.ctx.finding(
+                    self.checker,
+                    node,
+                    "await of a bare future/task has no deadline — wrap "
+                    "in asyncio.wait_for or reclaim via "
+                    "rpc.apply_with_timeout",
+                )
+            )
+            return
+        if isinstance(value, ast.Call):
+            callee = callee_last(value)
+            if callee in _BOUNDED_CALLEES:
+                return
+            if callee in _UNBOUNDED_LEAF_CALLEES and not has_kwarg(
+                value, "timeout"
+            ):
+                self.findings.append(
+                    self.ctx.finding(
+                        self.checker,
+                        node,
+                        f"await of .{callee}(...) has no timeout= and no "
+                        "wait_for wrapper",
+                    )
+                )
+
+    # ---- sync Future.result() ----
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "result"
+            and not node.args
+            and not has_kwarg(node, "timeout")
+        ):
+            self.findings.append(
+                self.ctx.finding(
+                    self.checker,
+                    node,
+                    ".result() without a timeout blocks forever if the "
+                    "producer dies — pass timeout=",
+                )
+            )
+
+
+@register
+class UnboundedWaitChecker(Checker):
+    code = "VDT003"
+    rule = "unbounded-wait"
+    description = "control-plane wait without a deadline"
+    rationale = (
+        "an unbounded wait turns a silent host into a wedged driver; "
+        "every control-plane wait needs a deadline"
+    )
+    scope = ("distributed/", "executor/", "engine/supervisor.py")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        visitor = _Visitor(self, ctx)
+        visitor.visit(ctx.tree)
+        return visitor.findings

@@ -1,0 +1,123 @@
+"""CLI: ``python -m tools.vdt_lint [--format json|text] [paths]``.
+
+Exit status 0 when the tree is clean (no unwaived, un-baselined
+findings), 1 otherwise — so the command can gate CI standalone, in
+lock-step with the tier-1 pytest gate (tests/test_code_hygiene.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.vdt_lint.baseline import save_baseline
+from tools.vdt_lint.core import (
+    DEFAULT_BASELINE_PATH,
+    PACKAGE_ROOT,
+    Finding,
+    all_checkers,
+    run_lint,
+)
+
+
+def _finding_dict(f: Finding) -> dict:
+    return {
+        "code": f.code,
+        "rule": f.rule,
+        "path": f.path,
+        "line": f.line,
+        "message": f.message,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.vdt_lint",
+        description=(
+            "Project-native static analysis for the engine's "
+            "concurrency, registry, and failure-handling invariants."
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=None,
+        help=f"files/dirs to lint (default: {PACKAGE_ROOT.name}/)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text"
+    )
+    parser.add_argument(
+        "--baseline",
+        default=str(DEFAULT_BASELINE_PATH),
+        help="baseline file (default: the committed one)",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report baselined findings as new",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="accept current new findings into --baseline and exit 0",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for checker in all_checkers():
+            scope = (
+                ", ".join(checker.scope) if checker.scope else "package-wide"
+            )
+            print(f"{checker.code}  {checker.rule:<18} [{scope}]")
+            print(f"        {checker.rationale}")
+        return 0
+
+    from tools.vdt_lint.baseline import load_baseline
+
+    baseline = (
+        None if args.no_baseline else load_baseline(args.baseline)
+    )
+    report = run_lint(args.paths or None, baseline=baseline)
+
+    if args.write_baseline:
+        save_baseline(args.baseline, report.new + report.baselined)
+        print(
+            f"vdt-lint: baselined {len(report.new) + len(report.baselined)} "
+            f"finding(s) into {args.baseline}"
+        )
+        return 0
+
+    status = 1 if report.new else 0
+    try:
+        if args.format == "json":
+            print(
+                json.dumps(
+                    {
+                        "new": [_finding_dict(f) for f in report.new],
+                        "waived": [_finding_dict(f) for f in report.waived],
+                        "baselined": [
+                            _finding_dict(f) for f in report.baselined
+                        ],
+                        "files": report.files,
+                    },
+                    indent=2,
+                )
+            )
+        else:
+            for f in report.new:
+                print(f.render())
+            print(report.summary(), file=sys.stderr)
+    except BrokenPipeError:
+        # `... | head` closed the pipe mid-report: truncated output is
+        # fine, but the exit status must still reflect the findings
+        # (CI pipefail relies on it).  Point stdout at devnull so the
+        # interpreter's exit flush doesn't re-raise.
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+    return status

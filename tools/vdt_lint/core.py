@@ -1,0 +1,287 @@
+"""Framework core: parse once, run every checker, classify findings.
+
+The driver parses each target file exactly once into a ``FileContext``
+(AST + source + waiver map) and hands the same context to every
+registered checker — adding a checker never adds a parse pass, which is
+what keeps the tier-1 lint gate cheap as the rule catalog grows.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Iterator
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+PACKAGE_ROOT = REPO_ROOT / "vllm_distributed_tpu"
+DEFAULT_BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+
+# Matches "vdt-lint: disable=rule-a,rule-b" (or "disable=all") anywhere
+# inside a comment; everything after the rule list (an em-dash
+# justification, say) is ignored.
+_WAIVER_RE = re.compile(r"vdt-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+_ALL = "all"
+
+
+@dataclass(frozen=True)
+class Finding:
+    code: str  # "VDT003"
+    rule: str  # "unbounded-wait"
+    path: str  # repo-root-relative posix path (display + baseline key)
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} [{self.rule}] {self.message}"
+
+
+class FileContext:
+    """One parsed target file, shared by every checker."""
+
+    def __init__(self, path: Path, rel: str, scope_rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.scope_rel = scope_rel
+        self.source = source
+        self.tree: ast.Module = ast.parse(source, filename=str(path))
+        self.waivers: dict[int, set[str]] = _parse_waivers(source)
+
+    def finding(self, checker: "Checker", node_or_line, message: str) -> Finding:
+        line = getattr(node_or_line, "lineno", node_or_line)
+        return Finding(checker.code, checker.rule, self.rel, line, message)
+
+
+def _parse_waivers(source: str) -> dict[int, set[str]]:
+    """line -> waived rule names.  A trailing comment waives its own
+    line; a comment that is the whole line waives the next non-blank,
+    non-comment line (so long statements can carry a waiver above)."""
+    waivers: dict[int, set[str]] = {}
+    lines = source.splitlines()
+    try:
+        tokens = list(
+            tokenize.generate_tokens(io.StringIO(source).readline)
+        )
+    except (tokenize.TokenError, IndentationError):  # pragma: no cover
+        return waivers
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _WAIVER_RE.search(tok.string)
+        if m is None:
+            continue
+        # Each comma-separated piece is "<rule> [justification...]":
+        # only the first word is the rule, so `disable=VDT003 because
+        # the caller bounds it` (or an ASCII-hyphen justification)
+        # still waives VDT003 instead of silently matching nothing.
+        rules = {
+            piece.split()[0]
+            for piece in m.group(1).split(",")
+            if piece.split()
+        }
+        line = tok.start[0]
+        own_line = lines[line - 1].lstrip().startswith("#")
+        if own_line:
+            # Bind to the next line that holds code.
+            target = line + 1
+            while target <= len(lines) and (
+                not lines[target - 1].strip()
+                or lines[target - 1].lstrip().startswith("#")
+            ):
+                target += 1
+            waivers.setdefault(target, set()).update(rules)
+        else:
+            waivers.setdefault(line, set()).update(rules)
+    return waivers
+
+
+class Project:
+    """Everything a whole-project checker needs: the parsed files plus
+    the roots they were collected from."""
+
+    def __init__(self, contexts: list[FileContext], roots: list[Path]):
+        self.contexts = contexts
+        self.roots = roots
+
+    def get(self, scope_rel: str) -> FileContext | None:
+        for ctx in self.contexts:
+            if ctx.scope_rel == scope_rel:
+                return ctx
+        return None
+
+
+class Checker:
+    """One invariant.  Subclasses set the metadata and override
+    ``check_file`` (per parsed file, already scope-filtered) and/or
+    ``check_project`` (once per run)."""
+
+    code: str = "VDT000"
+    rule: str = "abstract"
+    description: str = ""
+    rationale: str = ""
+    # Path prefixes (package-relative, posix) the checker applies to;
+    # None = every scanned file.  "engine/supervisor.py" matches one file.
+    scope: tuple[str, ...] | None = None
+
+    def applies(self, scope_rel: str) -> bool:
+        if self.scope is None:
+            return True
+        return any(
+            scope_rel == s or scope_rel.startswith(s) for s in self.scope
+        )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: dict[str, Checker] = {}
+
+
+def register(cls: type[Checker]) -> type[Checker]:
+    inst = cls()
+    for key in (inst.rule, inst.code):
+        if key in _REGISTRY:
+            raise ValueError(f"duplicate checker registration: {key}")
+    _REGISTRY[inst.rule] = inst
+    _REGISTRY[inst.code] = inst
+    return cls
+
+
+def all_checkers() -> list[Checker]:
+    seen: dict[str, Checker] = {}
+    for inst in _REGISTRY.values():
+        seen.setdefault(inst.code, inst)
+    return sorted(seen.values(), key=lambda c: c.code)
+
+
+@dataclass
+class Report:
+    files: int = 0
+    new: list[Finding] = field(default_factory=list)
+    waived: list[Finding] = field(default_factory=list)
+    baselined: list[Finding] = field(default_factory=list)
+
+    @property
+    def all_findings(self) -> list[Finding]:
+        return self.new + self.waived + self.baselined
+
+    def summary(self) -> str:
+        return (
+            f"vdt-lint: {len(self.new)} new finding(s), "
+            f"{len(self.waived)} waived, {len(self.baselined)} baselined "
+            f"across {self.files} file(s)"
+        )
+
+
+def _collect_files(paths: Iterable[Path]) -> Iterator[tuple[Path, Path]]:
+    """Yield (file, scan_root) pairs, each file once."""
+    seen: set[Path] = set()
+    for p in paths:
+        p = Path(p).resolve()
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if f not in seen and "__pycache__" not in f.parts:
+                    seen.add(f)
+                    yield f, p
+        elif p not in seen:
+            seen.add(p)
+            yield p, p.parent
+
+
+def _scope_rel(path: Path, scan_root: Path) -> str:
+    """Package-relative path used for checker scoping: parts after the
+    last ``vllm_distributed_tpu`` component when present (the real
+    package), otherwise relative to the scanned root (fixture trees)."""
+    parts = path.parts
+    if "vllm_distributed_tpu" in parts[:-1]:
+        idx = len(parts) - 1 - parts[:-1][::-1].index("vllm_distributed_tpu")
+        return "/".join(parts[idx:])
+    try:
+        return path.relative_to(scan_root).as_posix()
+    except ValueError:  # pragma: no cover
+        return path.name
+
+
+def _display_rel(path: Path) -> str:
+    try:
+        return path.relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def _is_waived(finding: Finding, ctx: FileContext) -> bool:
+    rules = ctx.waivers.get(finding.line)
+    if not rules:
+        return False
+    return bool(rules & {finding.rule, finding.code, _ALL})
+
+
+def run_lint(
+    paths: Iterable[Path | str] | None = None,
+    baseline: Iterable[dict] | None | str = "default",
+    checkers: Iterable[Checker] | None = None,
+) -> Report:
+    """Parse every target once, run every checker, classify findings as
+    new / waived / baselined.  ``baseline="default"`` loads the
+    committed file; ``None`` disables baselining."""
+    from tools.vdt_lint.baseline import load_baseline, match_baseline
+
+    paths = [Path(p) for p in (paths or [PACKAGE_ROOT])]
+    if baseline == "default":
+        baseline = load_baseline(DEFAULT_BASELINE_PATH)
+    checkers = list(checkers) if checkers is not None else all_checkers()
+
+    report = Report()
+    contexts: list[FileContext] = []
+    raw: list[tuple[Finding, FileContext | None]] = []
+    for file, scan_root in _collect_files(paths):
+        try:
+            source = file.read_text()
+            ctx = FileContext(
+                file, _display_rel(file), _scope_rel(file, scan_root), source
+            )
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            # Classified with everything else (ctx None: no inline
+            # waivers in an unparseable file, but baselining works).
+            raw.append((
+                Finding(
+                    "VDT000",
+                    "parse-error",
+                    _display_rel(file),
+                    getattr(e, "lineno", 0) or 0,
+                    f"could not parse: {e}",
+                ),
+                None,
+            ))
+            continue
+        contexts.append(ctx)
+    report.files = len(contexts)
+
+    project = Project(contexts, [Path(p).resolve() for p in paths])
+    by_rel = {ctx.rel: ctx for ctx in contexts}
+    for checker in checkers:
+        for ctx in contexts:
+            if checker.applies(ctx.scope_rel):
+                for finding in checker.check_file(ctx):
+                    raw.append((finding, ctx))
+        for finding in checker.check_project(project):
+            raw.append((finding, by_rel.get(finding.path)))
+
+    baseline_entries = list(baseline) if baseline else []
+    for finding, ctx in sorted(
+        raw, key=lambda fc: (fc[0].path, fc[0].line, fc[0].code)
+    ):
+        if ctx is not None and _is_waived(finding, ctx):
+            report.waived.append(finding)
+        elif match_baseline(finding, baseline_entries):
+            report.baselined.append(finding)
+        else:
+            report.new.append(finding)
+    return report

@@ -522,7 +522,7 @@ class EngineArgs:
             max_batched = max(2048, self.max_num_seqs)
         hbm_utilization = self.hbm_utilization
         if hbm_utilization is None:
-            hbm_utilization = float(os.environ.get("VDT_HBM_UTILIZATION", "0.9"))
+            hbm_utilization = envs.VDT_HBM_UTILIZATION
         cache_config = CacheConfig(
             page_size=self.page_size,
             num_pages=self.num_kv_pages,

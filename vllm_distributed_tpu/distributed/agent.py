@@ -142,12 +142,10 @@ async def agent_async_main(server_ip: str, port: int | None = None) -> None:
     # injector the mock-worker layer can arm over RPC, and a
     # deterministic pre-dial delay.
     injector = None
-    if os.environ.get("VDT_FAULT_INJECTION") == "1":
+    if envs.VDT_FAULT_INJECTION:
         injector = FaultInjector()
         set_global_injector(injector)
-    connect_delay = float(
-        os.environ.get("VDT_FAULT_CONNECT_DELAY_SECONDS", "0")
-    )
+    connect_delay = envs.VDT_FAULT_CONNECT_DELAY_SECONDS
 
     info_cache: dict[str, Any] = {}
 
@@ -156,8 +154,8 @@ async def agent_async_main(server_ip: str, port: int | None = None) -> None:
         jax here would pin the agent's backend before the worker's
         jax.distributed.initialize (which must run first).  Env
         overrides let operators/tests pin the advertisement."""
-        env_chips = os.environ.get("VDT_ADVERTISE_NUM_CHIPS")
-        env_platform = os.environ.get("VDT_ADVERTISE_PLATFORM")
+        env_chips = envs.VDT_ADVERTISE_NUM_CHIPS
+        env_platform = envs.VDT_ADVERTISE_PLATFORM
         if env_chips and env_platform:
             return {"num_chips": int(env_chips), "platform": env_platform}
         if not info_cache:
@@ -169,7 +167,22 @@ async def agent_async_main(server_ip: str, port: int | None = None) -> None:
                 stdout=asyncio.subprocess.PIPE,
                 stderr=asyncio.subprocess.DEVNULL,
             )
-            out, _ = await proc.communicate()
+            try:
+                # Deadline on the probe: a wedged TPU runtime must not
+                # hang the agent forever.  Must stay under the driver's
+                # 60s host_info budget (multihost._handle_agent) so the
+                # 0-chip fallback reply reaches the driver before its
+                # wait_for fires and it drops the connection.
+                out, _ = await asyncio.wait_for(proc.communicate(), 45)
+            except asyncio.TimeoutError:
+                proc.kill()
+                # vdt-lint: disable=unbounded-wait — just SIGKILL'd:
+                # the child exits promptly and only needs reaping.
+                await proc.wait()
+                # Deliberately NOT cached: a transient wedge (cold TPU
+                # runtime) must not mis-advertise this host for the
+                # agent's lifetime — the next host_info call re-probes.
+                return {"num_chips": 0, "platform": "unknown"}
             try:
                 chips, platform = out.decode().split()[-2:]
                 info_cache.update(
@@ -224,10 +237,13 @@ async def agent_async_main(server_ip: str, port: int | None = None) -> None:
             await asyncio.sleep(connect_delay)
         while True:
             try:
-                reader, writer = await asyncio.open_connection(
-                    server_ip, port
+                # Bounded dial: a SYN that never answers (blackholed
+                # server) must fall into the retry/backoff path, not
+                # wedge the agent in connect forever.
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(server_ip, port), 30
                 )
-            except OSError as e:
+            except (OSError, asyncio.TimeoutError) as e:
                 delay = reconnect_delay(attempt)
                 attempt += 1
                 logger.info(
@@ -252,6 +268,8 @@ async def agent_async_main(server_ip: str, port: int | None = None) -> None:
                 server_silence_watchdog(hb)
             )
             try:
+                # vdt-lint: disable=unbounded-wait — serve-until-disconnect
+                # by contract; the watchdog task in the set IS the deadline.
                 await asyncio.wait(
                     {readloop_task, watchdog_task},
                     return_when=asyncio.FIRST_COMPLETED,
@@ -264,6 +282,9 @@ async def agent_async_main(server_ip: str, port: int | None = None) -> None:
                         "exiting to release TPU devices"
                     )
                     sys.exit(1)
+                # vdt-lint: disable=unbounded-wait — FIRST_COMPLETED above
+                # guarantees this task is already done; the await only
+                # re-raises its exception.
                 await readloop_task
             except Exception as e:  # noqa: BLE001
                 logger.warning("connection lost: %s", e)

@@ -167,6 +167,9 @@ class RpcPeer:
             await self._send(
                 {"type": "param", "id": reply_id, "param": name}
             )
+        # vdt-lint: disable=unbounded-wait — param fetches are boot-time
+        # calls; every call site bounds them (wait_for in _handle_agent /
+        # _heartbeat_loop, .result(timeout=INIT) around _create_remote_workers).
         return await fut
 
     # camelCase alias matching the reference surface (launch.py:190).
@@ -208,9 +211,14 @@ class RpcPeer:
         async def send_then_wait():
             fut = self._make_pending(reply_id)
             if fut.done():
+                # vdt-lint: disable=unbounded-wait — already resolved
+                # (killed-peer short circuit); the await just re-raises.
                 return await fut
             if timeout is None:
                 await self._send(msg)
+                # vdt-lint: disable=unbounded-wait — timeout=None is the
+                # caller's explicit contract (device work whose deadline
+                # the dispatching executor owns, cf. collective_rpc).
                 return await fut
 
             async def send_and_wait():
@@ -249,6 +257,10 @@ class RpcPeer:
         msg = _extract_buffers(msg, buffers)
         result = self.send(msg, buffers)
         if inspect.isawaitable(result):
+            # vdt-lint: disable=unbounded-wait — transport backpressure:
+            # deadline-bounded applies cover their own send (send_and_wait
+            # inside wait_for), and a wedged peer trips the heartbeat
+            # watchdog, which kills this peer and fails the wait.
             await result
 
     # ---- incoming ----
@@ -321,6 +333,10 @@ class RpcPeer:
                     ) as sp:
                         value = fn(*args, **kwargs)
                         if inspect.isawaitable(value):
+                            # vdt-lint: disable=unbounded-wait — executing
+                            # the target on the REMOTE caller's behalf; the
+                            # calling side owns the deadline (apply_with_
+                            # timeout) and long device work is legitimate.
                             value = await value
                 finally:
                     if sp is not None:
@@ -328,6 +344,9 @@ class RpcPeer:
             else:
                 value = fn(*args, **kwargs)
                 if inspect.isawaitable(value):
+                    # vdt-lint: disable=unbounded-wait — same contract as
+                    # the traced branch above: the remote caller's
+                    # deadline bounds this execution.
                     value = await value
             if oneway:
                 return

@@ -117,6 +117,8 @@ class FaultInjector:
             await asyncio.sleep(delay)
             return kind, payload
         # hang: a wedged sender never completes this write.
+        # vdt-lint: disable=unbounded-wait — the unbounded wait IS the
+        # fault being injected (tests assert detection stays bounded).
         await asyncio.Event().wait()
         return None  # unreachable
 
@@ -159,8 +161,13 @@ class StreamRpcTransport(RpcTransport):
         self.injector = injector
 
     async def read(self) -> tuple[int, bytes]:
+        # vdt-lint: disable=unbounded-wait — the read side blocks until
+        # traffic or EOF by contract (SURVEY.md §5.3: read loop ending =
+        # disconnect detection); liveness is owned by the heartbeat
+        # loop, which closes this transport to unblock it.
         header = await self.reader.readexactly(_HEADER.size)
         length, kind = _HEADER.unpack(header)
+        # vdt-lint: disable=unbounded-wait — same read-side contract.
         payload = await self.reader.readexactly(length)
         return kind, payload
 
@@ -171,6 +178,10 @@ class StreamRpcTransport(RpcTransport):
                 return
             kind, payload = frame
         self.writer.write(_HEADER.pack(len(payload), kind) + payload)
+        # vdt-lint: disable=unbounded-wait — backpressure wait: deadline
+        # ownership is the sender's (deadline-bounded applies time out
+        # their own send; heartbeat misses kill a wedged peer, and the
+        # kill path closes this writer, failing the drain).
         await self.writer.drain()
 
     def close(self) -> None:
@@ -237,6 +248,9 @@ def prepare_peer_readloop(
         pending_buffers: list[bytes] = []
         try:
             while True:
+                # vdt-lint: disable=unbounded-wait — the read loop runs
+                # until EOF by contract; heartbeats own liveness and
+                # close the transport to end it.
                 kind, payload = await transport.read()
                 if kind == _BUF:
                     pending_buffers.append(payload)

@@ -84,6 +84,30 @@ environment_variables: dict[str, Callable[[], Any]] = {
     "VDT_TRACE_RING_SIZE": lambda: int(
         os.environ.get("VDT_TRACE_RING_SIZE", "256")
     ),
+    # OTLP export of completed traces (tracing.py): on by default when
+    # the opentelemetry SDK is importable; "0"/"false" disables even
+    # with the SDK present.
+    "VDT_TRACE_OTLP": lambda: os.environ.get("VDT_TRACE_OTLP", "1")
+    not in ("0", "false"),
+    # --- per-host test/operator hooks (never replicated) ---
+    # Install the deterministic FaultInjector on this process's RPC
+    # transports (tests/test_fault_injection.py arms it over RPC).
+    "VDT_FAULT_INJECTION": lambda: os.environ.get(
+        "VDT_FAULT_INJECTION", ""
+    )
+    == "1",
+    # Deterministic pre-dial delay in the agent (fault harness only).
+    "VDT_FAULT_CONNECT_DELAY_SECONDS": lambda: float(
+        os.environ.get("VDT_FAULT_CONNECT_DELAY_SECONDS", "0")
+    ),
+    # Pin this host's chip advertisement instead of probing jax in a
+    # subprocess (operators/tests; both must be set to take effect).
+    "VDT_ADVERTISE_NUM_CHIPS": lambda: os.environ.get(
+        "VDT_ADVERTISE_NUM_CHIPS"
+    ),
+    "VDT_ADVERTISE_PLATFORM": lambda: os.environ.get(
+        "VDT_ADVERTISE_PLATFORM"
+    ),
     # --- engine ---
     "VDT_LOG_LEVEL": lambda: os.environ.get("VDT_LOG_LEVEL", "INFO"),
     "VDT_COMPILE_CACHE_DIR": lambda: os.environ.get(
@@ -125,6 +149,13 @@ NON_REPLICATED_ENV_VARS = {
     "JAX_PLATFORMS",
     "LOCAL_RANK",
     "RANK",
+    # Per-host test/operator hooks: the driver's values must never leak
+    # onto remote hosts (arming faults fleet-wide, or pinning every
+    # host's chip advertisement to the driver's, would be wrong).
+    "VDT_FAULT_INJECTION",
+    "VDT_FAULT_CONNECT_DELAY_SECONDS",
+    "VDT_ADVERTISE_NUM_CHIPS",
+    "VDT_ADVERTISE_PLATFORM",
 }
 
 # Extra vars replicated even though they are not VDT_* (launch.py:70-72).

@@ -128,7 +128,7 @@ class MultiHostExecutor(Executor):
         )
 
         self.distributed_init_method = get_distributed_init_method(
-            os.environ.get("VDT_HOST_IP") or get_ip(), get_open_port()
+            envs.VDT_HOST_IP or get_ip(), get_open_port()
         )
 
         # Accept agents until every host slot is filled.
@@ -247,15 +247,16 @@ class MultiHostExecutor(Executor):
                 writer.close()
                 return await self._await_readloop(readloop_task)
             required = max(self.parallel_config.world_size // self.num_hosts, 1)
-            if (
+            if info.get("platform") == "unknown" or (
                 info.get("platform") == "tpu"
                 and info.get("num_chips", 0) < required
             ):
                 logger.warning(
-                    "agent %s offers %d chip(s); deployment needs %d per "
-                    "host — skipping this host",
+                    "agent %s offers %d chip(s) on platform %r; deployment "
+                    "needs %d per host — skipping this host",
                     addr,
                     info.get("num_chips", 0),
+                    info.get("platform"),
                     required,
                 )
                 writer.close()
@@ -281,6 +282,9 @@ class MultiHostExecutor(Executor):
                 and not self._hosts_ready.done()
             ):
                 self._hosts_ready.set_result(True)
+            # vdt-lint: disable=unbounded-wait — serves this agent until
+            # disconnect by contract; the heartbeat loop owns liveness
+            # and closes the transport to end it.
             await readloop_task
         except Exception as e:  # noqa: BLE001
             logger.warning("agent %s read loop ended: %s", addr, e)
@@ -310,6 +314,8 @@ class MultiHostExecutor(Executor):
                     self._remote_hosts.remove(host)
 
     async def _host_info(self, peer) -> dict:
+        # vdt-lint: disable=unbounded-wait — _handle_agent wraps this
+        # whole coroutine in asyncio.wait_for(..., 60).
         host_info = await peer.get_param("host_info")
         return await host_info()
 
@@ -318,9 +324,11 @@ class MultiHostExecutor(Executor):
         """Drain a rejected agent's read loop (errors expected: we just
         closed its transport)."""
         try:
+            # vdt-lint: disable=unbounded-wait — the transport is already
+            # closed, so the loop ends on the EOF/error it is about to read.
             await task
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001
+            logger.debug("rejected agent read loop ended: %s", e)
 
     async def _create_remote_workers(self) -> None:
         env = envs.replication_env()
@@ -339,6 +347,8 @@ class MultiHostExecutor(Executor):
             # it AFTER .result() re-raises, so no finally-clear here (it
             # would wipe the attribution before the engine thread looks).
             self._creating_host = host
+            # vdt-lint: disable=unbounded-wait — _boot bounds the whole
+            # coroutine with .result(timeout=VDT_INIT_TIMEOUT_SECONDS).
             create_worker = await host.peer.get_param("create_worker")
             host.worker = await create_worker(
                 self.config,
@@ -565,7 +575,10 @@ class MultiHostExecutor(Executor):
                 )
 
         def _local_fetch():
-            local_d.result()  # dispatch errors surface here, in order
+            # Dispatch errors surface here, in order.  Deadline-bounded:
+            # a wedged local dispatch must fail this step's gather, not
+            # hang the fetch-pool thread forever.
+            local_d.result(timeout=self.execute_timeout)
             return run_method(
                 self._local_worker, "fetch_results", (step_id,), {}
             )
@@ -709,8 +722,9 @@ class MultiHostExecutor(Executor):
             # raise "Executor failed" immediately.
             try:
                 self.collective_rpc("shutdown", timeout=15.0)
-            except Exception:  # noqa: BLE001 — failed/partial deployments
-                pass
+            except Exception as e:  # noqa: BLE001 — failed/partial
+                # deployments tear down as far as they can.
+                logger.debug("shutdown collective failed: %s", e)
         for host in self._remote_hosts:
             try:
                 host.peer.kill("executor shutdown")

@@ -49,8 +49,10 @@ def _configure_root() -> None:
     handler = logging.StreamHandler(sys.stderr)
     handler.setFormatter(logging.Formatter(_FORMAT, datefmt=_DATEFMT))
     handler.addFilter(_HostPrefixFilter())
+    from vllm_distributed_tpu import envs
+
     root = logging.getLogger("vllm_distributed_tpu")
-    root.setLevel(os.environ.get("VDT_LOG_LEVEL", "INFO").upper())
+    root.setLevel(envs.VDT_LOG_LEVEL.upper())
     root.addHandler(handler)
     root.propagate = False
 

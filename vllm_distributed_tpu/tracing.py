@@ -524,7 +524,9 @@ class Tracer:
             logger.debug("OTLP export failed: %s", e)
 
     def _init_otlp(self):
-        if os.environ.get("VDT_TRACE_OTLP", "1") in ("0", "false"):
+        from vllm_distributed_tpu import envs
+
+        if not envs.VDT_TRACE_OTLP:
             return False
         try:
             from opentelemetry.sdk.resources import Resource
