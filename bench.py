@@ -332,6 +332,10 @@ def _measure(engine, build, free_engine, *, batch, k_steps, quant,
 
     step_ms: list[float] = []
     timed_tokens = 0
+    # Snapshot so the reported count covers the timed window only —
+    # warmup/prefill-boundary reconciliations are expected and would
+    # otherwise mask a nonzero steady-state reading.
+    breaks_before = getattr(engine, "pipeline_breaks", 0)
     t0 = time.perf_counter()
     while engine.has_unfinished_requests():
         t1 = time.perf_counter()
@@ -399,6 +403,11 @@ def _measure(engine, build, free_engine, *, batch, k_steps, quant,
         "elapsed_s": round(elapsed, 3),
         "tokens_per_sec": round(tps, 1),
         "tokens_per_sec_p50": round(tps_p50, 1),
+        # The dispatch tax (ISSUE 7): steady-state p50 throughput minus
+        # what the wall clock actually delivered.  0 means the driver
+        # never fell off the p50 pace; r03 measured a 2,338 tok/s gap
+        # here before the overlapped dispatch pipeline.
+        "wall_vs_p50_gap": round(tps_p50 - tps, 1),
         "dispatch_ms_p50": round(p50_ms, 2),
         "dispatch_ms_max": round(max(step_ms), 2),
         # Windows > 2x the median are classified as stalls (transport
@@ -421,6 +430,10 @@ def _measure(engine, build, free_engine, *, batch, k_steps, quant,
         "param_bytes": param_bytes,
         "kv_read_bytes_per_microstep": kv_read_bytes,
     }
+    # Async-scheduling reconciliation drains over the timed window (0 at
+    # steady-state decode; each one idles the device for a full drain).
+    if hasattr(engine, "pipeline_breaks"):
+        detail["pipeline_breaks"] = engine.pipeline_breaks - breaks_before
     sched = getattr(engine, "scheduler", None)
     if sched is not None and getattr(sched, "prefix_cache_queries", 0):
         detail["prefix_cache_hit_rate"] = round(

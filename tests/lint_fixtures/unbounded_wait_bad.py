@@ -3,13 +3,23 @@
 import asyncio
 
 
-async def waits(fut, peer, reader, proc):
+async def waits(fut, peer, reader, proc, ev):
     await fut  # EXPECT
     await peer.get_param("ping")  # EXPECT
     await asyncio.wait({fut})  # EXPECT
     await reader.readexactly(4)  # EXPECT
     await proc.communicate()  # EXPECT
+    await ev.wait()  # EXPECT exactly one finding: the await path owns
+    # this leaf; the sync .wait() branch must not double-count it.
 
 
 def sync_result(fut):
     return fut.result()  # EXPECT
+
+
+def step_queue_loop(inbox, stop_event):
+    # The step-queue wait pattern gone wrong: unbounded queue get and
+    # event wait park the loop thread past stop().
+    frame = inbox.get()  # EXPECT
+    stop_event.wait()  # EXPECT
+    return frame

@@ -4,11 +4,15 @@ composition, whose callee owns it)."""
 import asyncio
 
 
-async def bounded(fut, peer, reader):
+async def bounded(fut, peer, reader, ev):
     await asyncio.wait_for(fut, 5)
     await asyncio.sleep(1)
     await asyncio.wait({fut}, timeout=5)
     await reader.readexactly(4, timeout=5)
+    # The canonical bounded-event pattern: asyncio.Event.wait takes no
+    # timeout kwarg, the wait_for wrapper IS the deadline — the sync
+    # .wait() branch must not flag it.
+    await asyncio.wait_for(ev.wait(), timeout=5)
     # Composition: awaiting an ordinary coroutine call is the callee's
     # (or its orchestrator's) deadline to own.
     await helper(peer)
@@ -30,3 +34,19 @@ async def nested_wait_for(fut, msg, send):
 
 def sync_result(fut):
     return fut.result(timeout=5)
+
+
+def step_queue_loop(inbox, stop, results):
+    # The step-queue wait pattern (worker/step_stream.py): bounded poll
+    # plus stop-flag re-check, so stop() always wins within one tick.
+    import queue
+
+    while not stop.is_set():
+        try:
+            frame = inbox.get(timeout=0.5)
+        except queue.Empty:
+            continue
+        results.append(frame)
+    stop.wait(timeout=5)
+    # dict.get always takes a key — a positional arg is not a queue wait.
+    return {"a": 1}.get("a")

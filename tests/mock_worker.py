@@ -33,7 +33,10 @@ import time
 
 from vllm_distributed_tpu.outputs import ModelRunnerOutput
 
-# Simulated device time per fused dispatch in the two-phase protocol.
+# Simulated device time per fused dispatch in the two-phase protocol
+# (per-process override: VDT_MOCK_STEP_SECONDS — the dispatch
+# microbench shrinks it to put driver overhead and device time in the
+# production regime).
 MOCK_STEP_SECONDS = 0.3
 
 _TRANSPORT_FAULTS = {
@@ -72,6 +75,11 @@ class MockWorker:
         # tests need a stream slow enough to kill mid-generation).
         self._execute_sleep = float(
             os.environ.get("VDT_MOCK_EXECUTE_SLEEP_SECONDS", "0")
+        )
+        self._step_seconds = float(
+            os.environ.get(
+                "VDT_MOCK_STEP_SECONDS", str(MOCK_STEP_SECONDS)
+            )
         )
 
     # ---- fault injection ----
@@ -129,16 +137,20 @@ class MockWorker:
                 req_id: [42]
                 for req_id in scheduler_output.num_scheduled_tokens
             }
-        for nr in scheduler_output.new_requests:
-            self._seq_state[nr.req_id] = {
-                "total": len(nr.prompt_token_ids),
-                "computed": nr.num_computed_tokens,
-            }
+        # Drop finished/preempted state BEFORE seeding new requests —
+        # the real worker's _apply_scheduler_deltas order — so a step
+        # that both finishes request id X and re-admits a new X keeps
+        # the new state.
         for req_id in (
             scheduler_output.finished_req_ids
             + scheduler_output.preempted_req_ids
         ):
             self._seq_state.pop(req_id, None)
+        for nr in scheduler_output.new_requests:
+            self._seq_state[nr.req_id] = {
+                "total": len(nr.prompt_token_ids),
+                "computed": nr.num_computed_tokens,
+            }
         sampled: dict[str, list[int]] = {}
         for req_id, n in scheduler_output.num_scheduled_tokens.items():
             st = self._seq_state.get(req_id)
@@ -149,8 +161,14 @@ class MockWorker:
                 # Prompt fully prefetched: sample.  The token IS the
                 # absolute position, so a replayed request (longer
                 # prompt, same total) continues the identical sequence.
-                sampled[req_id] = [st["total"]]
-                st["total"] += 1
+                # A fused decode window (num_new > 1, engine
+                # num_decode_steps > 1) emits one position token per
+                # micro-step, exactly like the real worker's scan.
+                k = st["computed"] - st["total"] + 1
+                sampled[req_id] = list(
+                    range(st["total"], st["total"] + k)
+                )
+                st["total"] += k
         return sampled
 
     def execute_model(self, scheduler_output) -> ModelRunnerOutput | None:
@@ -176,7 +194,7 @@ class MockWorker:
     def fetch_results(self, step_id: int) -> ModelRunnerOutput | None:
         so = self._deferred.get(timeout=10)
         assert so.step_id == step_id, (so.step_id, step_id)
-        time.sleep(MOCK_STEP_SECONDS)  # pretend the device is busy
+        time.sleep(self._step_seconds)  # pretend the device is busy
         self.timeline.append(("fetch_done", step_id, time.monotonic()))
         sampled = self._sample(so)
         if not self.is_driver_worker:

@@ -701,6 +701,73 @@ def test_kill_mid_stream_recovers_and_replays(recovery_deployment):
     _assert_no_new_vdt_threads(baseline)
 
 
+def test_kill_with_steps_queued_in_stream_recovers(tmp_path, monkeypatch):
+    """ISSUE 7 fault interplay: with the overlapped dispatch pipeline
+    active (step streams + fused async scheduling, two steps in
+    flight), kill the remote host while steps are queued in its stream
+    — the in-flight/queued frames die with the host, the supervisor
+    rebuild still replays the journaled request, the continuation is
+    bit-identical, and nothing (loop threads, stream runners, futures)
+    leaks."""
+    port = get_open_port()
+    _recovery_env(monkeypatch, tmp_path, port)
+    # Pipelined protocol knobs: fused windows through the two-phase
+    # stream path, device slow enough that the driver's two-in-flight
+    # discipline keeps the remote inbox non-empty at kill time.
+    monkeypatch.setenv("VDT_STEP_STREAMS", "1")
+    monkeypatch.setenv("VDT_MOCK_STEP_SECONDS", "0.1")
+    agent_env = {
+        **RECOVERY_AGENT_ENV,
+        "VDT_STEP_STREAMS": "1",
+        "VDT_MOCK_STEP_SECONDS": "0.1",
+    }
+    baseline = _vdt_threads()
+    agents = RespawningAgent(port, agent_env, spawn=_spawn_agent)
+    engine = AsyncLLM.from_engine_args(
+        _engine_args(
+            tmp_path,
+            num_decode_steps=4,  # fused windows -> non_block pipeline
+            max_model_len=512,
+            distributed_executor_backend=FaultMultiHostExecutor,
+        )
+    )
+    try:
+        prompt = [1, 2, 3]
+        max_tokens = 24
+        expected = list(range(3, 3 + max_tokens))
+        sp = SamplingParams(
+            temperature=0.0, max_tokens=max_tokens, ignore_eos=True
+        )
+
+        async def go(client):
+            tokens = []
+            killed = False
+            async for out in engine.generate(
+                "queued-victim",
+                prompt_token_ids=list(prompt),
+                sampling_params=sp,
+            ):
+                tokens = list(out.outputs[0].token_ids)
+                if not killed and len(tokens) >= 4:
+                    # First fused window delivered: the pipeline is
+                    # full — step N+1 is executing and N+2 is queued
+                    # in the stream when the host dies.
+                    agents.kill_current()
+                    killed = True
+            assert killed and out.finished
+            assert tokens == expected, f"{tokens} != {expected}"
+            r = await client.get("/health")
+            assert r.status == 200
+
+        _serve(engine, go)
+        assert engine.supervisor.restarts_total >= 1
+        assert _metric_value(engine, "vllm:requests_replayed_total") >= 1
+    finally:
+        engine.shutdown()
+        agents.stop()
+    _assert_no_new_vdt_threads(baseline)
+
+
 def test_restart_policy_exhaustion_goes_terminal(tmp_path, monkeypatch):
     """Exceeding VDT_MAX_ENGINE_RESTARTS within the crash-loop window
     lands in the pre-supervisor terminal state: typed EngineDeadError

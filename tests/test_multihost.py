@@ -137,6 +137,103 @@ def test_pipelined_dispatch_overlaps_cross_rpc(deployment):
     assert elapsed < 2 * MOCK_STEP_SECONDS + 1.0
 
 
+def test_dispatch_microbench():
+    """ISSUE 7 acceptance gate: on the mock-worker microbench the
+    overlapped protocol must (a) produce bit-identical greedy outputs,
+    (b) cut per-step dispatch time >= 5x at p50, (c) finish its wall
+    under the blocking path's summed dispatch time, and (d) record zero
+    steady-state stall windows."""
+    from tools.dispatch_microbench import run_microbench
+
+    report = run_microbench(batch=4, prompt_len=8, max_tokens=12)
+    assert report["ok"], report
+
+
+def _engine_run(tmp_path, monkeypatch, *, streams: str, decode_steps: int):
+    """Boot a full LLMEngine over the mocked 2-host deployment and run
+    three staggered greedy requests to completion; returns
+    req_id -> tokens."""
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+
+    port = get_open_port()
+    monkeypatch.setenv("VDT_SERVER_PORT", str(port))
+    monkeypatch.setenv("VDT_STEP_STREAMS", streams)
+    monkeypatch.setenv("VDT_MOCK_TOKEN_SEQ", "1")
+    monkeypatch.setenv("VDT_MOCK_STEP_SECONDS", "0.01")
+    monkeypatch.setenv("VDT_EXECUTE_MODEL_TIMEOUT_SECONDS", "30")
+    monkeypatch.setenv("VDT_COMPILE_CACHE_DIR", str(tmp_path / "cc"))
+    agent = _spawn_agent(
+        port,
+        {
+            "VDT_ADVERTISE_NUM_CHIPS": "4",
+            "VDT_ADVERTISE_PLATFORM": "cpu",
+            "VDT_MOCK_TOKEN_SEQ": "1",
+            "VDT_MOCK_STEP_SECONDS": "0.01",
+            "VDT_STEP_STREAMS": streams,
+        },
+    )
+    engine = None
+    try:
+        engine = LLMEngine.from_engine_args(
+            EngineArgs(
+                model=write_llama_config(
+                    str(tmp_path / f"m-{streams}-{decode_steps}")
+                ),
+                skip_tokenizer_init=True,
+                load_format="dummy",
+                num_hosts=2,
+                num_decode_steps=decode_steps,
+                max_model_len=512,
+                distributed_executor_backend=MockedMultiHostExecutor,
+            )
+        )
+        # Staggered prompt lengths: requests finish on different steps,
+        # forcing mid-window finishes (reconciliation) and held notices.
+        for i in range(3):
+            engine.add_request(
+                f"r{i}",
+                prompt_token_ids=list(range(1, 4 + 2 * i)),
+                sampling_params=SamplingParams(
+                    temperature=0.0, max_tokens=9 + i, ignore_eos=True
+                ),
+            )
+        tokens: dict[str, list[int]] = {}
+        while engine.has_unfinished_requests():
+            for out in engine.step():
+                tokens[out.request_id] = list(out.outputs[0].token_ids)
+        return tokens
+    finally:
+        if engine is not None:
+            engine.shutdown()
+        if agent.is_alive():
+            agent.terminate()
+        agent.join(timeout=5)
+
+
+def test_pipelined_vs_blocking_engine_outputs_bit_identical(
+    tmp_path, monkeypatch
+):
+    """ISSUE 7: the overlapped protocol (step streams + async fused
+    scheduling, two steps in flight) must be invisible in the outputs —
+    greedy tokens bit-identical to the blocking per-step RPC path."""
+    blocking = _engine_run(
+        tmp_path, monkeypatch, streams="0", decode_steps=1
+    )
+    overlapped = _engine_run(
+        tmp_path, monkeypatch, streams="1", decode_steps=4
+    )
+    # Mock seq mode: token i == absolute position, so request i
+    # (prompt 3+2i, max_tokens 9+i) must be exactly this range — both
+    # protocols are checked against the ORACLE, not just each other.
+    expected = {
+        f"r{i}": list(range(3 + 2 * i, 3 + 2 * i + 9 + i))
+        for i in range(3)
+    }
+    assert blocking == expected
+    assert overlapped == expected
+
+
 def test_short_host_rejected(tmp_path, monkeypatch):
     """A TPU host advertising fewer chips than the deployment needs per
     host is skipped with a warning (reference: launch.py:226-231); a

@@ -145,7 +145,13 @@ def test_abort():
     assert not sched.has_unfinished_requests()
     out = sched.schedule()
     assert out.is_empty
-    assert "a" in out.finished_req_ids
+    # Empty outputs are never dispatched, so the finish notice is HELD —
+    # it must ride the next step that actually reaches the workers.
+    assert out.finished_req_ids == []
+    sched.add_request(make_req("b", prompt_len=4, max_tokens=1))
+    out2 = sched.schedule()
+    assert not out2.is_empty
+    assert "a" in out2.finished_req_ids
 
 
 def test_finished_ids_propagate_next_step():
@@ -154,5 +160,30 @@ def test_finished_ids_propagate_next_step():
     sched.add_request(req)
     run_step(sched)  # prefill + sample -> finished (max_tokens=1)
     assert req.status.is_finished
+    # The next dispatched step carries the notice alongside its work.
+    sched.add_request(make_req("b", prompt_len=4, max_tokens=2))
     out = sched.schedule()
+    assert not out.is_empty
     assert "a" in out.finished_req_ids
+
+
+def test_notices_held_across_empty_steps():
+    """Finish notices survive any number of empty schedule() calls and
+    arrive exactly once on the next dispatched (non-empty) step."""
+    sched = make_scheduler()
+    req = make_req("a", prompt_len=4, max_tokens=1)
+    sched.add_request(req)
+    run_step(sched)  # finishes (max_tokens=1)
+    assert req.status.is_finished
+    for _ in range(3):
+        out = sched.schedule()
+        assert out.is_empty
+        assert out.finished_req_ids == []
+    sched.add_request(make_req("b", prompt_len=4, max_tokens=2))
+    out = sched.schedule()
+    assert not out.is_empty
+    assert out.finished_req_ids == ["a"]
+    # Delivered once, not re-sent.
+    sched.update_from_output(out, {"b": [7]})
+    out2 = sched.schedule()
+    assert "a" not in out2.finished_req_ids

@@ -295,6 +295,51 @@ def test_trace_summary_cli(tmp_path, capsys):
     assert "3 trace(s)" in out
 
 
+def test_trace_summary_overlap_view(tmp_path, capsys):
+    """The overlap view pairs gather N with dispatch N+1 per host and
+    counts positive gaps as stall windows."""
+    from tools.trace_summary import main, overlap_summary
+
+    def span(name, step_id, start, duration, host="host0"):
+        return {
+            "name": name,
+            "start": start,
+            "duration": duration,
+            "attributes": {"step_id": step_id, "target_host": host},
+        }
+
+    # Step 0: gather ends at t=1.0.  Step 1 dispatched at t=0.9 →
+    # overlapped (negative gap).  Step 1's gather ends at 2.0; step 2
+    # dispatched at 2.25 → one 250ms stall window.
+    spans = [
+        span("executor.dispatch", 0, 0.0, 0.01),
+        span("executor.gather", 0, 0.5, 0.5),
+        span("executor.dispatch", 1, 0.9, 0.01),
+        span("executor.gather", 1, 1.5, 0.5),
+        span("executor.dispatch", 2, 2.25, 0.01),
+        span("executor.gather", 2, 2.5, 0.5),
+    ]
+    traces = [{"trace_id": "t0", "spans": spans}]
+    overlap = overlap_summary(traces)
+    assert overlap is not None
+    assert overlap["steps"] == 2
+    assert overlap["stall_windows"] == 1
+    assert abs(overlap["gap_max"] - 0.25) < 1e-9
+    assert overlap["gap_p50"] < 0.25  # the overlapped pair is negative
+    # Spans without step ids (pre-overlap dumps) → no overlap section.
+    legacy = [{"trace_id": "t1", "spans": [
+        {"name": "executor.dispatch", "start": 0.0, "duration": 0.01,
+         "attributes": {"target_host": "host0"}},
+    ]}]
+    assert overlap_summary(legacy) is None
+    dump = tmp_path / "traces.json"
+    dump.write_text(json.dumps({"traces": traces}))
+    assert main([str(dump)]) == 0
+    out = capsys.readouterr().out
+    assert "stall_windows  : 1" in out
+    assert "dispatch overlap" in out
+
+
 # ---------------------------------------------------------------------
 # engine no-op path + /debug/traces while disabled
 # ---------------------------------------------------------------------

@@ -1,0 +1,301 @@
+"""Dispatch-overlap microbench (ISSUE 7 gate).
+
+Boots the SAME loopback 2-host mock deployment twice and drives a real
+``Scheduler`` through an identical greedy workload over each dispatch
+protocol:
+
+- **blocking** (``VDT_STEP_STREAMS=0``, ``non_block=False``): one
+  collective ``execute_model`` request/reply pair per step — the engine
+  thread is occupied for serialize + RPC + device + gather every step
+  (the "dispatch tax" BENCH r02-r05 measured at 110-210 ms p50), and
+  the device idles one full driver round trip per step by construction.
+- **overlapped** (``VDT_STEP_STREAMS=1``, ``non_block=True``): each
+  step is delta-compressed, pushed as one one-way frame per host, and
+  the driver schedules step N+1 while N executes (two in flight, the
+  engine's ``max_concurrent_dispatches`` discipline).
+
+Asserted (exit 1 on violation, ``--no-assert`` to just report):
+
+1. greedy outputs are bit-identical across the two protocols
+   (``VDT_MOCK_TOKEN_SEQ`` position tokens make any divergence loud);
+2. per-step dispatch time (engine-thread occupancy of the
+   ``execute_model`` call, what ``vllm:step_dispatch_time_seconds``
+   observes) drops >= 5x at p50;
+3. overlap: the overlapped run's steady-state wall is under the sum of
+   the blocking path's per-step dispatch times (driver work hides
+   entirely under device time);
+4. measured steady-state ``stall_windows`` == 0: after the pipeline
+   fills, the device-side run loops never wait for a frame with
+   nothing in flight.
+
+Run from the repo root:
+
+    JAX_PLATFORMS=cpu python tools/dispatch_microbench.py
+
+A small-workload smoke runs in tier-1
+(tests/test_multihost.py::test_dispatch_microbench).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _agent_main(port: int, env: dict[str, str]) -> None:
+    for k, v in env.items():
+        os.environ[k] = v
+    from vllm_distributed_tpu.distributed.agent import remote_main
+
+    remote_main("127.0.0.1", port)
+
+
+def _spawn_agent(port: int, env: dict[str, str]):
+    proc = multiprocessing.Process(
+        target=_agent_main, args=(port, env), daemon=True
+    )
+    proc.start()
+    return proc
+
+
+def _make_scheduler(batch: int, prompt_len: int, max_tokens: int):
+    from vllm_distributed_tpu.config import CacheConfig, SchedulerConfig
+    from vllm_distributed_tpu.engine.request import Request
+    from vllm_distributed_tpu.engine.scheduler import Scheduler
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+
+    sched = Scheduler(
+        SchedulerConfig(
+            max_num_seqs=batch,
+            max_num_batched_tokens=4096,
+            enable_chunked_prefill=True,
+            max_model_len=max(4 * (prompt_len + max_tokens), 64),
+            # One token per decode dispatch: the microbench measures
+            # PER-DISPATCH driver overhead, so fused windows would just
+            # shrink the sample count (the engine-level fused path is
+            # covered by test_pipelined_vs_blocking_engine_outputs_*).
+            num_decode_steps=1,
+        ),
+        CacheConfig(page_size=4),
+        num_pages=512,
+    )
+    for i in range(batch):
+        sched.add_request(
+            Request(
+                request_id=f"r{i}",
+                prompt_token_ids=[(7 * i + j) % 900 + 1
+                                  for j in range(prompt_len)],
+                sampling_params=SamplingParams(
+                    temperature=0.0, max_tokens=max_tokens, ignore_eos=True
+                ),
+                eos_token_id=None,
+            )
+        )
+    return sched
+
+
+# Simulated device time per step.  SHORT on purpose: the production
+# regime this microbench reproduces is decode microsteps of 5-13 ms
+# against a driver path that was costing 110-210 ms per step (BENCH
+# r02-r05) — device time must NOT dwarf driver overhead or the blocking
+# path's tax disappears into the sleeps.
+DEVICE_SECONDS = 0.01
+
+
+def run_phase(
+    overlapped: bool,
+    *,
+    batch: int = 4,
+    prompt_len: int = 8,
+    max_tokens: int = 24,
+    depth: int = 2,
+) -> dict:
+    """One full boot + workload over one protocol.  Returns per-step
+    dispatch times (ms), steady-state wall (s), per-request tokens, and
+    the stream runners' steady-state stall counts."""
+    from vllm_distributed_tpu.config import EngineArgs
+    from vllm_distributed_tpu.executor.multihost import MultiHostExecutor
+    from vllm_distributed_tpu.testing import write_llama_config
+    from vllm_distributed_tpu.utils import get_open_port
+
+    class MicrobenchExecutor(MultiHostExecutor):
+        worker_cls = "tests.mock_worker.MockWorker"
+
+    port = get_open_port()
+    env = {
+        "VDT_SERVER_PORT": str(port),
+        "VDT_STEP_STREAMS": "1" if overlapped else "0",
+        "VDT_EXECUTE_MODEL_TIMEOUT_SECONDS": "60",
+        "VDT_MOCK_TOKEN_SEQ": "1",
+        # Same simulated device time on BOTH protocols: the blocking
+        # verb and the two-phase fetch both sleep DEVICE_SECONDS.
+        "VDT_MOCK_EXECUTE_SLEEP_SECONDS": str(DEVICE_SECONDS),
+        "VDT_MOCK_STEP_SECONDS": str(DEVICE_SECONDS),
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    agent_env = {
+        **env,
+        "VDT_ADVERTISE_NUM_CHIPS": "4",
+        "VDT_ADVERTISE_PLATFORM": "cpu",
+    }
+    tmp = tempfile.mkdtemp(prefix="vdt_dispatch_mb_")
+    agent = _spawn_agent(port, agent_env)
+    executor = None
+    try:
+        config = EngineArgs(
+            model=write_llama_config(os.path.join(tmp, "m")),
+            skip_tokenizer_init=True,
+            load_format="dummy",
+            num_hosts=2,
+        ).create_engine_config()
+        executor = MicrobenchExecutor(config)
+        sched = _make_scheduler(batch, prompt_len, max_tokens)
+        tokens: dict[str, list[int]] = {}
+
+        def settle(so, result):
+            for req in sched.update_from_output(
+                so, result.sampled_token_ids
+            ):
+                tokens[req.request_id] = list(req.output_token_ids)
+
+        # Prime: prefill runs blocking on both protocols (the pipeline
+        # only overlaps decode continuations, exactly like the engine).
+        prefill = sched.schedule()
+        settle(prefill, executor.execute_model(prefill))
+
+        dispatch_ms: list[float] = []
+        pending: list[tuple] = []
+        stall_base: dict | None = None
+        t_wall = time.perf_counter()
+        while sched.has_unfinished_requests() or pending:
+            so = sched.schedule()
+            if not so.is_empty:
+                t0 = time.perf_counter()
+                if overlapped:
+                    fut = executor.execute_model(so, non_block=True)
+                    dispatch_ms.append((time.perf_counter() - t0) * 1e3)
+                    pending.append((so, fut))
+                else:
+                    out = executor.execute_model(so)
+                    dispatch_ms.append((time.perf_counter() - t0) * 1e3)
+                    settle(so, out)
+            elif not pending:
+                break  # nothing in flight and nothing to schedule
+            while pending and (
+                len(pending) > depth - 1 or so.is_empty
+            ):
+                so0, fut0 = pending.pop(0)
+                settle(so0, fut0.result(timeout=60))
+                if so.is_empty:
+                    break  # drain ONE per idle pass, like the engine
+            if (
+                overlapped
+                and stall_base is None
+                and len(dispatch_ms) >= depth
+            ):
+                # Pipeline is full: steady state starts here.  The
+                # prefill->decode boundary may legitimately record one
+                # stall window; everything after this snapshot may not.
+                stall_base = executor.step_stream_stats()
+        wall_s = time.perf_counter() - t_wall
+
+        stalls_steady = None
+        if overlapped:
+            stall_end = executor.step_stream_stats()
+            base = stall_base or {}
+            stalls_steady = sum(
+                host_stats["stalls"]
+                - base.get(host, {}).get("stalls", 0)
+                for host, host_stats in stall_end.items()
+            )
+        return {
+            "protocol": "overlapped" if overlapped else "blocking",
+            "steps": len(dispatch_ms),
+            "dispatch_ms": [round(ms, 3) for ms in dispatch_ms],
+            "dispatch_ms_p50": round(statistics.median(dispatch_ms), 3),
+            "wall_s": round(wall_s, 3),
+            "stall_windows_steady": stalls_steady,
+            "tokens": tokens,
+        }
+    finally:
+        if executor is not None:
+            executor.shutdown()
+        if agent.is_alive():
+            agent.terminate()
+        agent.join(timeout=5)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run_microbench(
+    *, batch: int = 4, prompt_len: int = 8, max_tokens: int = 24
+) -> dict:
+    blocking = run_phase(
+        False, batch=batch, prompt_len=prompt_len, max_tokens=max_tokens
+    )
+    overlapped = run_phase(
+        True, batch=batch, prompt_len=prompt_len, max_tokens=max_tokens
+    )
+    ratio = blocking["dispatch_ms_p50"] / max(
+        overlapped["dispatch_ms_p50"], 1e-9
+    )
+    blocking_dispatch_sum_s = sum(blocking["dispatch_ms"]) / 1e3
+    report = {
+        "blocking": {k: v for k, v in blocking.items() if k != "tokens"},
+        "overlapped": {
+            k: v for k, v in overlapped.items() if k != "tokens"
+        },
+        "dispatch_p50_speedup": round(ratio, 1),
+        "checks": {
+            "outputs_bit_identical": blocking["tokens"]
+            == overlapped["tokens"],
+            "dispatch_p50_5x": ratio >= 5.0,
+            "overlap_wall_lt_blocking_dispatch_sum": overlapped["wall_s"]
+            < blocking_dispatch_sum_s,
+            "stall_windows_zero": overlapped["stall_windows_steady"] == 0,
+        },
+    }
+    report["ok"] = all(report["checks"].values())
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="blocking vs overlapped dispatch protocol microbench"
+    )
+    parser.add_argument("--batch", type=int, default=4)
+    parser.add_argument("--prompt-len", type=int, default=8)
+    parser.add_argument("--max-tokens", type=int, default=24)
+    parser.add_argument(
+        "--no-assert",
+        action="store_true",
+        help="report only; exit 0 even when a check fails",
+    )
+    args = parser.parse_args(argv)
+    report = run_microbench(
+        batch=args.batch,
+        prompt_len=args.prompt_len,
+        max_tokens=args.max_tokens,
+    )
+    print(json.dumps(report, indent=2))
+    if not report["ok"] and not args.no_assert:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,6 +82,92 @@ def summarize(traces: list[dict]) -> dict[str, dict[str, float]]:
     return stats
 
 
+def overlap_summary(traces: list[dict]) -> dict | None:
+    """Dispatch-pipeline overlap view (ISSUE 7): for each pair of
+    consecutively dispatched steps on the same host, the idle gap
+    between the end of ``executor.gather`` N and the start of
+    ``executor.dispatch`` N+1.  A positive gap is a **stall window** —
+    the driver sat waiting for results before it had the next step on
+    the wire; the overlapped scheduler exists to make every gap
+    negative (dispatch N+1 in flight before gather N lands).
+
+    Returns ``{"steps", "stall_windows", "gap_p50", "gap_p90",
+    "gap_max"}`` (seconds; gaps can be negative), or None when the dump
+    has no step-stamped dispatch/gather spans (tracing predates the
+    overlap protocol, or no steps ran).
+    """
+    # host -> step_id -> {"dispatch": start, "gather_end": end}
+    by_host: dict[str, dict[int, dict[str, float]]] = {}
+    for trace in traces:
+        for span in trace.get("spans", []):
+            name = span.get("name")
+            if name not in ("executor.dispatch", "executor.gather"):
+                continue
+            attrs = span.get("attributes") or {}
+            step_id = attrs.get("step_id")
+            host = attrs.get("target_host")
+            if step_id is None or host is None:
+                continue
+            steps = by_host.setdefault(host, {})
+            entry = steps.setdefault(int(step_id), {})
+            entry["trace_id"] = trace.get("trace_id")
+            start = float(span.get("start") or 0.0)
+            duration = float(span.get("duration") or 0.0)
+            if name == "executor.dispatch":
+                # First dispatch span wins (a step is dispatched once
+                # per host; retries would only widen the gap).
+                entry.setdefault("dispatch", start)
+            else:
+                entry["gather_end"] = max(
+                    entry.get("gather_end", 0.0), start + duration
+                )
+    gaps: list[float] = []
+    stall_windows = 0
+    pairs = 0
+    for steps in by_host.values():
+        ordered = sorted(steps)
+        for prev, nxt in zip(ordered, ordered[1:]):
+            if steps[prev].get("trace_id") != steps[nxt].get("trace_id"):
+                # Steps from different traces: idle time between
+                # unrelated requests (or a ring-evicted trace), not a
+                # pipeline gap.  Within one trace, non-adjacent ids are
+                # still a real pair — empty schedules consume a step id
+                # without dispatching, exactly the stall-prone window.
+                continue
+            gather_end = steps[prev].get("gather_end")
+            dispatch = steps[nxt].get("dispatch")
+            if gather_end is None or dispatch is None:
+                continue
+            pairs += 1
+            gap = dispatch - gather_end
+            gaps.append(gap)
+            if gap > 0:
+                stall_windows += 1
+    if not pairs:
+        return None
+    gaps.sort()
+    return {
+        "steps": pairs,
+        "stall_windows": stall_windows,
+        "gap_p50": percentile(gaps, 0.50),
+        "gap_p90": percentile(gaps, 0.90),
+        "gap_max": gaps[-1],
+    }
+
+
+def format_overlap(overlap: dict) -> str:
+    lines = [
+        "dispatch overlap (gap = dispatch N+1 start - gather N end; "
+        "negative = overlapped)",
+        f"  step pairs     : {overlap['steps']}",
+        f"  stall_windows  : {overlap['stall_windows']}",
+        f"  gap p50 (ms)   : {overlap['gap_p50'] * 1e3:+.2f}",
+        f"  gap p90 (ms)   : {overlap['gap_p90'] * 1e3:+.2f}",
+        f"  gap max (ms)   : {overlap['gap_max'] * 1e3:+.2f}",
+    ]
+    return "\n".join(lines)
+
+
 def format_table(stats: dict[str, dict[str, float]]) -> str:
     names = [n for n in _STAGE_ORDER if n in stats]
     names += sorted(set(stats) - set(_STAGE_ORDER))
@@ -116,6 +202,10 @@ def main(argv: list[str] | None = None) -> int:
     stats = summarize(traces)
     print(f"{len(traces)} trace(s)")
     print(format_table(stats))
+    overlap = overlap_summary(traces)
+    if overlap is not None:
+        print()
+        print(format_overlap(overlap))
     return 0
 
 

@@ -17,7 +17,13 @@ What counts as an unbounded leaf wait:
   ``communicate``, ``open_connection``, ``connect``,
   ``get_param``/``getParam``);
 - sync ``<expr>.result()`` with neither a positional timeout nor
-  ``timeout=`` (concurrent futures block forever).
+  ``timeout=`` (concurrent futures block forever);
+- sync no-arg ``<expr>.get()`` / ``<expr>.wait()`` with no ``timeout=``
+  — the step-queue wait pattern (ISSUE 7): the persistent run loops in
+  ``worker/step_stream.py`` park loop threads on ``queue.Queue.get`` /
+  ``threading.Event.wait``, and an unbounded one survives ``stop()``
+  forever (a no-arg ``.get()`` cannot be a ``dict.get``, which needs a
+  key, so this stays precise).
 
 Awaiting an ordinary coroutine *call* is composition, not a leaf wait —
 deadline ownership belongs inside the callee or at the orchestration
@@ -64,6 +70,10 @@ class _Visitor(ast.NodeVisitor):
         # inside an asyncio.wait_for(...) argument in the parent scope.
         self._protected_defs: set[int] = set()
         self._protection_depth = 0
+        # Call nodes owned by an enclosing await or wait_for(...): the
+        # await path (visit_Await) is the authority there, so the sync
+        # .get()/.wait() branch must not re-flag them.
+        self._async_owned: set[int] = set()
 
     # ---- wait_for-wrapped nested defs ----
     def _mark_protected(self, func: ast.AST) -> None:
@@ -113,6 +123,8 @@ class _Visitor(ast.NodeVisitor):
 
     # ---- awaits ----
     def visit_Await(self, node: ast.Await) -> None:
+        if isinstance(node.value, ast.Call):
+            self._async_owned.add(id(node.value))
         self.generic_visit(node)
         if self._protection_depth > 0:
             return
@@ -144,21 +156,45 @@ class _Visitor(ast.NodeVisitor):
                     )
                 )
 
-    # ---- sync Future.result() ----
+    # ---- sync leaf waits: Future.result(), queue get, event wait ----
     def visit_Call(self, node: ast.Call) -> None:
+        if callee_last(node) == "wait_for":
+            # Primitives handed to wait_for ARE deadline-bounded —
+            # mark them before descending so the leaf branch below
+            # skips them (`await asyncio.wait_for(ev.wait(), 5)`).
+            for arg in node.args:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Call):
+                        self._async_owned.add(id(sub))
         self.generic_visit(node)
         if (
-            isinstance(node.func, ast.Attribute)
-            and node.func.attr == "result"
-            and not node.args
-            and not has_kwarg(node, "timeout")
+            not isinstance(node.func, ast.Attribute)
+            or node.args
+            or has_kwarg(node, "timeout")
         ):
+            return
+        attr = node.func.attr
+        if attr == "result":
             self.findings.append(
                 self.ctx.finding(
                     self.checker,
                     node,
                     ".result() without a timeout blocks forever if the "
                     "producer dies — pass timeout=",
+                )
+            )
+        elif attr in ("get", "wait") and id(node) not in self._async_owned:
+            # The step-queue wait pattern: loop threads must poll with
+            # timeout= and re-check their stop flag (a no-arg .get()
+            # can only be a queue, never dict.get(key)).  Awaited or
+            # wait_for-wrapped calls belong to the await path above.
+            self.findings.append(
+                self.ctx.finding(
+                    self.checker,
+                    node,
+                    f".{attr}() without a timeout parks the thread "
+                    "forever — poll with timeout= and re-check the "
+                    "stop flag",
                 )
             )
 
@@ -172,7 +208,7 @@ class UnboundedWaitChecker(Checker):
         "an unbounded wait turns a silent host into a wedged driver; "
         "every control-plane wait needs a deadline"
     )
-    scope = ("distributed/", "executor/", "engine/supervisor.py")
+    scope = ("distributed/", "executor/", "worker/", "engine/supervisor.py")
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
         visitor = _Visitor(self, ctx)

@@ -53,12 +53,16 @@ class WorkerHost:
     """The object proxied back to the driver: one worker on this host,
     every lifecycle verb reachable via ``run`` (the executor's
     collective_rpc contract; cf. WorkerWrapper.run_worker,
-    launch.py:523-541)."""
+    launch.py:523-541), plus the persistent step-stream verbs
+    (``start_step_stream``/``stream_step``): per-step work arrives as
+    one-way frames pulled by a long-lived run loop instead of
+    request/reply pairs."""
 
     __rpc_proxy__ = True
 
     def __init__(self, worker: Any) -> None:
         self.worker = worker
+        self.runner = None  # StepStreamRunner, once the driver starts it
         # Device work blocks; keep RPC handling responsive and calls
         # ordered with a single-thread pool.  fetch_results gets its OWN
         # ordered pool: it blocks until a dispatched step's results are
@@ -77,6 +81,89 @@ class WorkerHost:
         return await loop.run_in_executor(
             pool, run_method, self.worker, method, args, kwargs or {}
         )
+
+    # ---- persistent step stream (ISSUE 7) ----
+    def start_step_stream(self, deliver: Any, depth: int) -> bool:
+        """Spin up this host's run loop.  ``deliver`` is a driver-side
+        callable proxied over the connection; each finished step sends
+        ONE one-way ack frame back through it — result bytes are
+        pre-pickled off the event loop (inside the worker.serialize
+        span) so the transport ships them sideband without re-walking
+        the payload."""
+        import cloudpickle
+
+        from vllm_distributed_tpu.distributed.rpc import apply_oneway
+        from vllm_distributed_tpu.tracing import get_tracer
+        from vllm_distributed_tpu.worker.step_stream import StepStreamRunner
+
+        loop = asyncio.get_running_loop()
+
+        def _send_ack(step_id: int, result, error, spans, span_ctx) -> None:
+            tracer = get_tracer()
+            if span_ctx is not None and tracer.enabled:
+                ctx = tuple(span_ctx)
+                sp = None
+                try:
+                    with tracer.span(
+                        "worker.serialize", parent=ctx, record=False
+                    ) as sp:
+                        payload = cloudpickle.dumps(result)
+                finally:
+                    if sp is not None:
+                        spans.append(sp.to_wire())
+                spans.append(tracer.stamp("worker.reply", ctx))
+            else:
+                payload = cloudpickle.dumps(result)
+            fut = asyncio.run_coroutine_threadsafe(
+                apply_oneway(
+                    deliver, None, step_id, payload, error, spans
+                ),
+                loop,
+            )
+            fut.add_done_callback(_log_ack_error)
+
+        self.runner = StepStreamRunner(
+            self.worker, _send_ack, depth=depth, name="agent"
+        )
+        return True
+
+    def stream_step(self, frame_bytes: bytes, span_ctx: Any = None) -> None:
+        """One-way per-step push from the driver.  Unpickling the
+        O(batch) delta frame here is microseconds; the mirror decode
+        (full SchedulerOutput reconstruction) runs on the runner's
+        dispatch thread, never on the event loop."""
+        import cloudpickle
+
+        runner = self.runner
+        if runner is None:
+            # Raced a teardown (stop_step_stream already ran) or an
+            # out-of-order start: drop the frame — the driver's
+            # per-step deadline attributes the missing ack, and an
+            # AttributeError here would die unobserved on the one-way
+            # path anyway.
+            logger.warning("step frame arrived with no active stream")
+            return
+        frame = cloudpickle.loads(frame_bytes)
+        runner.submit(
+            frame, tuple(span_ctx) if span_ctx is not None else None
+        )
+
+    def stop_step_stream(self) -> dict:
+        runner, self.runner = self.runner, None
+        if runner is None:
+            return {}
+        stats = runner.stats()
+        runner.stop()
+        return stats
+
+    def get_step_stream_stats(self) -> dict:
+        return self.runner.stats() if self.runner is not None else {}
+
+
+def _log_ack_error(fut) -> None:
+    e = fut.exception()
+    if e is not None:
+        logger.debug("step ack send failed: %s", e)
 
 
 def _resolve_worker_cls(worker_cls: str | None):

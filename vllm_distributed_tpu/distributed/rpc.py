@@ -433,6 +433,17 @@ def apply_with_timeout(proxy: RpcProxy, timeout: float, *args, **kwargs):
     )
 
 
+def apply_oneway(proxy: RpcProxy, method: str | None, *args, **kwargs):
+    """Fire-and-forget apply: one frame on the wire, NO reply slot, no
+    pending-map entry, nothing to time out.  The step-stream protocol's
+    per-step sends (driver→host step frames, host→driver result acks)
+    ride this — result delivery and failure detection are owned by the
+    stream coordinator, not by per-call futures."""
+    return proxy._peer._apply(
+        proxy._proxy_id, method, args, kwargs, oneway=True
+    )
+
+
 def _send_finalize(peer_ref, proxy_id: str) -> None:
     """weakref.finalize callback: tell the remote side its object is no
     longer referenced here (distributed GC, reference rpc.py finalize).

@@ -102,6 +102,12 @@ class LLMEngine:
         # engine-side realization of the reference's in-flight batches
         # (max_concurrent_batches, launch.py:298-302).
         self._pending: list[tuple[Any, Any]] = []
+        # Async-scheduling reconciliation count: times the pipeline had
+        # to fully drain because the predicted post-step state was
+        # invalidated (stop/EOS/budget mid-window, admissions, logprob
+        # requests).  0 at steady-state decode; surfaced by bench-serve
+        # as its stall_windows field.
+        self.pipeline_breaks = 0
 
     @classmethod
     def from_engine_args(cls, engine_args: EngineArgs) -> "LLMEngine":
@@ -263,6 +269,12 @@ class LLMEngine:
         outputs: list[RequestOutput] = []
         outputs.extend(self._finalize_done())
         if self._pending and not self._pipeline_safe():
+            # Reconciliation: the predicted continuation no longer holds
+            # (a request finished mid-window, an admission arrived, …) —
+            # drain so the next schedule sees settled state.  Deferred
+            # page frees settle in the same drain.
+            self.pipeline_breaks += 1
+            self.metrics.record_pipeline_break()
             outputs.extend(self._drain_pending())
         scheduler_output = self._schedule()
         if scheduler_output.is_empty:

@@ -112,6 +112,19 @@ class Scheduler:
         self._step_id = 0
         # Finished/preempted since last step, to notify workers.
         self._finished_since_last: list[str] = []
+        # Notices that rode an EMPTY SchedulerOutput: the engine never
+        # dispatches empty steps, so without holding them here the
+        # workers would silently keep mirrored state for finished/
+        # preempted requests forever (and the step-delta encoder would
+        # desynchronize from the worker mirrors).
+        self._held_notices: tuple[list[str], list[str]] | None = None
+        # Requests that finished/aborted while LATER steps containing
+        # them were still in flight on the device: their KV pages are
+        # freed only once every in-flight step has drained, so the
+        # device can never be writing into pages the allocator has
+        # already handed to another request (async-scheduling
+        # reconciliation, ISSUE 7).
+        self._deferred_frees: dict[str, Request] = {}
         # Cumulative preemption count (metrics, SURVEY.md §5.5).
         self.num_preemptions = 0
         # Cumulative prefix-cache token counters (metrics): tokens
@@ -163,8 +176,18 @@ class Scheduler:
             self._finished_since_last.append(req_id)
         elif req in self.waiting:
             self.waiting.remove(req)
-        self.allocator.free(req)
+        self._release_or_defer(req)
         del self.requests[req_id]
+
+    def _release_or_defer(self, req: Request) -> None:
+        """Free a finished request's pages — unless a later in-flight
+        step still references them (pipelined scheduling ran ahead of
+        this finish), in which case the free waits for those steps to
+        drain (``update_from_output`` settles the debt)."""
+        if req.num_inflight_tokens > 0:
+            self._deferred_frees[req.request_id] = req
+        else:
+            self.allocator.free(req)
 
     @property
     def num_unfinished(self) -> int:
@@ -349,6 +372,21 @@ class Scheduler:
             )
 
         out.preempted_req_ids = sorted(preempted)
+        if self._held_notices is not None:
+            held_fin, held_pre = self._held_notices
+            self._held_notices = None
+            out.finished_req_ids = held_fin + out.finished_req_ids
+            out.preempted_req_ids = held_pre + [
+                p for p in out.preempted_req_ids if p not in held_pre
+            ]
+        if out.is_empty and (out.finished_req_ids or out.preempted_req_ids):
+            # Empty outputs are never dispatched — hold the notices for
+            # the next step that actually reaches the workers.
+            self._held_notices = (
+                out.finished_req_ids, out.preempted_req_ids
+            )
+            out.finished_req_ids = []
+            out.preempted_req_ids = []
         return out
 
     def _allocate_or_preempt(
@@ -420,6 +458,18 @@ class Scheduler:
         Returns requests that finished this step."""
         finished: list[Request] = []
         for req_id, num in scheduler_output.num_scheduled_tokens.items():
+            deferred = self._deferred_frees.get(req_id)
+            if deferred is not None:
+                # A step scheduled before this request finished is
+                # draining: settle its in-flight debt, free the pages
+                # once the last such step lands.
+                deferred.num_inflight_tokens = max(
+                    deferred.num_inflight_tokens - num, 0
+                )
+                if deferred.num_inflight_tokens == 0:
+                    del self._deferred_frees[req_id]
+                    self.allocator.free(deferred)
+                continue
             req = self.requests.get(req_id)
             if req is None or req.status != RequestStatus.RUNNING:
                 continue  # aborted mid-step
@@ -439,7 +489,7 @@ class Scheduler:
                 self.allocator.register_computed(req)
             if req.status.is_finished:
                 self.running.remove(req)
-                self.allocator.free(req)
+                self._release_or_defer(req)
                 self._finished_since_last.append(req_id)
                 finished.append(req)
                 del self.requests[req_id]
@@ -452,5 +502,5 @@ class Scheduler:
             self._finished_since_last.append(req.request_id)
         if req in self.waiting:
             self.waiting.remove(req)
-        self.allocator.free(req)
+        self._release_or_defer(req)
         self.requests.pop(req.request_id, None)

@@ -1,0 +1,237 @@
+"""Delta-compressed step frames (ISSUE 7 tentpole piece 2).
+
+``SchedulerOutput`` is already delta-shaped at the object level (full
+data only for newly-admitted requests, per-step deltas for cached ones),
+but its WIRE form still repeats every request id string in four places
+per step (``cached_requests``, ``num_scheduled_tokens`` keys,
+finished/preempted lists) and re-ships ``num_computed_tokens`` that the
+worker can derive itself.  At batch 64 that is the dominant per-step
+payload — O(batch) strings plus dataclass framing — serialized once per
+host per step on the driver's hot path.
+
+This module compresses a step to a ``StepFrame``:
+
+- every request gets a small integer index at admission
+  (``NewRequestData`` rides the frame verbatim — prompt ids, block
+  table, sampling params are sent ONCE, the SGLang/vLLM worker-mirror
+  economy);
+- per-step entries for cached requests carry only ``(index,
+  new_token_count, block_table_appends)``;
+- finished/resumed/preempted notices are index lists;
+- ``num_computed_tokens``, ``num_scheduled_tokens`` and the step total
+  are DERIVED, not shipped: the worker-side ``StepStateMirror`` advances
+  its per-request token counter by each step's new-token count, exactly
+  mirroring the scheduler's ``num_computed + num_inflight`` arithmetic.
+
+``StepDeltaEncoder.encode`` (driver) and ``StepStateMirror.decode``
+(worker) are exact inverses: the reconstructed ``SchedulerOutput``
+compares equal to the original, field for field, including dict
+ordering — asserted by the round-trip property tests in
+tests/test_step_delta.py.  The encoder also self-checks its computed
+prediction against the scheduler's value each step and falls back to an
+explicit override (``computed_overrides``) on mismatch, so a prediction
+bug degrades to a larger frame, never to silent state divergence.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from vllm_distributed_tpu.engine.scheduler import (
+    CachedRequestData,
+    NewRequestData,
+    SchedulerOutput,
+)
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class StepFrame:
+    """One step's delta-compressed wire form (pickled ONCE per step and
+    shared byte-identically across every host send)."""
+
+    step_id: int
+    decode_steps: int = 1
+    # True = the driver blocks on this step's result (prefill/mixed
+    # steps); the worker runs it inline instead of two-phase.
+    blocking: bool = False
+    # Admissions (and preemption-resumes): full request state, once.
+    new: list[NewRequestData] = field(default_factory=list)
+    # (index, num_new_tokens, new_page_ids) per already-mirrored request.
+    cached: list[tuple[int, int, list[int]]] = field(default_factory=list)
+    finished: list[int] = field(default_factory=list)
+    preempted: list[int] = field(default_factory=list)
+    # index -> absolute num_computed_tokens; normally empty (see module
+    # docstring), populated only if the encoder's prediction disagrees
+    # with the scheduler.
+    computed_overrides: dict[int, int] = field(default_factory=dict)
+    trace_ctx: tuple | None = None
+    # Escape hatch: a SchedulerOutput the codec cannot synthesize from
+    # mirror state (num_scheduled_tokens entries with no matching
+    # new/cached record — hand-built test payloads, not scheduler
+    # output) ships verbatim and bypasses the mirror entirely.
+    raw: SchedulerOutput | None = None
+
+
+class _Entry:
+    __slots__ = ("req_id", "computed")
+
+    def __init__(self, req_id: str, computed: int) -> None:
+        self.req_id = req_id
+        self.computed = computed
+
+
+class StepDeltaEncoder:
+    """Driver-side: assigns request indices and emits ``StepFrame``s.
+    Stateful — every dispatched step MUST flow through one encoder
+    instance, in order, or the worker mirrors desynchronize (the
+    executor enforces this by routing all step traffic through the
+    stream path whenever it is enabled)."""
+
+    def __init__(self) -> None:
+        self._by_id: dict[str, _Entry] = {}
+        self._index: dict[str, int] = {}
+        self._next_index = 0
+
+    def _assign(self, req_id: str) -> int:
+        idx = self._next_index
+        self._next_index += 1
+        self._index[req_id] = idx
+        return idx
+
+    def encode(
+        self, so: SchedulerOutput, *, blocking: bool = False
+    ) -> StepFrame:
+        covered = {c.req_id for c in so.cached_requests} | {
+            n.req_id for n in so.new_requests
+        }
+        if covered != set(so.num_scheduled_tokens):
+            # Not a scheduler-produced step (every scheduled request is
+            # paired with a new/cached record there) — ship it raw.
+            logger.debug(
+                "step %d not delta-encodable; shipping raw", so.step_id
+            )
+            return StepFrame(
+                step_id=so.step_id,
+                decode_steps=so.decode_steps,
+                blocking=blocking,
+                trace_ctx=so.trace_ctx,
+                raw=so,
+            )
+        frame = StepFrame(
+            step_id=so.step_id,
+            decode_steps=so.decode_steps,
+            blocking=blocking,
+            trace_ctx=so.trace_ctx,
+        )
+        # Order mirrors the worker's apply order (model_runner
+        # _apply_scheduler_deltas): finished/preempted drop state before
+        # admissions may reuse a request id.
+        for rid in so.finished_req_ids:
+            idx = self._index.pop(rid, None)
+            if idx is None:
+                raise ValueError(f"finish notice for unknown request {rid}")
+            self._by_id.pop(rid, None)
+            frame.finished.append(idx)
+        for rid in so.preempted_req_ids:
+            idx = self._index.pop(rid, None)
+            if idx is None:
+                raise ValueError(f"preempt notice for unknown request {rid}")
+            self._by_id.pop(rid, None)
+            frame.preempted.append(idx)
+        for c in so.cached_requests:
+            entry = self._by_id.get(c.req_id)
+            idx = self._index.get(c.req_id)
+            if entry is None or idx is None:
+                raise ValueError(
+                    f"cached delta for unmirrored request {c.req_id}"
+                )
+            if entry.computed != c.num_computed_tokens:
+                # Prediction miss: ship the absolute value this step (a
+                # bigger frame, never a divergent mirror) and resync.
+                logger.warning(
+                    "step %d: computed-token prediction for %s is %d, "
+                    "scheduler says %d — shipping explicit override",
+                    so.step_id,
+                    c.req_id,
+                    entry.computed,
+                    c.num_computed_tokens,
+                )
+                frame.computed_overrides[idx] = c.num_computed_tokens
+                entry.computed = c.num_computed_tokens
+            frame.cached.append((idx, c.num_new_tokens, c.new_page_ids))
+            entry.computed += c.num_new_tokens
+        for nr in so.new_requests:
+            if nr.req_id in self._index:
+                raise ValueError(f"re-admission of mirrored {nr.req_id}")
+            self._assign(nr.req_id)
+            self._by_id[nr.req_id] = _Entry(
+                nr.req_id, nr.num_computed_tokens + nr.num_new_tokens
+            )
+            frame.new.append(nr)
+        return frame
+
+    @property
+    def num_mirrored(self) -> int:
+        return len(self._by_id)
+
+
+class StepStateMirror:
+    """Worker-side inverse: reconstructs the full ``SchedulerOutput``
+    from a ``StepFrame``.  One mirror per worker host; every host
+    receives every frame in step order, so all mirrors (and the
+    driver-side encoder) stay in lockstep."""
+
+    def __init__(self) -> None:
+        self._by_index: dict[int, _Entry] = {}
+        self._next_index = 0
+
+    def decode(self, frame: StepFrame) -> SchedulerOutput:
+        if frame.raw is not None:
+            return frame.raw
+        so = SchedulerOutput(
+            step_id=frame.step_id,
+            decode_steps=frame.decode_steps,
+            trace_ctx=(
+                tuple(frame.trace_ctx)
+                if frame.trace_ctx is not None
+                else None
+            ),
+        )
+        for idx in frame.finished:
+            entry = self._by_index.pop(idx)
+            so.finished_req_ids.append(entry.req_id)
+        for idx in frame.preempted:
+            entry = self._by_index.pop(idx)
+            so.preempted_req_ids.append(entry.req_id)
+        for idx, num_new, new_page_ids in frame.cached:
+            entry = self._by_index[idx]
+            override = frame.computed_overrides.get(idx)
+            if override is not None:
+                entry.computed = override
+            so.cached_requests.append(
+                CachedRequestData(
+                    req_id=entry.req_id,
+                    new_page_ids=list(new_page_ids),
+                    num_computed_tokens=entry.computed,
+                    num_new_tokens=num_new,
+                )
+            )
+            entry.computed += num_new
+            so.num_scheduled_tokens[entry.req_id] = num_new
+            so.total_num_scheduled_tokens += num_new
+        for nr in frame.new:
+            self._by_index[self._next_index] = _Entry(
+                nr.req_id, nr.num_computed_tokens + nr.num_new_tokens
+            )
+            self._next_index += 1
+            so.new_requests.append(nr)
+            so.num_scheduled_tokens[nr.req_id] = nr.num_new_tokens
+            so.total_num_scheduled_tokens += nr.num_new_tokens
+        return so
+
+    @property
+    def num_mirrored(self) -> int:
+        return len(self._by_index)

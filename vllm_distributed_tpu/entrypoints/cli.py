@@ -219,6 +219,7 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
             "vllm:time_per_output_token_seconds_sum",
             "vllm:time_per_output_token_seconds_count",
             "vllm:generation_tokens_total",
+            "vllm:pipeline_breaks_total",
         }
         out = {}
         for line in text.splitlines():
@@ -318,6 +319,17 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
             else None
         ),
     }
+    if itls:
+        # The dispatch tax as the CLIENT sees it (ISSUE 7): throughput
+        # implied by the p50 inter-token pace at this concurrency minus
+        # the wall-clock throughput.  ~0 when the driver holds the p50
+        # pace for the whole run.
+        itl_p50 = _percentiles(itls)["p50"]
+        if itl_p50 > 0:
+            result["wall_vs_p50_gap"] = round(
+                args.concurrency / itl_p50 - result["output_tokens_per_s"],
+                1,
+            )
     if after:
         # Server-side cross-check: deltas of the Prometheus histograms
         # over the run window.
@@ -340,6 +352,12 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
             ),
             "generation_tokens": delta("vllm:generation_tokens_total"),
         }
+        # Engine-side pipeline flushes over the run window: the serve
+        # analogue of the microbench's stall_windows (0 = the async
+        # scheduler never had to drain and re-plan mid-run).
+        result["stall_windows"] = int(
+            delta("vllm:pipeline_breaks_total")
+        )
     return result
 
 

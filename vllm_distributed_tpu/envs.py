@@ -73,6 +73,19 @@ environment_variables: dict[str, Callable[[], Any]] = {
     "VDT_CRASH_LOOP_WINDOW_SECONDS": lambda: float(
         os.environ.get("VDT_CRASH_LOOP_WINDOW_SECONDS", "300")
     ),
+    # Persistent per-host step streams (executor/multihost.py): per-step
+    # control messages ride one one-way frame each way through a
+    # long-lived run loop instead of dispatch/fetch request-reply pairs.
+    # "0" falls back to the legacy two-phase RPC path.
+    "VDT_STEP_STREAMS": lambda: os.environ.get(
+        "VDT_STEP_STREAMS", "1"
+    ).lower() not in ("", "0", "false", "off"),
+    # Bound on each host's step-stream inbox (queued-but-undispatched
+    # frames); the engine keeps at most max_concurrent_dispatches steps
+    # in flight, so this only guards against a runaway driver.
+    "VDT_STEP_STREAM_DEPTH": lambda: int(
+        os.environ.get("VDT_STEP_STREAM_DEPTH", "8")
+    ),
     # --- observability ---
     # Per-request tracing (tracing.py): default off; the engine step
     # loop runs the no-op tracer path and /debug/traces answers 404.

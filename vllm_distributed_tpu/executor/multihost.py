@@ -53,7 +53,12 @@ from vllm_distributed_tpu.distributed.failure import (
     PHASE_INIT,
     HostFailure,
 )
-from vllm_distributed_tpu.distributed.rpc import RpcProxy, apply_with_timeout
+from vllm_distributed_tpu.distributed.rpc import (
+    RpcProxy,
+    apply_oneway,
+    apply_with_timeout,
+)
+from vllm_distributed_tpu.engine.step_delta import StepDeltaEncoder
 from vllm_distributed_tpu.distributed.rpc_transport import (
     StreamRpcTransport,
     prepare_peer_readloop,
@@ -83,6 +88,43 @@ class RemoteHost:
     in_use: bool = False
     address: str = ""
     transport: Any = None  # closing it unblocks the read loop
+
+
+class _InflightStep:
+    """Driver-side record of one step pushed into the streams: which
+    hosts still owe an ack, the canonical (host 0) result, and the
+    event `_finish_step` waits on — deadline-bounded, so a silent host
+    turns into an attributed ``HostFailure``, never a wedged engine."""
+
+    __slots__ = (
+        "step_id",
+        "expected",
+        "done",
+        "origins",
+        "result",
+        "error",
+        "event",
+        "trace_ctx",
+        "t_mono",
+        "t_wall",
+    )
+
+    def __init__(
+        self,
+        step_id: int,
+        origins: dict[int, str],
+        trace_ctx: tuple | None,
+    ) -> None:
+        self.step_id = step_id
+        self.expected = set(origins)
+        self.done: set[int] = set()
+        self.origins = origins  # host_rank -> address (attribution)
+        self.result = None
+        self.error: HostFailure | str | None = None
+        self.event = threading.Event()
+        self.trace_ctx = trace_ctx
+        self.t_mono = time.monotonic()
+        self.t_wall = time.time()
 
 
 class MultiHostExecutor(Executor):
@@ -124,8 +166,28 @@ class MultiHostExecutor(Executor):
         # Resolver threads for in-flight steps (two dispatches in flight
         # at steady state; replaces thread-per-dispatch).
         self._gather_pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=2, thread_name_prefix="vdt-gather"
+            max_workers=max(
+                2, self.scheduler_config.max_concurrent_dispatches
+            ),
+            thread_name_prefix="vdt-gather",
         )
+        # Persistent per-host step streams (ISSUE 7): per-step control
+        # messages become one one-way frame each way instead of
+        # request/reply pairs.  Disabled for KV-transfer deployments
+        # (their steps fan out through the aggregating collective path).
+        self._stream_enabled = (
+            envs.VDT_STEP_STREAMS
+            and self.config.kv_transfer_config is None
+        )
+        self._stream_depth = max(
+            envs.VDT_STEP_STREAM_DEPTH,
+            2 * self.scheduler_config.max_concurrent_dispatches,
+        )
+        self._streams_started = False
+        self._encoder = StepDeltaEncoder()
+        self._local_runner = None
+        self._inflight_steps: dict[int, _InflightStep] = {}
+        self._inflight_lock = threading.Lock()
 
         self.distributed_init_method = get_distributed_init_method(
             envs.VDT_HOST_IP or get_ip(), get_open_port()
@@ -488,7 +550,12 @@ class MultiHostExecutor(Executor):
 
         trace_ctx = self._step_trace_ctx(method, args)
         payload = self._payload_bytes(args) if trace_ctx is not None else None
-        with self._dispatch_span(trace_ctx, 0, method, payload):
+        step_id = (
+            getattr(args[0], "step_id", None)
+            if trace_ctx is not None and args
+            else None
+        )
+        with self._dispatch_span(trace_ctx, 0, method, payload, step_id):
             local_fut = self._local_pool.submit(
                 run_method, self._local_worker, method, args, kwargs
             )
@@ -499,7 +566,7 @@ class MultiHostExecutor(Executor):
             # attach to: host.worker.run builds the RPC frame inside
             # this block, so the frame carries the span's context.
             with self._dispatch_span(
-                trace_ctx, host.host_rank, method, payload
+                trace_ctx, host.host_rank, method, payload, step_id
             ):
                 remote_futs.append(
                     asyncio.run_coroutine_threadsafe(
@@ -512,28 +579,40 @@ class MultiHostExecutor(Executor):
         if non_block:
             return self._gather_pool.submit(
                 self._gather, futures, origins, unique_reply_rank, timeout,
-                _phase, trace_ctx,
+                _phase, trace_ctx, step_id,
             )
         return self._gather(futures, origins, unique_reply_rank, timeout,
-                            _phase, trace_ctx)
+                            _phase, trace_ctx, step_id)
 
     def execute_model(self, scheduler_output, non_block: bool = False):
-        """Blocking path: one collective execute_model RPC.  Pipelined
-        path (non_block): two-phase dispatch_model / fetch_results so
-        the per-step DCN round trip overlaps device compute — the
-        steady-state amortization the fused-decode design exists for
-        (SURVEY.md §3.3; reference's in-flight batches,
-        launch.py:298-302).
+        """Step dispatch, in order of preference:
 
-        Per-peer ordering: dispatch and fetch RPCs are scheduled on the
-        executor loop from this (engine) thread, in program order; the
-        agent routes the two verbs to separate single-thread pools, so
-        dispatches stay ordered, fetches stay ordered, and fetch N never
-        blocks dispatch N+1."""
-        if not non_block or self.config.kv_transfer_config is not None:
+        1. **Persistent step streams** (default, ``VDT_STEP_STREAMS``):
+           the step is delta-compressed against the worker mirrors
+           (engine/step_delta.py), serialized ONCE, and pushed to every
+           host as a single one-way frame; results come back as one-way
+           acks collected by ``_on_step_result``.  Every step — blocking
+           prefills included — flows through the stream so the encoder
+           and the per-host mirrors stay in lockstep.
+        2. Legacy two-phase dispatch_model/fetch_results RPC pairs
+           (``VDT_STEP_STREAMS=0``), the pre-stream pipelining path.
+        3. Blocking collective execute_model (legacy non-pipelined, and
+           all KV-transfer deployments — their steps fan out through the
+           aggregating collective path).
+
+        Per-peer ordering: stream frames (and legacy dispatch/fetch
+        RPCs) are scheduled on the executor loop from this (engine)
+        thread, in program order, over one TCP stream per host — so
+        every host's mirror sees every step in step-id order."""
+        if self.config.kv_transfer_config is not None:
             return super().execute_model(scheduler_output, non_block=False)
         if self.is_failed:
             raise RuntimeError("Executor failed.")
+        if self._stream_enabled:
+            self._ensure_step_streams()
+            return self._stream_execute(scheduler_output, non_block)
+        if not non_block:
+            return super().execute_model(scheduler_output, non_block=False)
         step_id = scheduler_output.step_id
         trace_ctx = self._step_trace_ctx("dispatch_model", (scheduler_output,))
         payload = (
@@ -541,7 +620,9 @@ class MultiHostExecutor(Executor):
             if trace_ctx is not None
             else None
         )
-        with self._dispatch_span(trace_ctx, 0, "dispatch_model", payload):
+        with self._dispatch_span(
+            trace_ctx, 0, "dispatch_model", payload, step_id
+        ):
             local_d = self._local_pool.submit(
                 run_method,
                 self._local_worker,
@@ -557,7 +638,7 @@ class MultiHostExecutor(Executor):
             # (the frames are built inside this block), so worker-side
             # dispatch AND fetch spans chain into the step's trace.
             with self._dispatch_span(
-                trace_ctx, host.host_rank, "dispatch_model", payload
+                trace_ctx, host.host_rank, "dispatch_model", payload, step_id
             ):
                 remote_d.append(
                     asyncio.run_coroutine_threadsafe(
@@ -593,7 +674,265 @@ class MultiHostExecutor(Executor):
             self.execute_timeout,
             PHASE_EXECUTE,
             trace_ctx,
+            step_id,
         )
+
+    # ---- persistent step streams (ISSUE 7) ----
+    def _ensure_step_streams(self) -> None:
+        """Lazy one-time stream start (first dispatched step): a local
+        in-process runner for host 0, and one ``start_step_stream`` RPC
+        per remote host handing it the per-host ack callback."""
+        if self._streams_started:
+            return
+        from vllm_distributed_tpu.worker.step_stream import StepStreamRunner
+
+        def _local_deliver(step_id, result, error, spans, _ctx):
+            self._on_step_result(0, step_id, result, error, spans or [])
+
+        self._local_runner = StepStreamRunner(
+            self._local_worker,
+            _local_deliver,
+            depth=self._stream_depth,
+            name="local",
+        )
+        for host in self._remote_hosts:
+            if host.worker is None:
+                continue
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    host.worker.start_step_stream(
+                        self._make_remote_deliver(host.host_rank),
+                        self._stream_depth,
+                    ),
+                    self._loop,
+                ).result(timeout=self.execute_timeout)
+            except Exception as e:  # noqa: BLE001 — a host that cannot
+                # start its run loop fails the deployment, attributed.
+                failure = HostFailure.from_exception(
+                    host.host_rank,
+                    host.address,
+                    PHASE_EXECUTE,
+                    "step stream start failed",
+                    e,
+                )
+                self._notify_failure(failure)
+                raise RuntimeError(
+                    f"Executor failed: {failure.describe()}"
+                ) from e
+        self._streams_started = True
+
+    def _make_remote_deliver(self, host_rank: int):
+        """Driver-side ack sink proxied to one agent: runs on the
+        executor loop when the host's one-way ack frame arrives."""
+        import cloudpickle
+
+        def step_ack(step_id, payload=None, error=None, spans=None):
+            result = (
+                cloudpickle.loads(payload) if payload is not None else None
+            )
+            self._on_step_result(
+                host_rank, step_id, result, error, spans or []
+            )
+
+        step_ack.__name__ = f"step_ack_host{host_rank}"
+        return step_ack
+
+    def _stream_execute(self, scheduler_output, non_block: bool):
+        step_id = scheduler_output.step_id
+        tracer = get_tracer()
+        trace_ctx = (
+            getattr(scheduler_output, "trace_ctx", None)
+            if tracer.enabled
+            else None
+        )
+        frame = self._encoder.encode(
+            scheduler_output, blocking=not non_block
+        )
+        # Serialize ONCE; every host send shares the same bytes (the
+        # transport ships them as one sideband buffer per host, and the
+        # payload_bytes span attribute is exact, not re-pickled).
+        import cloudpickle
+
+        frame_bytes = cloudpickle.dumps(frame)
+        live = [h for h in self._remote_hosts if h.worker is not None]
+        origins = {0: "local"}
+        origins.update({h.host_rank: h.address for h in live})
+        entry = _InflightStep(step_id, origins, trace_ctx)
+        with self._inflight_lock:
+            self._inflight_steps[step_id] = entry
+        if self.is_failed:
+            # A failure that landed after execute_model's gate but
+            # before the insertion above raced _fail_inflight_steps'
+            # snapshot — nobody else will release this entry, so fail
+            # it here (EOF-fast, never deadline-slow).
+            cause = self.failure_info
+            entry.error = entry.error or (
+                cause if cause is not None else "executor failed"
+            )
+            entry.event.set()
+        with self._dispatch_span(
+            trace_ctx, 0, "stream_step", len(frame_bytes), step_id
+        ):
+            self._local_runner.submit(frame, None)
+        for host in live:
+            span = self._dispatch_span(
+                trace_ctx,
+                host.host_rank,
+                "stream_step",
+                len(frame_bytes),
+                step_id,
+            )
+            with span:
+                # The span's context rides the frame so the host's
+                # worker.execute/serialize/reply spans (shipped back in
+                # the ack) chain into this step's trace.
+                ctx = span.ctx if trace_ctx is not None else None
+                fut = asyncio.run_coroutine_threadsafe(
+                    apply_oneway(
+                        host.worker,
+                        "stream_step",
+                        frame_bytes,
+                        list(ctx) if ctx is not None else None,
+                    ),
+                    self._loop,
+                )
+                fut.add_done_callback(_log_send_error)
+        if non_block:
+            return self._gather_pool.submit(self._finish_step, step_id)
+        return self._finish_step(step_id)
+
+    def _on_step_result(
+        self, host_rank: int, step_id: int, result, error, spans
+    ) -> None:
+        """One host's ack for one step (executor loop for remote hosts,
+        runner resolve thread for host 0)."""
+        if spans:
+            get_tracer().adopt(spans)
+        with self._inflight_lock:
+            entry = self._inflight_steps.get(step_id)
+        if entry is None:
+            logger.debug(
+                "ack for unknown step %d from host %d", step_id, host_rank
+            )
+            return
+        if entry.trace_ctx is not None:
+            get_tracer().record_span(
+                "executor.gather",
+                entry.t_wall,
+                max(time.monotonic() - entry.t_mono, 0.0),
+                parent=entry.trace_ctx,
+                target_host=f"host{host_rank}",
+                step_id=step_id,
+            )
+        if error is not None:
+            failure = HostFailure(
+                host_rank=host_rank,
+                address=entry.origins.get(host_rank, ""),
+                phase=PHASE_EXECUTE,
+                message=f"step {step_id} failed on host: {error}",
+            )
+            logger.error("%s — executor failed", failure.describe())
+            self._notify_failure(failure)
+            return
+        with self._inflight_lock:
+            entry.done.add(host_rank)
+            if host_rank == 0:
+                entry.result = result
+            if entry.expected <= entry.done:
+                # Do NOT pop here: _finish_step owns removal — a fast
+                # step completing before the gather-pool thread even
+                # looks up the entry must still find it.
+                entry.event.set()
+
+    def _finish_step(self, step_id: int):
+        """Wait out one step's acks under the execute deadline.  Runs on
+        a gather-pool thread (non_block) or the engine thread
+        (blocking); either way the wait is bounded and a blown deadline
+        names the laggard host(s).  Sole owner of entry removal."""
+        with self._inflight_lock:
+            entry = self._inflight_steps.get(step_id)
+        if entry is None:
+            raise RuntimeError(
+                "Executor failed."
+                if self.is_failed
+                else f"step {step_id} has no in-flight record"
+            )
+        remaining = entry.t_mono + self.execute_timeout - time.monotonic()
+        if not entry.event.wait(timeout=max(remaining, 0.0)):
+            with self._inflight_lock:
+                # Re-check under the lock: the final ack may have landed
+                # between the wait timing out and here — that's a
+                # completed step, not a deadline miss.
+                complete = (
+                    entry.error is None and entry.expected <= entry.done
+                )
+                laggards = sorted(entry.expected - entry.done)
+            if not complete:
+                names = ", ".join(
+                    f"rank {r} ({entry.origins.get(r, '?')})"
+                    for r in laggards
+                ) or "unknown"
+                first = laggards[0] if laggards else 0
+                failure = HostFailure(
+                    host_rank=first,
+                    address=entry.origins.get(first, ""),
+                    phase=PHASE_EXECUTE,
+                    message=(
+                        f"step dispatch deadline "
+                        f"({self.execute_timeout:.0f}s) missed by: {names}"
+                    ),
+                )
+                logger.error("%s", failure.describe())
+                self._notify_failure(failure)
+                entry.error = entry.error or failure
+                entry.event.set()
+        with self._inflight_lock:
+            self._inflight_steps.pop(step_id, None)
+        if entry.error is not None:
+            detail = (
+                entry.error.describe()
+                if isinstance(entry.error, HostFailure)
+                else str(entry.error)
+            )
+            raise RuntimeError(f"Executor failed: {detail}")
+        return entry.result
+
+    def _fail_inflight_steps(self, error: HostFailure | str) -> None:
+        """Release every engine-side waiter with the failure — a dead
+        deployment must never leave a `_finish_step` blocked until its
+        deadline when the cause is already known.  Entries stay in the
+        map (each `_finish_step` pops its own); on a dead deployment
+        the executor object is discarded wholesale, so unclaimed
+        entries cannot outlive it."""
+        with self._inflight_lock:
+            entries = list(self._inflight_steps.values())
+        for entry in entries:
+            if entry.error is None:
+                entry.error = error
+            entry.event.set()
+
+    def step_stream_stats(self) -> dict:
+        """Per-host run-loop stats ({dispatched, resolved, stalls,
+        inflight, max_queue_depth}) for the bench harness and the
+        dispatch microbench."""
+        stats: dict[str, dict] = {}
+        if self._local_runner is not None:
+            stats["host0"] = self._local_runner.stats()
+        for host in self._remote_hosts:
+            if host.worker is None:
+                continue
+            try:
+                stats[f"host{host.host_rank}"] = (
+                    asyncio.run_coroutine_threadsafe(
+                        host.worker.get_step_stream_stats(), self._loop
+                    ).result(timeout=10)
+                )
+            except Exception as e:  # noqa: BLE001 — stats are
+                # best-effort introspection.
+                logger.debug(
+                    "host %d stream stats failed: %s", host.host_rank, e
+                )
+        return stats
 
     def _step_trace_ctx(self, method: str, args: tuple):
         """Trace context for a step-shaped collective: the scheduler
@@ -618,16 +957,19 @@ class MultiHostExecutor(Executor):
             return -1
 
     @staticmethod
-    def _dispatch_span(ctx, host_rank, method, payload_bytes=None):
+    def _dispatch_span(ctx, host_rank, method, payload_bytes=None,
+                       step_id=None):
         if ctx is None:
             return NOOP_SPAN
         attrs = {"target_host": f"host{host_rank}", "method": method}
         if payload_bytes is not None:
             attrs["payload_bytes"] = payload_bytes
+        if step_id is not None:
+            attrs["step_id"] = step_id
         return get_tracer().span("executor.dispatch", parent=ctx, **attrs)
 
     def _gather(self, futures, origins, unique_reply_rank, timeout, phase,
-                trace_ctx=None):
+                trace_ctx=None, step_id=None):
         # One overall deadline, not timeout × num_hosts; a blown deadline
         # or a failed reply is attributed to the offending host(s).
         deadline = (
@@ -636,12 +978,11 @@ class MultiHostExecutor(Executor):
         tracer = get_tracer()
         results = []
         for fut, (host_rank, address) in zip(futures, origins):
+            attrs = {"target_host": f"host{host_rank}"}
+            if step_id is not None:
+                attrs["step_id"] = step_id
             span = (
-                tracer.span(
-                    "executor.gather",
-                    parent=trace_ctx,
-                    target_host=f"host{host_rank}",
-                )
+                tracer.span("executor.gather", parent=trace_ctx, **attrs)
                 if trace_ctx is not None
                 else NOOP_SPAN
             )
@@ -703,6 +1044,13 @@ class MultiHostExecutor(Executor):
         if getattr(self, "_shutting_down", False):
             return
         super()._notify_failure(failure)
+        # Any failure path (heartbeat, EOF, step error, deadline) must
+        # release step-stream waiters immediately with the root cause —
+        # detection stays EOF-fast instead of deadline-slow.
+        cause = self.failure_info
+        self._fail_inflight_steps(
+            cause if cause is not None else "executor failed"
+        )
 
     def shutdown(self) -> None:
         self._shutting_down = True
@@ -715,7 +1063,48 @@ class MultiHostExecutor(Executor):
         rebuilding the executor (engine/supervisor.py) can immediately
         re-listen on the same port.  Safe to call more than once."""
         self._cancel_heartbeats()
+        # Close the listener FIRST: the port must be re-bindable the
+        # instant teardown begins.  Test/compose respawners fork new
+        # agent processes within ~100ms of a kill, and a fork taken
+        # while this socket is still open would inherit the bound fd
+        # and hold the port against the supervisor's rebuilt executor.
+        server = getattr(self, "_server", None)
+        if server is not None:
+            self._server = None
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    self._close_server(server), self._loop
+                ).result(timeout=5)
+            except Exception as e:  # noqa: BLE001
+                logger.debug("listener close failed: %s", e)
+        # Release engine-side step waiters and stop the local run loop:
+        # gather-pool threads blocked in _finish_step must wake now, not
+        # at their deadline.
+        self._fail_inflight_steps("executor shutdown")
+        runner, self._local_runner = getattr(
+            self, "_local_runner", None
+        ), None
+        if runner is not None:
+            runner.stop()
         if drain_workers and not self.is_failed:
+            if getattr(self, "_streams_started", False):
+                # Stop remote run loops first so their worker threads
+                # are joined before the jax.distributed shutdown
+                # barrier below.
+                for host in self._remote_hosts:
+                    if host.worker is None:
+                        continue
+                    try:
+                        asyncio.run_coroutine_threadsafe(
+                            host.worker.stop_step_stream(), self._loop
+                        ).result(timeout=5)
+                    except Exception as e:  # noqa: BLE001 — teardown
+                        # is best-effort on each host.
+                        logger.debug(
+                            "stop_step_stream on host %d failed: %s",
+                            host.host_rank,
+                            e,
+                        )
             # Clean jax.distributed teardown on every host BEFORE dropping
             # the control plane (the shutdown barrier needs all tasks).
             # Pointless on a failed deployment: the collective would just
@@ -734,15 +1123,6 @@ class MultiHostExecutor(Executor):
                     self._loop.call_soon_threadsafe(host.transport.close)
             except Exception as e:  # noqa: BLE001
                 logger.debug("peer teardown failed: %s", e)
-        server = getattr(self, "_server", None)
-        if server is not None:
-            self._server = None
-            try:
-                asyncio.run_coroutine_threadsafe(
-                    self._close_server(server), self._loop
-                ).result(timeout=5)
-            except Exception as e:  # noqa: BLE001
-                logger.debug("listener close failed: %s", e)
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._loop_thread.join(timeout=5)
         self._local_pool.shutdown(wait=False)
@@ -753,6 +1133,12 @@ class MultiHostExecutor(Executor):
     async def _close_server(server) -> None:
         server.close()
         await server.wait_closed()
+
+
+def _log_send_error(fut) -> None:
+    e = fut.exception()
+    if e is not None:
+        logger.debug("step frame send failed: %s", e)
 
 
 def method_desc(phase: str) -> str:

@@ -87,6 +87,7 @@ DOCUMENTED_METRICS = (
     "vllm:step_dispatch_time_seconds",
     "vllm:step_gather_time_seconds",
     "vllm:request_success_total",
+    "vllm:pipeline_breaks_total",
     "vllm:host_up",
     "vllm:heartbeat_latency_seconds",
     "vllm:engine_dead_info",
@@ -223,6 +224,13 @@ class EngineMetrics:
             "Per-host reply wait per step (bounds device time + DCN)",
             _STEP_BUCKETS,
         )
+        self.pipeline_breaks = counter(
+            "vllm:pipeline_breaks",
+            "Async-scheduling reconciliation drains: the predicted "
+            "post-step state was invalidated (stop/EOS/budget "
+            "mid-window, admission, preemption risk) and the dispatch "
+            "pipeline flushed before rescheduling",
+        )
         self._success = Counter(
             "vllm:request_success",
             "Finished requests by finish reason",
@@ -280,6 +288,10 @@ class EngineMetrics:
     def record_preemptions(self, n: int) -> None:
         if self.enabled and n:
             self.preemptions.inc(n)
+
+    def record_pipeline_break(self) -> None:
+        if self.enabled:
+            self.pipeline_breaks.inc()
 
     def record_prompt_tokens(self, n: int) -> None:
         if self.enabled and n:

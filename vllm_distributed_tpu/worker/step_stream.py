@@ -1,0 +1,269 @@
+"""Persistent step-stream run loop (ISSUE 7 tentpole piece 1, worker
+side).
+
+Replaces the per-step ``dispatch_model``/``fetch_results`` RPC
+round-trip pair with a long-lived pull loop: the driver pushes encoded
+``StepFrame``s into this runner's bounded inbox (one ONE-WAY frame per
+step), the dispatch thread decodes them against the host's
+``StepStateMirror`` and issues them to the device, and the resolve
+thread fetches results in FIFO order and hands them to ``deliver`` —
+which, on a remote host, sends one one-way ack frame back to the
+driver.  A step therefore costs two one-way frames total instead of two
+request/reply pairs, and the driver never blocks a thread per step on
+the wire.
+
+Threading contract: both loop threads are daemon (named ``vdt-*`` so
+the leak assertions in the fault suite see them) AND joined by
+``stop()``; every queue wait is deadline-bounded (``timeout=`` + stop
+flag — the step-queue wait pattern VDT003 enforces for this module).
+
+Stall accounting: ``stalls`` counts the times the dispatch thread had
+to WAIT for a frame while the device had nothing in flight — the
+precise "scheduler idled between gather N and dispatch N+1" signal the
+overlapped driver is built to eliminate (acceptance: 0 at steady
+state).  The blocking driver protocol measures one stall per step by
+construction.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable
+
+from vllm_distributed_tpu.engine.step_delta import StepFrame, StepStateMirror
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.tracing import get_tracer
+
+logger = init_logger(__name__)
+
+# Poll granularity for the stop flag; every queue wait in this module is
+# bounded by it.
+_POLL_SECONDS = 0.5
+
+# Deliver callback:
+# (step_id, result, error_message|None, wire_spans, dispatch_span_ctx).
+DeliverFn = Callable[[int, Any, str | None, list[dict], Any], None]
+
+
+class StepStreamRunner:
+    """One per worker host.  ``submit`` is called from the transport
+    side (agent event loop, or the driver's engine thread for the local
+    worker) and never blocks; execution happens on the two loop
+    threads."""
+
+    def __init__(
+        self,
+        worker: Any,
+        deliver: DeliverFn,
+        *,
+        depth: int,
+        name: str = "local",
+    ) -> None:
+        self.worker = worker
+        self.deliver = deliver
+        self.mirror = StepStateMirror()
+        self._inbox: queue.Queue = queue.Queue(maxsize=max(depth, 1))
+        self._resolve_q: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        # Stats (read via stats(), written on the loop threads).
+        self._dispatched = 0
+        self._resolved = 0
+        self._stalls = 0
+        self._inflight = 0
+        self._max_queue_depth = 0
+        self._dispatch_thread = threading.Thread(
+            target=self._dispatch_loop,
+            daemon=True,
+            name=f"vdt-stepstream-dispatch-{name}",
+        )
+        self._resolve_thread = threading.Thread(
+            target=self._resolve_loop,
+            daemon=True,
+            name=f"vdt-stepstream-resolve-{name}",
+        )
+        self._dispatch_thread.start()
+        self._resolve_thread.start()
+
+    def _deliver(self, step_id, result, error, spans, span_ctx) -> None:
+        """Deliver guard: the callback crosses into transport territory
+        (pickle + event-loop handoff on remote hosts) and an exception
+        there must never kill a loop thread — a dead loop thread would
+        silently wedge every queued step until the driver's deadline."""
+        try:
+            self.deliver(step_id, result, error, spans, span_ctx)
+        except Exception:  # noqa: BLE001 — the stream must outlive a
+            # failed ack; the driver's per-step deadline attributes it.
+            logger.exception("step %d: result delivery failed", step_id)
+
+    # ---- intake (transport side) ----
+    def submit(self, frame: StepFrame, span_ctx: tuple | None = None) -> None:
+        """Enqueue one decoded-on-arrival step.  Never blocks: the
+        driver bounds in-flight steps well under the inbox depth, so a
+        full inbox is a protocol violation and is surfaced as a step
+        error instead of backpressure that could wedge the caller."""
+        try:
+            self._inbox.put_nowait((frame, span_ctx))
+        except queue.Full:
+            logger.error(
+                "step stream inbox overflow at step %d", frame.step_id
+            )
+            self._deliver(
+                frame.step_id, None, "step stream inbox overflow", [], None
+            )
+        else:
+            with self._lock:
+                self._max_queue_depth = max(
+                    self._max_queue_depth, self._inbox.qsize()
+                )
+
+    # ---- loops ----
+    def _next_frame(self):
+        """Bounded pull with stall accounting: a wait that begins with
+        nothing in flight on the device (and at least one step already
+        served) is a stall window."""
+        try:
+            item = self._inbox.get_nowait()
+            return None if item is None else item
+        except queue.Empty:
+            pass
+        with self._lock:
+            if self._dispatched > 0 and self._inflight == 0:
+                self._stalls += 1
+        while not self._stop.is_set():
+            try:
+                item = self._inbox.get(timeout=_POLL_SECONDS)
+            except queue.Empty:
+                continue
+            if item is None:  # stop() wake sentinel
+                return None
+            return item
+        return None
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            item = self._next_frame()
+            if item is None:
+                return  # stop flag (or wake sentinel) — exit
+            frame, span_ctx = item
+            try:
+                so = self.mirror.decode(frame)
+            except Exception as e:  # noqa: BLE001 — mirror desync is
+                # fatal for the host; surface it as a step error.
+                logger.exception("step %d: frame decode failed", frame.step_id)
+                self._deliver(
+                    frame.step_id, None, f"frame decode: {e}", [], span_ctx
+                )
+                continue
+            with self._lock:
+                self._dispatched += 1
+                self._inflight += 1
+            if frame.blocking:
+                # Blocking steps (prefill/mixed) run inline: the driver
+                # is waiting on this result before scheduling anything
+                # else, so two-phase staging buys nothing.
+                result, err, spans = self._run_step(
+                    span_ctx, self.worker.execute_model, so
+                )
+                with self._lock:
+                    self._inflight -= 1
+                    self._resolved += 1
+                self._deliver(
+                    frame.step_id, result, err, spans, span_ctx
+                )
+                continue
+            try:
+                self.worker.dispatch_model(so)
+            except Exception as e:  # noqa: BLE001 — device dispatch
+                # failure fails the step, attributed by the driver.
+                logger.exception(
+                    "step %d: dispatch failed", frame.step_id
+                )
+                with self._lock:
+                    self._inflight -= 1
+                self._deliver(
+                    frame.step_id, None, f"dispatch: {e}", [], span_ctx
+                )
+                continue
+            self._resolve_q.put((frame.step_id, span_ctx))
+
+    def _resolve_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = self._resolve_q.get(timeout=_POLL_SECONDS)
+            except queue.Empty:
+                continue
+            if item is None:  # stop() wake sentinel
+                return
+            step_id, span_ctx = item
+            result, err, spans = self._run_step(
+                span_ctx, self.worker.fetch_results, step_id
+            )
+            with self._lock:
+                self._inflight -= 1
+                self._resolved += 1
+            self._deliver(step_id, result, err, spans, span_ctx)
+
+    def _run_step(self, span_ctx, fn, arg):
+        """Run one worker call, wrapped in a ``worker.execute`` span
+        when the driver attached a dispatch-span context (remote hosts
+        with tracing on); the span ships back inside the ack so the
+        step's trace keeps its worker-side chain under the one-way
+        protocol."""
+        spans: list[dict] = []
+        tracer = get_tracer()
+        if span_ctx is None or not tracer.enabled:
+            try:
+                return fn(arg), None, spans
+            except Exception as e:  # noqa: BLE001 — worker errors are
+                # delivered, not raised on the loop thread.
+                logger.exception("step stream worker call failed")
+                return None, f"{type(e).__name__}: {e}", spans
+        sp = None
+        try:
+            try:
+                with tracer.span(
+                    "worker.execute",
+                    parent=tuple(span_ctx),
+                    record=False,
+                    method=fn.__name__,
+                ) as sp:
+                    result = fn(arg)
+            finally:
+                if sp is not None:
+                    spans.append(sp.to_wire())
+            return result, None, spans
+        except Exception as e:  # noqa: BLE001
+            logger.exception("step stream worker call failed")
+            return None, f"{type(e).__name__}: {e}", spans
+
+    # ---- introspection / teardown ----
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "dispatched": self._dispatched,
+                "resolved": self._resolved,
+                "stalls": self._stalls,
+                "inflight": self._inflight,
+                "max_queue_depth": self._max_queue_depth,
+            }
+
+    def stop(self, join_timeout: float = 5.0) -> None:
+        self._stop.set()
+        # Wake sentinels so idle loop threads exit immediately instead
+        # of at their next poll tick (teardown latency matters: the
+        # supervisor's rebuild waits on this join).
+        for q in (self._inbox, self._resolve_q):
+            try:
+                q.put_nowait(None)
+            except queue.Full:
+                pass  # a busy queue means the thread isn't idle anyway
+        self._dispatch_thread.join(timeout=join_timeout)
+        self._resolve_thread.join(timeout=join_timeout)
+        if self._dispatch_thread.is_alive() or self._resolve_thread.is_alive():
+            logger.warning(
+                "step stream loop thread(s) still running after %.1fs "
+                "(wedged worker call?)",
+                join_timeout,
+            )
