@@ -1,0 +1,21 @@
+"""Positive corpus for VDT008 unbounded-queue."""
+
+import asyncio
+import collections
+import queue
+from collections import deque
+from queue import Queue, SimpleQueue
+
+
+class Intake:
+    def __init__(self):
+        self.q = queue.Queue()  # EXPECT
+        self.sq = SimpleQueue()  # EXPECT
+        self.sq2 = queue.SimpleQueue()  # EXPECT
+        self.waiting = deque()  # EXPECT
+        self.also_waiting = collections.deque([1, 2, 3])  # EXPECT
+        self.aq = asyncio.Queue()  # EXPECT
+        self.zero_is_infinite = Queue(maxsize=0)  # EXPECT
+        self.zero_positional = queue.Queue(0)  # EXPECT
+        self.none_maxlen = deque([], maxlen=None)  # EXPECT
+        self.lifo = queue.LifoQueue()  # EXPECT

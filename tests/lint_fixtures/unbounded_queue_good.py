@@ -1,0 +1,20 @@
+"""Negative corpus for VDT008: explicit bounds and waived sites."""
+
+import asyncio
+import collections
+import queue
+from collections import deque
+from queue import Queue, SimpleQueue
+
+DEPTH = 8
+
+
+class Intake:
+    def __init__(self):
+        self.q = queue.Queue(maxsize=DEPTH)
+        self.q2 = Queue(DEPTH)
+        self.aq = asyncio.Queue(maxsize=16)
+        self.window = deque(maxlen=32)
+        self.window2 = collections.deque([1, 2], 4)
+        # vdt-lint: disable=unbounded-queue — producers bounded by admission caps
+        self.waived = SimpleQueue()

@@ -39,6 +39,7 @@ RULES = {
     "thread-leak": "VDT005",
     "silent-except": "VDT006",
     "orphan-span": "VDT007",
+    "unbounded-queue": "VDT008",
 }
 
 

@@ -111,6 +111,19 @@ def _percentile(values: list[float], q: float) -> float:
     return ordered[idx]
 
 
+def _rss_mb() -> float:
+    """Resident set size in MiB (Linux).  The overload phase asserts
+    this plateaus — an unbounded waiting queue shows up here first."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
 def run_soak(
     cycles: int = 5,
     *,
@@ -120,14 +133,25 @@ def run_soak(
     kill_after_tokens: int = 3,
     hb_interval: float = 0.5,
     backoff: float = 0.2,
+    overload_rps: float = 0.0,
+    overload_cap: int = 8,
 ) -> dict:
     """Run the kill→recover loop; returns the report dict.  Mutates (and
     restores) os.environ — call from a dedicated process or a test that
-    tolerates env churn."""
+    tolerates env churn.
+
+    ``overload_rps`` > 0 arms the ISSUE 8 overload phase: open-loop
+    Poisson arrivals at that rate run CONCURRENTLY with the kill→recover
+    cycles (admission caps at ``overload_cap``), and the report asserts
+    the overload-resilience contract — sheds happen (typed 429-path
+    rejections, not hangs), the waiting queue stays under the cap, and
+    RSS plateaus instead of growing with offered load."""
     import asyncio
+    import random
 
     from vllm_distributed_tpu.config import EngineArgs
     from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+    from vllm_distributed_tpu.engine.overload import EngineOverloadedError
     from vllm_distributed_tpu.executor.multihost import MultiHostExecutor
     from vllm_distributed_tpu.sampling_params import SamplingParams
     from vllm_distributed_tpu.testing import write_llama_config
@@ -152,6 +176,8 @@ def run_soak(
         "VDT_MOCK_TOKEN_SEQ": "1",
         "VDT_MOCK_EXECUTE_SLEEP_SECONDS": "0.05",
     }
+    if overload_rps > 0:
+        env["VDT_MAX_WAITING_REQUESTS"] = str(overload_cap)
     saved = {k: os.environ.get(k) for k in env}
     os.environ.update(env)
     agents = None
@@ -163,6 +189,18 @@ def run_soak(
     )
 
     async def one_cycle(idx: int, kill: bool):
+        # Under the overload phase the victim competes with the offered
+        # load for admission slots; a well-behaved client retries 429s,
+        # so the victim does too (a reject carries no partial state —
+        # whole-request retry is safe).
+        for _ in range(100):
+            try:
+                return await _one_cycle_admitted(idx, kill)
+            except EngineOverloadedError:
+                await asyncio.sleep(0.1)
+        raise RuntimeError("victim request never admitted under overload")
+
+    async def _one_cycle_admitted(idx: int, kill: bool):
         tokens: list[int] = []
         killed = False
         last_arrival = time.monotonic()
@@ -186,6 +224,59 @@ def run_soak(
     # bound each cycle so it reports instead of stalling CI forever.
     cycle_timeout = 60.0
 
+    # Overload phase (ISSUE 8): sustained over-capacity offered load
+    # riding across the kill→recover cycles.
+    load_stats = {
+        "offered": 0,
+        "completed": 0,
+        "rejected": 0,
+        "dead_errors": 0,
+        "other_errors": 0,
+        "max_waiting_depth": 0,
+    }
+
+    async def one_load_request(idx: int) -> None:
+        try:
+            async for _ in engine.generate(
+                f"load-{idx}",
+                prompt_token_ids=list(prompt),
+                sampling_params=SamplingParams(
+                    temperature=0.0, max_tokens=4, ignore_eos=True
+                ),
+            ):
+                pass
+            load_stats["completed"] += 1
+        except EngineOverloadedError:
+            load_stats["rejected"] += 1
+        except Exception as e:  # noqa: BLE001 — accounted, not fatal
+            from vllm_distributed_tpu.engine.async_llm import (
+                EngineDeadError,
+            )
+
+            if isinstance(e, EngineDeadError):
+                load_stats["dead_errors"] += 1
+            else:
+                load_stats["other_errors"] += 1
+
+    async def offered_load(stop: "asyncio.Event") -> None:
+        rng = random.Random(7)
+        inflight: set = set()
+        idx = 0
+        while not stop.is_set():
+            load_stats["offered"] += 1
+            t = asyncio.ensure_future(one_load_request(idx))
+            inflight.add(t)
+            t.add_done_callback(inflight.discard)
+            idx += 1
+            load_stats["max_waiting_depth"] = max(
+                load_stats["max_waiting_depth"],
+                len(engine.engine.scheduler.waiting),
+            )
+            await asyncio.sleep(rng.expovariate(overload_rps))
+        # Sheds resolve fast; completions are bounded by max_tokens=4.
+        if inflight:
+            await asyncio.wait(list(inflight), timeout=30)
+
     async def go():
         latencies: list[float] = []
         failures = 0
@@ -197,17 +288,28 @@ def run_soak(
             raise RuntimeError(
                 f"baseline run wrong: {tokens} != {expected}"
             )
-        for i in range(cycles):
-            tokens, stall = await asyncio.wait_for(
-                one_cycle(i, kill=True), timeout=cycle_timeout
-            )
-            latencies.append(stall)
-            if tokens != expected:
-                failures += 1
-                print(
-                    f"cycle {i}: REPLAY MISMATCH {tokens} != {expected}",
-                    file=sys.stderr,
+        stop_load = asyncio.Event()
+        load_task = (
+            asyncio.ensure_future(offered_load(stop_load))
+            if overload_rps > 0
+            else None
+        )
+        try:
+            for i in range(cycles):
+                tokens, stall = await asyncio.wait_for(
+                    one_cycle(i, kill=True), timeout=cycle_timeout
                 )
+                latencies.append(stall)
+                if tokens != expected:
+                    failures += 1
+                    print(
+                        f"cycle {i}: REPLAY MISMATCH {tokens} != {expected}",
+                        file=sys.stderr,
+                    )
+        finally:
+            if load_task is not None:
+                stop_load.set()
+                await load_task
         return latencies, failures
 
     # Setup happens inside the try so a failed boot (port race, connect
@@ -219,6 +321,11 @@ def run_soak(
             tmpdir = tempfile.mkdtemp(prefix="vdt_soak_")
             model_dir = write_llama_config(os.path.join(tmpdir, "m"))
         agents = RespawningAgent(port)
+        engine_kwargs = {}
+        if overload_rps > 0:
+            # Constrain capacity so the configured rate is genuinely
+            # over-capacity on the mock deployment.
+            engine_kwargs["max_num_seqs"] = 4
         engine = AsyncLLM.from_engine_args(
             EngineArgs(
                 model=model_dir,
@@ -228,12 +335,15 @@ def run_soak(
                 num_decode_steps=1,
                 max_model_len=512,
                 distributed_executor_backend=SoakExecutor,
+                **engine_kwargs,
             )
         )
+        rss_before = _rss_mb()
+        threads_before = threading.active_count()
         latencies, failures = (
             asyncio.new_event_loop().run_until_complete(go())
         )
-        return {
+        report = {
             "cycles": cycles,
             "replay_failures": failures,
             "recovery_seconds": {
@@ -248,6 +358,25 @@ def run_soak(
             "restarts_total": engine.supervisor.restarts_total,
             "agent_respawns": agents.respawns,
         }
+        if overload_rps > 0:
+            rss_after = _rss_mb()
+            report["overload"] = {
+                "offered_rps": overload_rps,
+                "cap": overload_cap,
+                **load_stats,
+                "rss_before_mb": round(rss_before, 1),
+                "rss_after_mb": round(rss_after, 1),
+                "rss_growth_mb": round(rss_after - rss_before, 1),
+                "threads_before": threads_before,
+                "threads_after": threading.active_count(),
+                # The contract the smoke test asserts: the cap held
+                # (bounded memory) and load was actually shed.
+                "bounded": (
+                    load_stats["max_waiting_depth"] <= overload_cap
+                    and load_stats["rejected"] > 0
+                ),
+            }
+        return report
     finally:
         try:
             if engine is not None:
@@ -270,15 +399,34 @@ def main() -> None:
     parser.add_argument("--max-tokens", type=int, default=14)
     parser.add_argument("--kill-after-tokens", type=int, default=3)
     parser.add_argument("--backoff", type=float, default=0.2)
+    parser.add_argument(
+        "--overload-rps",
+        type=float,
+        default=0.0,
+        help="arm the overload phase: open-loop Poisson offered load "
+        "at this rate rides across the kill-recover cycles "
+        "(admission caps on; 0 = off)",
+    )
+    parser.add_argument(
+        "--overload-cap",
+        type=int,
+        default=8,
+        help="VDT_MAX_WAITING_REQUESTS for the overload phase",
+    )
     args = parser.parse_args()
     report = run_soak(
         cycles=args.cycles,
         max_tokens=args.max_tokens,
         kill_after_tokens=args.kill_after_tokens,
         backoff=args.backoff,
+        overload_rps=args.overload_rps,
+        overload_cap=args.overload_cap,
     )
     print(json.dumps(report))
     if report["replay_failures"]:
+        sys.exit(1)
+    overload = report.get("overload")
+    if overload is not None and not overload["bounded"]:
         sys.exit(1)
 
 

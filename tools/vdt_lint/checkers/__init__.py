@@ -9,6 +9,7 @@
 | VDT005 | thread-leak      | threads are daemons or joined on shutdown        |
 | VDT006 | silent-except    | no ``except Exception: pass``                    |
 | VDT007 | orphan-span      | spans open via ``with`` / try-finally ``.end()`` |
+| VDT008 | unbounded-queue  | queues/deques on the request path carry a bound  |
 """
 
 from tools.vdt_lint.checkers import (  # noqa: F401
@@ -18,5 +19,6 @@ from tools.vdt_lint.checkers import (  # noqa: F401
     orphan_span,
     silent_except,
     thread_leak,
+    unbounded_queue,
     unbounded_wait,
 )

@@ -1,0 +1,108 @@
+"""VDT008 unbounded-queue: queues/deques on the request path carry an
+explicit bound or a justified waiver.
+
+The ISSUE 8 overload class: before bounded admission, the scheduler's
+waiting deque and the AsyncLLM intake grew without limit under offered
+load the engine couldn't absorb — memory, then latency, then the
+process fell over.  Every ``queue.Queue()`` / ``asyncio.Queue()`` /
+``collections.deque()`` constructed in ``engine/``, ``entrypoints/``,
+or ``distributed/`` must either pass an explicit bound
+(``maxsize=``/``maxlen=``, or positionally) or carry a waiver naming
+what bounds it upstream (admission caps, 1:1 with live handlers, a
+pruning loop).  ``SimpleQueue`` has no capacity parameter at all, so it
+is always flagged — bound it upstream and say how, or use a bounded
+``queue.Queue``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.vdt_lint.astutil import dotted_name
+from tools.vdt_lint.core import Checker, FileContext, Finding, register
+
+# Constructors whose FIRST positional (or the named kwarg) is the bound.
+# A literal 0 (queue.Queue's "infinite") does not count as a bound.
+_MAXSIZE_TARGETS = {
+    "Queue",
+    "LifoQueue",
+    "PriorityQueue",
+    "queue.Queue",
+    "queue.LifoQueue",
+    "queue.PriorityQueue",
+    "_queue.Queue",
+    "asyncio.Queue",
+    "asyncio.LifoQueue",
+    "asyncio.PriorityQueue",
+}
+
+# deque(iterable, maxlen) — the SECOND positional (or maxlen=) bounds it.
+_MAXLEN_TARGETS = {"deque", "collections.deque"}
+
+# No capacity parameter exists: always unbounded.
+_ALWAYS_UNBOUNDED = {
+    "SimpleQueue",
+    "queue.SimpleQueue",
+    "_queue.SimpleQueue",
+}
+
+
+def _is_zero(node: ast.expr) -> bool:
+    return isinstance(node, ast.Constant) and node.value in (0, None)
+
+
+def _bound_given(call: ast.Call, kwarg: str, pos_index: int) -> bool:
+    kw = next((k for k in call.keywords if k.arg == kwarg), None)
+    if kw is not None:
+        return not _is_zero(kw.value)
+    if len(call.args) > pos_index:
+        return not _is_zero(call.args[pos_index])
+    return False
+
+
+@register
+class UnboundedQueueChecker(Checker):
+    code = "VDT008"
+    rule = "unbounded-queue"
+    description = "queue/deque constructed without an explicit bound"
+    rationale = (
+        "an unbounded queue on the request path turns overload into "
+        "memory growth and tail latency instead of load shedding; "
+        "bound it, or waive with what bounds it upstream"
+    )
+    scope = ("engine/", "entrypoints/", "distributed/")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is None:
+                continue
+            if name in _ALWAYS_UNBOUNDED:
+                yield ctx.finding(
+                    self,
+                    node,
+                    f"{name}() has no capacity bound — bound the "
+                    "producers and waive with the justification, or "
+                    "use queue.Queue(maxsize=...)",
+                )
+            elif name in _MAXSIZE_TARGETS:
+                if not _bound_given(node, "maxsize", 0):
+                    yield ctx.finding(
+                        self,
+                        node,
+                        f"{name}() without maxsize is unbounded — pass "
+                        "an explicit bound or waive with what bounds "
+                        "it upstream",
+                    )
+            elif name in _MAXLEN_TARGETS:
+                if not _bound_given(node, "maxlen", 1):
+                    yield ctx.finding(
+                        self,
+                        node,
+                        f"{name}() without maxlen is unbounded — pass "
+                        "an explicit bound or waive with what bounds "
+                        "it upstream",
+                    )

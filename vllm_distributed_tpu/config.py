@@ -236,6 +236,22 @@ class SchedulerConfig:
     # Pre-compile the prefill/mixed single-step program per token
     # bucket at boot (first-request TTFT becomes execution time).
     warmup_prefill: bool = False
+    # ---- overload resilience (ISSUE 8; every knob defaults OFF so the
+    # seed behavior is unchanged until an operator opts in) ----
+    # Caps on the admission queue: waiting requests / queued prompt
+    # tokens.  0 = unbounded.  Enforced at the AsyncLLM surface (typed
+    # EngineOverloadedError -> HTTP 429 + Retry-After).
+    max_waiting_requests: int = 0
+    max_queued_tokens: int = 0
+    # Reject admission when the prompt's estimated page demand would
+    # leave less than this fraction of usable KV pages free.  0 = off.
+    kv_admission_watermark: float = 0.0
+    # Server-default per-request deadline (ms); 0 = none.
+    default_deadline_ms: int = 0
+    # Preemptions per request (while others wait) before the scheduler
+    # sheds it with finish_reason="overloaded" instead of recompute
+    # thrash.  0 = off.
+    preempt_shed_threshold: int = 0
 
     def fused_decode_steps(self) -> int:
         """The uniform fused-scan length K the scheduler emits: the
@@ -259,6 +275,22 @@ class SchedulerConfig:
             raise ValueError("num_decode_steps must be >= 1")
         if self.max_concurrent_dispatches < 1:
             raise ValueError("max_concurrent_dispatches must be >= 1")
+        if not 0.0 <= self.kv_admission_watermark < 1.0:
+            raise ValueError(
+                "kv_admission_watermark must be in [0, 1), got "
+                f"{self.kv_admission_watermark}"
+            )
+        for name in (
+            "max_waiting_requests",
+            "max_queued_tokens",
+            "default_deadline_ms",
+            "preempt_shed_threshold",
+        ):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"{name} must be >= 0 (0 disables), got "
+                    f"{getattr(self, name)}"
+                )
         if 1 < self.num_decode_steps and (
             self.fused_decode_steps() < self.num_decode_steps
         ):
@@ -380,6 +412,14 @@ class EngineArgs:
     warmup_decode: bool = False
     warmup_prefill: bool = False
 
+    # Overload resilience (None -> resolved late from the VDT_* env
+    # vars, so the knobs work on both the CLI and programmatic paths).
+    max_waiting_requests: int | None = None
+    max_queued_tokens: int | None = None
+    kv_admission_watermark: float | None = None
+    default_deadline_ms: int | None = None
+    preempt_shed_threshold: int | None = None
+
     # JSON dict (or dict) configuring a KV connector (disaggregated
     # prefill hook, SURVEY.md §3.4); None = off.
     kv_transfer_config: Any = None
@@ -476,6 +516,46 @@ class EngineArgs:
             dest="enable_chunked_prefill",
             action="store_false",
         )
+        parser.add_argument(
+            "--max-waiting-requests",
+            type=int,
+            default=None,
+            help="admission cap on waiting requests; excess rejected "
+            "with HTTP 429 (default: $VDT_MAX_WAITING_REQUESTS or "
+            "0 = unbounded)",
+        )
+        parser.add_argument(
+            "--max-queued-tokens",
+            type=int,
+            default=None,
+            help="admission cap on queued prompt tokens (default: "
+            "$VDT_MAX_QUEUED_TOKENS or 0 = unbounded)",
+        )
+        parser.add_argument(
+            "--kv-admission-watermark",
+            type=float,
+            default=None,
+            help="reject admission when the prompt's estimated KV page "
+            "demand would leave less than this fraction of pages free "
+            "(default: $VDT_KV_ADMISSION_WATERMARK or 0 = off)",
+        )
+        parser.add_argument(
+            "--default-deadline-ms",
+            type=int,
+            default=None,
+            help="server-default per-request deadline in ms; expired "
+            "requests are shed (waiting) or finish with "
+            'finish_reason="timeout" (running) (default: '
+            "$VDT_DEFAULT_DEADLINE_MS or 0 = none)",
+        )
+        parser.add_argument(
+            "--preempt-shed-threshold",
+            type=int,
+            default=None,
+            help="preemptions per request before it is shed with "
+            'finish_reason="overloaded" instead of recompute thrash '
+            "(default: $VDT_PREEMPT_SHED_THRESHOLD or 0 = off)",
+        )
         parser.add_argument("--device", type=str, default="auto")
         parser.add_argument("--profile-dir", type=str, default=None)
         parser.add_argument("--disable-log-stats", action="store_true")
@@ -540,6 +620,9 @@ class EngineArgs:
             host_id=self.host_id,
             coordinator_address=self.coordinator_address,
         )
+        def _env_default(value, env_name):
+            return getattr(envs, env_name) if value is None else value
+
         scheduler_config = SchedulerConfig(
             max_num_seqs=self.max_num_seqs,
             max_num_batched_tokens=max_batched,
@@ -549,6 +632,21 @@ class EngineArgs:
             max_concurrent_dispatches=self.max_concurrent_dispatches,
             warmup_decode=self.warmup_decode,
             warmup_prefill=self.warmup_prefill,
+            max_waiting_requests=_env_default(
+                self.max_waiting_requests, "VDT_MAX_WAITING_REQUESTS"
+            ),
+            max_queued_tokens=_env_default(
+                self.max_queued_tokens, "VDT_MAX_QUEUED_TOKENS"
+            ),
+            kv_admission_watermark=_env_default(
+                self.kv_admission_watermark, "VDT_KV_ADMISSION_WATERMARK"
+            ),
+            default_deadline_ms=_env_default(
+                self.default_deadline_ms, "VDT_DEFAULT_DEADLINE_MS"
+            ),
+            preempt_shed_threshold=_env_default(
+                self.preempt_shed_threshold, "VDT_PREEMPT_SHED_THRESHOLD"
+            ),
         )
         kv_transfer = self.kv_transfer_config
         if isinstance(kv_transfer, str):

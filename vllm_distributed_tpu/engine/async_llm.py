@@ -24,12 +24,23 @@ every queued/in-flight/new request gets a typed EngineDeadError.
 from __future__ import annotations
 
 import asyncio
+import json
+import os
 import queue as _queue
 import threading
+import time
 from typing import AsyncIterator
 
+from vllm_distributed_tpu import envs
 from vllm_distributed_tpu.config import EngineArgs, EngineConfig
 from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.engine.overload import (
+    DRAIN_DRAINED,
+    DRAIN_DRAINING,
+    AdmissionController,
+    EngineOverloadedError,
+    estimate_prompt_tokens,
+)
 from vllm_distributed_tpu.engine.supervisor import (
     EngineSupervisor,
     JournalEntry,
@@ -37,6 +48,7 @@ from vllm_distributed_tpu.engine.supervisor import (
 from vllm_distributed_tpu.logger import init_logger
 from vllm_distributed_tpu.outputs import RequestOutput
 from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.tracing import get_tracer
 
 logger = init_logger(__name__)
 
@@ -76,8 +88,12 @@ class AsyncLLM:
         # recovery replays.  Written on the event loop, snapshotted by
         # the supervisor on the engine thread after a flush barrier.
         self._journal: dict[str, JournalEntry] = {}
-        # Thread-safe intake: ("add", kwargs) / ("abort", request_id),
-        # applied by the engine thread between steps.
+        # Thread-safe intake: ("add", kwargs) / ("abort", request_id) /
+        # ("resume", JournalEntry) / ("aux", ...), applied by the engine
+        # thread between steps.  "add" producers are bounded by the
+        # AdmissionController caps; abort/aux are 1:1 with live HTTP
+        # handlers, which the server's connection limits bound.
+        # vdt-lint: disable=unbounded-queue — bound enforced at admission
         self._intake: _queue.SimpleQueue = _queue.SimpleQueue()
         self._wake = threading.Event()
         self._dead: BaseException | None = None
@@ -87,6 +103,18 @@ class AsyncLLM:
         self._phase = "boot"
         self.engine = LLMEngine(config)
         self.supervisor = EngineSupervisor(self)
+        # Overload resilience (ISSUE 8): bounded admission + drain state.
+        # Caps live in SchedulerConfig (default 0 = seed behavior).
+        self._admission = AdmissionController(
+            config.scheduler_config,
+            retry_after=envs.VDT_OVERLOAD_RETRY_AFTER_SECONDS,
+        )
+        self._admission.attach_scheduler(self.engine.scheduler)
+        self._drain_journal_path = envs.VDT_DRAIN_JOURNAL_PATH or None
+        # Requests journaled by a previous process's drain: re-admitted
+        # (with their emitted tokens restored) when a client re-attaches
+        # via generate() with the same request id.
+        self._resumable: dict[str, JournalEntry] = self._load_drain_journal()
         self._thread = threading.Thread(
             target=self._run_engine_loop, daemon=True, name="vdt-engine"
         )
@@ -105,6 +133,12 @@ class AsyncLLM:
             except _queue.Empty:
                 return
             if op == "add":
+                # The reservation moves from "intake-pending" to
+                # scheduler state (counted there) the moment the add is
+                # consumed — even on error, the tokens never reach the
+                # waiting queue.
+                est = payload.pop("_est_tokens", 0)
+                self._admission.consumed(est)
                 request_id = payload["request_id"]
                 entry = self._journal.get(request_id)
                 if entry is not None:
@@ -118,6 +152,17 @@ class AsyncLLM:
                     # on the request's own stream, preserving the type so
                     # the API layer can map e.g. ValueError -> 400.
                     self._to_request_queue(request_id, e)
+            elif op == "resume":
+                # Drain-journal replay (ISSUE 8): re-admit a request a
+                # previous process drained, with its delivered tokens
+                # restored as output state (preemption-resume
+                # semantics, engine/supervisor.py JournalEntry).
+                entry = payload
+                entry.admitted = True
+                try:
+                    entry.replay_into(self.engine)
+                except Exception as e:  # noqa: BLE001 — per-request error
+                    self._to_request_queue(entry.request_id, e)
             elif op == "aux":
                 # Auxiliary device work (embed/score) runs HERE so its
                 # collective dispatch is totally ordered with step
@@ -248,6 +293,10 @@ class AsyncLLM:
                 op, payload = self._intake.get_nowait()
             except _queue.Empty:
                 return
+            if op == "add":
+                # Release the admission reservation even when the loop
+                # is gone — the counters must not leak on shutdown.
+                self._admission.consumed(payload.pop("_est_tokens", 0))
             if self._loop is None:
                 continue
             try:
@@ -257,6 +306,8 @@ class AsyncLLM:
                     )
                 elif op == "add":
                     self._to_request_queue(payload["request_id"], error)
+                elif op == "resume":
+                    self._to_request_queue(payload.request_id, error)
             except RuntimeError:
                 return  # event loop already closed; nobody awaits
 
@@ -335,6 +386,45 @@ class AsyncLLM:
         if self.engine.errored:
             raise self._dead_error()
 
+    def _deadline_mono(self, params: SamplingParams) -> float | None:
+        """Effective deadline for journaling: the client's deadline_ms
+        or the server default, anchored now (the journal mirrors what
+        the engine will compute at add time)."""
+        ms = params.deadline_ms
+        if ms is None:
+            default = self.config.scheduler_config.default_deadline_ms
+            ms = default if default > 0 else None
+        return time.monotonic() + ms / 1000.0 if ms is not None else None
+
+    @property
+    def _journaling_enabled(self) -> bool:
+        """Journaling exists for replay: in-process recovery
+        (supervisor) or cross-process drain hand-off.  With neither
+        configured the per-output cumulative copies are skipped."""
+        if self._drain_journal_path:
+            return True
+        return self.supervisor.policy.max_restarts > 0 and getattr(
+            self.engine.executor, "supports_recovery", False
+        )
+
+    def check_admission(
+        self,
+        num_requests: int = 1,
+        est_tokens: int = 0,
+        prompt_token_ids: list[int] | None = None,
+    ) -> None:
+        """Pure admission pre-check for the HTTP layer (no
+        reservation): raises EngineOverloadedError so rejects become
+        429 responses before any SSE stream opens.  generate() runs the
+        authoritative reserving check."""
+        try:
+            self._admission.check(
+                num_requests, est_tokens, prompt_token_ids
+            )
+        except EngineOverloadedError as e:
+            self.engine.metrics.record_rejected(e.reason)
+            raise
+
     async def generate(
         self,
         request_id: str,
@@ -346,21 +436,48 @@ class AsyncLLM:
         """Feed a request and yield cumulative RequestOutputs until
         finished.  Cancellation (client disconnect) aborts the request.
         A request submitted while the engine is RECOVERING waits in the
-        intake and is admitted by the rebuilt engine."""
+        intake and is admitted by the rebuilt engine.  A request id
+        journaled by a previous process's drain resumes instead: the
+        journaled prompt/params are re-admitted with the already
+        delivered tokens restored, and outputs stay cumulative across
+        the hand-off."""
         if self._dead is not None or (
             self.engine.errored and not self._recovery_pending()
         ):
             raise self._dead_error()
         self._loop = asyncio.get_running_loop()
+        # Drain-journal resume: bypass admission caps — this is
+        # previously ADMITTED work being handed back (losing it would
+        # violate the drain contract), not new load.
+        resume_entry = self._resumable.pop(request_id, None)
+        est = 0
+        if resume_entry is None:
+            est = estimate_prompt_tokens(prompt, prompt_token_ids)
+            try:
+                # Bounded admission (ISSUE 8): caps + KV watermark +
+                # drain state.  Default-off knobs make this a single
+                # flag read in the seed configuration.
+                self._admission.reserve(est, prompt_token_ids)
+            except EngineOverloadedError as e:
+                self.engine.metrics.record_rejected(e.reason)
+                get_tracer().event(
+                    trace_ctx,
+                    "engine.rejected",
+                    request_id=request_id,
+                    reason=e.reason,
+                )
+                raise
+        # Drained by this handler's own iteration below; bounded by the
+        # request's max_tokens worth of outputs.
+        # vdt-lint: disable=unbounded-queue — consumer is this handler
         q: asyncio.Queue = asyncio.Queue()
         self._queues[request_id] = q
-        if self.supervisor.policy.max_restarts > 0 and getattr(
-            self.engine.executor, "supports_recovery", False
-        ):
-            # Journaling exists solely for replay; when recovery is
-            # disabled — or the executor can never produce a
-            # recoverable HostFailure (uniproc) — skip the per-output
-            # cumulative copies entirely.
+        if resume_entry is not None:
+            # Keep journaling the resumed request so a later drain (or
+            # recovery) can hand it off again.
+            self._journal[request_id] = resume_entry
+        elif self._journaling_enabled:
+            params = sampling_params or SamplingParams()
             self._journal[request_id] = JournalEntry(
                 request_id=request_id,
                 prompt=prompt,
@@ -369,28 +486,33 @@ class AsyncLLM:
                     if prompt_token_ids is not None
                     else None
                 ),
-                sampling_params=(
-                    sampling_params or SamplingParams()
-                ).clone(),
+                sampling_params=params.clone(),
                 trace_ctx=trace_ctx,
+                deadline_mono=self._deadline_mono(params),
             )
         try:
             if self._dead is not None:
                 # Raced the death after the check above: the fail-all
                 # sweep may have already run without seeing our queue.
+                if resume_entry is None:
+                    self._admission.release(est)
                 raise self._dead_error()
-            self._intake.put(
-                (
-                    "add",
-                    dict(
-                        request_id=request_id,
-                        prompt=prompt,
-                        prompt_token_ids=prompt_token_ids,
-                        sampling_params=sampling_params,
-                        trace_ctx=trace_ctx,
-                    ),
+            if resume_entry is not None:
+                self._intake.put(("resume", resume_entry))
+            else:
+                self._intake.put(
+                    (
+                        "add",
+                        dict(
+                            request_id=request_id,
+                            prompt=prompt,
+                            prompt_token_ids=prompt_token_ids,
+                            sampling_params=sampling_params,
+                            trace_ctx=trace_ctx,
+                            _est_tokens=est,
+                        ),
+                    )
                 )
-            )
             self._wake.set()
             if self._shutdown:
                 # Raced shutdown(): the engine thread's final sweep may
@@ -415,6 +537,148 @@ class AsyncLLM:
         self._wake.set()
         self._queues.pop(request_id, None)
         self._journal.pop(request_id, None)
+
+    # ---- graceful drain (ISSUE 8) ----
+    @property
+    def draining(self) -> bool:
+        return self._admission.draining
+
+    @property
+    def drain_state_name(self) -> str:
+        return self._admission.drain_state_name
+
+    def resumable_request_ids(self) -> list[str]:
+        """Request ids a previous process drained into the journal; a
+        router (ROADMAP item 1) re-drives each through generate() to
+        finish it here."""
+        return list(self._resumable)
+
+    async def drain(self, timeout: float | None = None) -> dict:
+        """Stop admission, let in-flight work finish for up to
+        ``timeout`` seconds, then journal what remains so a restarted
+        engine (or another replica) replays it with zero lost admitted
+        work — the hand-off primitive a multi-replica router calls
+        before taking this backend out of rotation (Llumnix,
+        PAPERS.md).
+
+        New requests 429 with reason="draining" from the moment this is
+        called; /health reports the drain state.  Requests still live
+        at the deadline are journaled to VDT_DRAIN_JOURNAL_PATH (when
+        set), then their streams are terminated with a typed
+        EngineOverloadedError and the engine-side work is aborted.
+        Idempotent: a second call just waits again."""
+        if timeout is None:
+            timeout = envs.VDT_DRAIN_TIMEOUT_SECONDS
+        t0 = time.monotonic()
+        self._admission.begin_drain()
+        self.engine.metrics.record_drain_state(DRAIN_DRAINING)
+        logger.warning(
+            "drain started: admission stopped, waiting up to %.1fs for "
+            "%d live request(s)",
+            timeout,
+            len(self._queues),
+        )
+        deadline = t0 + timeout
+        while time.monotonic() < deadline:
+            if self._dead is not None:
+                break
+            if (
+                not self.engine.has_unfinished_requests()
+                and self._admission.pending()[0] == 0
+                and not any(
+                    not e.finished for e in self._journal.values()
+                )
+            ):
+                break
+            await asyncio.sleep(0.05)
+        # Journal whatever is still live, then cut its streams.  The
+        # abort sweep covers EVERY live stream (journaling may be
+        # disabled); the journal covers what can be replayed.
+        leftover = [
+            e for e in self._journal.values() if not e.finished
+        ]
+        journaled = 0
+        if leftover and self._drain_journal_path:
+            journaled = self._write_drain_journal(leftover)
+        journaled_ids = {e.request_id for e in leftover} if journaled else set()
+        aborted = []
+        for request_id in list(self._queues):
+            aborted.append(request_id)
+            self._dispatch_item(
+                request_id,
+                EngineOverloadedError(
+                    "engine drained: request journaled for replay"
+                    if request_id in journaled_ids
+                    else "engine drained: request aborted",
+                    reason="draining",
+                    retry_after=envs.VDT_RETRY_AFTER_SECONDS,
+                ),
+            )
+        self._admission.finish_drain()
+        self.engine.metrics.record_drain_state(DRAIN_DRAINED)
+        result = {
+            "status": "drained",
+            "waited_s": round(time.monotonic() - t0, 3),
+            "journaled": journaled,
+            "aborted": len(aborted),
+            "journal_path": (
+                self._drain_journal_path if journaled else None
+            ),
+        }
+        logger.warning("drain finished: %s", result)
+        return result
+
+    def _write_drain_journal(self, entries: list[JournalEntry]) -> int:
+        """Persist unfinished requests for a future process.  Atomic
+        write (tmp + rename) so a crash mid-drain never leaves a
+        half-journal a restarted engine would trip over."""
+        payload = {
+            "version": 1,
+            "requests": [e.to_dict() for e in entries],
+        }
+        path = self._drain_journal_path
+        tmp = f"{path}.tmp"
+        # vdt-lint: disable=async-blocking — drain is a shutdown path,
+        # one small local write; the loop is not serving admissions.
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+        return len(entries)
+
+    def _load_drain_journal(self) -> dict[str, JournalEntry]:
+        """Boot-time pickup of a previous process's drain journal.  The
+        file is renamed away immediately so a crash loop can't replay
+        the same work twice."""
+        path = self._drain_journal_path
+        if not path or not os.path.exists(path):
+            return {}
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+            os.replace(path, f"{path}.consumed")
+        except (OSError, ValueError) as e:
+            logger.error("drain journal %s unreadable: %s", path, e)
+            return {}
+        entries = {}
+        for item in payload.get("requests", ()):
+            try:
+                entry = JournalEntry.from_dict(item)
+            except (KeyError, TypeError, ValueError) as e:
+                logger.error(
+                    "drain journal entry %r malformed: %s",
+                    item.get("request_id", "?"),
+                    e,
+                )
+                continue
+            entries[entry.request_id] = entry
+        if entries:
+            logger.warning(
+                "loaded drain journal %s: %d request(s) resumable via "
+                "generate() with the same request id",
+                path,
+                len(entries),
+            )
+        return entries
 
     async def embed(self, prompt_token_ids: list[int]) -> list[float]:
         """Runs on the engine thread between steps (_drain_intake), so

@@ -84,6 +84,14 @@ class PageAllocator:
     def get_page_ids(self, req_id: str) -> list[int]:
         return self._allocated.get(req_id, [])
 
+    def estimate_cached_tokens(
+        self, token_ids: list[int] | None
+    ) -> int:
+        """Admission-time estimate of how many of ``token_ids`` are
+        already resident as cached KV (ISSUE 8 KV backpressure).  The
+        base allocator caches nothing."""
+        return 0
+
     def slot_for_token(self, req: Request, token_idx: int) -> int:
         page = req.page_ids[token_idx // self.page_size]
         return page * self.page_size + token_idx % self.page_size
@@ -266,6 +274,29 @@ class PrefixCachingAllocator(PageAllocator):
         req.page_ids = owned
         # Registration resumes after the attached chain.
         self._reg[req.request_id] = len(hit_pages)
+
+    def estimate_cached_tokens(
+        self, token_ids: list[int] | None
+    ) -> int:
+        """Hash-walk the prompt's full pages against the content
+        registry WITHOUT touching ownership — the prefix-cache-aware
+        page estimate the admission watermark consults (ISSUE 8).
+
+        Called from the event loop while the engine thread mutates the
+        allocator: every access is a dict ``get`` (GIL-atomic, no
+        iteration), so the worst outcome of a race is a slightly stale
+        estimate — admission is a guardrail, not an allocation."""
+        if not token_ids:
+            return 0
+        ps = self.page_size
+        parent = b""
+        hit_pages = 0
+        for i in range(len(token_ids) // ps):
+            parent = hash_page_tokens(parent, token_ids[i * ps : (i + 1) * ps])
+            if self._hash_to_page.get(parent) is None:
+                break
+            hit_pages += 1
+        return hit_pages * ps
 
     def register_computed(self, req: Request) -> None:
         """Register every newly FULL page whose tokens are now computed

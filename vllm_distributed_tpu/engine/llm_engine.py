@@ -170,6 +170,9 @@ class LLMEngine:
             eos_token_id=eos,
             trace_ctx=trace_ctx,
         )
+        # Client deadline (deadline_ms param) or server default, anchored
+        # to the monotonic arrival instant; enforced at schedule time.
+        req.set_deadline(self.config.scheduler_config.default_deadline_ms)
         self.scheduler.add_request(req)
         if (
             sampling_params.detokenize
@@ -277,6 +280,10 @@ class LLMEngine:
             self.metrics.record_pipeline_break()
             outputs.extend(self._drain_pending())
         scheduler_output = self._schedule()
+        # Deadline sheds and preempt-to-sheds finish OUTSIDE
+        # update_from_output; emit their final (partial) outputs now so
+        # clients see finish_reason="timeout"/"overloaded" promptly.
+        outputs.extend(self._finish_out_of_band())
         if scheduler_output.is_empty:
             # Typically every request's remaining budget is in flight:
             # block on the HEAD dispatch only, so tokens keep streaming
@@ -296,6 +303,31 @@ class LLMEngine:
         outputs.extend(self._drain_pending())
         runner_output = self.executor.execute_model(scheduler_output)
         outputs.extend(self._process(scheduler_output, runner_output))
+        return outputs
+
+    def _finish_out_of_band(self) -> list[RequestOutput]:
+        """Final outputs for requests the scheduler finished outside
+        update_from_output (deadline sheds, preempt-to-shed, ISSUE 8):
+        partial tokens/text, finish_reason from the status, metrics and
+        spans recorded like any other finish."""
+        reqs = self.scheduler.take_finished_out_of_band()
+        if not reqs:
+            return []
+        now = time.time()
+        now_mono = time.monotonic()
+        outputs: list[RequestOutput] = []
+        for req in reqs:
+            req.metrics.finished_time = now
+            req.metrics.finished_time_mono = now_mono
+            if self.tracer.enabled:
+                self._record_request_spans(req, now_mono, True)
+            detok = self.detokenizers.pop(req.request_id, None)
+            outputs.append(self._make_output(req, detok))
+            self.metrics.record_finished(
+                req.metrics, FINISH_REASON.get(req.status)
+            )
+            if self.config.kv_transfer_config is not None:
+                self.executor.kv_output_aggregator.forget(req.request_id)
         return outputs
 
     def _schedule(self):
@@ -395,7 +427,9 @@ class LLMEngine:
         now = time.time()
         now_mono = time.monotonic()
         self.metrics.record_queues(
-            len(self.scheduler.running), len(self.scheduler.waiting)
+            len(self.scheduler.running),
+            len(self.scheduler.waiting),
+            self.scheduler.num_waiting_tokens,
         )
         self.metrics.record_preemptions(
             self.scheduler.num_preemptions - self._preemptions_seen

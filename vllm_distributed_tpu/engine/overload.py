@@ -1,0 +1,270 @@
+"""Overload resilience: bounded admission + KV backpressure + drain
+state (ISSUE 8 tentpole).
+
+The engine before this module accepted unbounded work: the scheduler's
+waiting deque and the AsyncLLM intake grew without limit, and nothing
+shed load before HBM pages or the API process fell over.  vLLM (Kwon et
+al. 2023) treats KV watermarks and preempt-to-recompute as first-class
+admission signals; Llumnix (Sun et al. 2024) shows that a *drain*
+primitive — stop admitting, finish or hand off in-flight work — is the
+building block for multi-replica routing and live migration.  This
+module is the admission side of both.
+
+``AdmissionController`` runs on the event loop (called from
+``AsyncLLM.generate`` before anything is enqueued) and answers one
+question cheaply: *may this request enter the building?*  It consults
+
+- its own pending counters (adds accepted but not yet consumed by the
+  engine thread's intake drain),
+- the scheduler's waiting-queue snapshot (`len()` and an integer token
+  counter — both single reads, safe under the GIL against the engine
+  thread's mutations),
+- the allocator's free-page count against a configurable watermark,
+  with a prefix-cache-aware estimate of the prompt's page demand, and
+- the drain state.
+
+Every reject raises a typed ``EngineOverloadedError`` carrying the
+machine-readable reason — the HTTP layer maps it to 429 + Retry-After,
+*distinct* from the PR 2/3 ``EngineDeadError``/``EngineRecoveringError``
+503 states: overload clears in inter-token time, a dead engine in
+restart time, and load balancers must tell them apart.
+
+All checks are **default-off**: with every cap at 0 the controller's
+fast path is a single drain-flag read and the seed behavior is
+byte-for-byte unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.utils import cdiv
+
+logger = init_logger(__name__)
+
+# Drain states surfaced by /health and the vllm:engine_drain_state
+# gauge.
+DRAIN_SERVING = 0
+DRAIN_DRAINING = 1
+DRAIN_DRAINED = 2
+
+DRAIN_STATE_NAMES = {
+    DRAIN_SERVING: "serving",
+    DRAIN_DRAINING: "draining",
+    DRAIN_DRAINED: "drained",
+}
+
+
+class EngineOverloadedError(RuntimeError):
+    """The engine is shedding load: admission was rejected (or an
+    admitted request was shed) because a configured cap, watermark, or
+    the drain state says accepting it would make things worse.  Maps to
+    HTTP 429 + Retry-After.  ``reason`` is machine-readable:
+    queue_full | queued_tokens | kv_pressure | draining | overloaded.
+    """
+
+    def __init__(
+        self, message: str, reason: str = "overloaded", retry_after: int = 1
+    ) -> None:
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after = retry_after
+
+
+class AdmissionController:
+    """Bounded admission for one AsyncLLM.  Event-loop side owns
+    reserve/release; the engine thread calls ``consumed`` when an add
+    leaves the intake.  Counters use a lock (cheap: admission is
+    per-request, not per-token) so reserve/consumed interleavings can't
+    lose a decrement."""
+
+    def __init__(self, scheduler_config, retry_after: int = 1) -> None:
+        self.config = scheduler_config
+        self.retry_after = retry_after
+        self._lock = threading.Lock()
+        # Adds accepted by reserve() but not yet consumed by the engine
+        # thread's intake drain (the scheduler can't see them yet).
+        self._pending_requests = 0
+        self._pending_tokens = 0
+        self._drain_state = DRAIN_SERVING
+        # Bound by the engine thread after boot; None while unwired
+        # (checks degrade to caps-only, no scheduler snapshot).
+        self._scheduler = None
+
+    # ---- wiring ----
+    def attach_scheduler(self, scheduler) -> None:
+        """Point the controller at the (possibly rebuilt) scheduler.
+        Reads of its waiting len / token counter / allocator free count
+        are single attribute+int reads, GIL-atomic against the engine
+        thread."""
+        self._scheduler = scheduler
+
+    # ---- drain state ----
+    @property
+    def drain_state(self) -> int:
+        return self._drain_state
+
+    @property
+    def drain_state_name(self) -> str:
+        return DRAIN_STATE_NAMES[self._drain_state]
+
+    @property
+    def draining(self) -> bool:
+        return self._drain_state != DRAIN_SERVING
+
+    def begin_drain(self) -> None:
+        self._drain_state = DRAIN_DRAINING
+
+    def finish_drain(self) -> None:
+        self._drain_state = DRAIN_DRAINED
+
+    # ---- admission ----
+    def _overloaded(self, reason: str, detail: str) -> EngineOverloadedError:
+        return EngineOverloadedError(
+            f"engine overloaded ({reason}): {detail}",
+            reason=reason,
+            retry_after=self.retry_after,
+        )
+
+    def pending(self) -> tuple[int, int]:
+        with self._lock:
+            return self._pending_requests, self._pending_tokens
+
+    def queue_depth(self) -> int:
+        """Admission-queue depth: scheduler waiting + intake pending."""
+        sched = self._scheduler
+        waiting = len(sched.waiting) if sched is not None else 0
+        return waiting + self._pending_requests
+
+    def queued_tokens(self) -> int:
+        sched = self._scheduler
+        base = sched.num_waiting_tokens if sched is not None else 0
+        return base + self._pending_tokens
+
+    def _check(
+        self,
+        num_requests: int,
+        est_tokens: int,
+        prompt_token_ids: list[int] | None = None,
+    ) -> EngineOverloadedError | None:
+        """The decision, caps-first (cheapest signals first).  Returns
+        the reject to raise, or None to admit."""
+        if self.draining:
+            return self._overloaded(
+                "draining",
+                "engine is draining; not admitting new requests",
+            )
+        cfg = self.config
+        if cfg.max_waiting_requests > 0:
+            depth = self.queue_depth()
+            if depth + num_requests > cfg.max_waiting_requests:
+                return self._overloaded(
+                    "queue_full",
+                    f"admission queue holds {depth} request(s), cap is "
+                    f"{cfg.max_waiting_requests}",
+                )
+        if cfg.max_queued_tokens > 0:
+            queued = self.queued_tokens()
+            if queued + est_tokens > cfg.max_queued_tokens:
+                return self._overloaded(
+                    "queued_tokens",
+                    f"{queued} prompt token(s) queued, cap is "
+                    f"{cfg.max_queued_tokens}",
+                )
+        if cfg.kv_admission_watermark > 0.0:
+            err = self._check_kv(
+                num_requests, est_tokens, prompt_token_ids
+            )
+            if err is not None:
+                return err
+        return None
+
+    def _check_kv(
+        self,
+        num_requests: int,
+        est_tokens: int,
+        prompt_token_ids: list[int] | None,
+    ) -> EngineOverloadedError | None:
+        """Free-page watermark: would admitting this work leave less
+        than the watermark fraction of usable pages free?  The estimate
+        is prefix-cache-aware — tokens already resident as cached pages
+        cost nothing to admit.  ``est_tokens`` is the TOTAL over
+        ``num_requests`` sequences (n>1 choices each allocate their own
+        pages, sharing nothing but a possible cached prefix)."""
+        sched = self._scheduler
+        if sched is None:
+            return None
+        alloc = sched.allocator
+        usable = alloc.num_pages - 1  # page 0 reserved
+        if usable <= 0:
+            return None
+        n = max(num_requests, 1)
+        per_req = est_tokens // n
+        cached = alloc.estimate_cached_tokens(prompt_token_ids)
+        if cached:
+            per_req = max(per_req - cached, 0)
+        # +1 page per sequence: the first sampled token needs a slot.
+        est_pages = n * (cdiv(per_req, alloc.page_size) + 1)
+        floor = int(self.config.kv_admission_watermark * usable)
+        if alloc.num_free_pages - est_pages < floor:
+            return self._overloaded(
+                "kv_pressure",
+                f"{n} sequence(s) need ~{est_pages} KV page(s) but "
+                f"only {alloc.num_free_pages}/{usable} are free "
+                f"(watermark keeps {floor} free)",
+            )
+        return None
+
+    def check(
+        self,
+        num_requests: int = 1,
+        est_tokens: int = 0,
+        prompt_token_ids: list[int] | None = None,
+    ) -> None:
+        """Pure check (no reservation) — the HTTP layer calls this
+        before opening an SSE stream so rejects become proper 429
+        responses, not in-stream error frames."""
+        err = self._check(num_requests, est_tokens, prompt_token_ids)
+        if err is not None:
+            raise err
+
+    def reserve(
+        self,
+        est_tokens: int,
+        prompt_token_ids: list[int] | None = None,
+    ) -> None:
+        """Authoritative admit for ONE request: re-checks the caps and
+        reserves intake-pending capacity.  The reservation is released
+        by ``consumed`` (engine thread drained the add) or ``release``
+        (the add never reached the intake)."""
+        err = self._check(1, est_tokens, prompt_token_ids)
+        if err is not None:
+            raise err
+        with self._lock:
+            self._pending_requests += 1
+            self._pending_tokens += est_tokens
+
+    def consumed(self, est_tokens: int) -> None:
+        """Engine thread: one reserved add left the intake (it is now
+        scheduler state, counted there)."""
+        self.release(est_tokens)
+
+    def release(self, est_tokens: int) -> None:
+        with self._lock:
+            self._pending_requests = max(self._pending_requests - 1, 0)
+            self._pending_tokens = max(self._pending_tokens - est_tokens, 0)
+
+
+def estimate_prompt_tokens(
+    prompt: str | None, prompt_token_ids: list[int] | None
+) -> int:
+    """Admission-time token estimate.  Exact when ids are in hand (the
+    API layer tokenizes first); a ~4-chars-per-token heuristic for raw
+    text (only the offline/programmatic path) — caps are load-shedding
+    guardrails, not billing, so an estimate is fine."""
+    if prompt_token_ids is not None:
+        return len(prompt_token_ids)
+    if prompt:
+        return len(prompt) // 4 + 1
+    return 1

@@ -17,6 +17,13 @@ class RequestStatus(enum.Enum):
     FINISHED_STOPPED = enum.auto()
     FINISHED_LENGTH = enum.auto()
     FINISHED_ABORTED = enum.auto()
+    # Deadline expired: shed from waiting before prefill, or stopped
+    # mid-decode with partial output (ISSUE 8).
+    FINISHED_TIMEOUT = enum.auto()
+    # Shed by the sustained-pressure preempt-to-shed policy: repeated
+    # preemption under load degrades to a rejection, not allocator
+    # thrash (ISSUE 8).
+    FINISHED_SHED = enum.auto()
 
     @property
     def is_finished(self) -> bool:
@@ -24,6 +31,8 @@ class RequestStatus(enum.Enum):
             RequestStatus.FINISHED_STOPPED,
             RequestStatus.FINISHED_LENGTH,
             RequestStatus.FINISHED_ABORTED,
+            RequestStatus.FINISHED_TIMEOUT,
+            RequestStatus.FINISHED_SHED,
         )
 
 
@@ -31,6 +40,8 @@ FINISH_REASON = {
     RequestStatus.FINISHED_STOPPED: "stop",
     RequestStatus.FINISHED_LENGTH: "length",
     RequestStatus.FINISHED_ABORTED: "abort",
+    RequestStatus.FINISHED_TIMEOUT: "timeout",
+    RequestStatus.FINISHED_SHED: "overloaded",
 }
 
 
@@ -66,6 +77,14 @@ class Request:
     # engine parents this request's queue/prefill/decode spans and
     # preemption/replay events to it.  None = untraced.
     trace_ctx: tuple | None = None
+    # Monotonic instant this request must be finished by (ISSUE 8);
+    # None = no deadline.  Set by LLMEngine.add_request from the
+    # client's deadline_ms or the server default; checked cheaply at
+    # schedule time.
+    deadline_mono: float | None = None
+    # Times this request was preempted (the preempt-to-shed policy's
+    # thrash signal).
+    num_preemptions: int = 0
 
     def __post_init__(self) -> None:
         self.metrics.arrival_time = time.time()
@@ -105,6 +124,20 @@ class Request:
         if mt is None:
             return 1 << 60
         return self.num_prompt_tokens + mt
+
+    def set_deadline(self, default_deadline_ms: int) -> None:
+        """Resolve the effective deadline: the client's deadline_ms
+        sampling param, else the server default (0 = none).  Anchored
+        to the monotonic arrival instant so NTP steps can't expire (or
+        resurrect) a request."""
+        ms = self.sampling_params.deadline_ms
+        if ms is None and default_deadline_ms > 0:
+            ms = default_deadline_ms
+        if ms is not None:
+            self.deadline_mono = self.arrival_time + ms / 1000.0
+
+    def expired(self, now_mono: float) -> bool:
+        return self.deadline_mono is not None and now_mono >= self.deadline_mono
 
     def append_output_token(self, token_id: int) -> None:
         self.output_token_ids.append(token_id)

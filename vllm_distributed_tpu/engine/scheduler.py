@@ -106,7 +106,16 @@ class Scheduler:
             else PageAllocator
         )
         self.allocator = alloc_cls(num_pages, cache_config.page_size)
+        # Bounded upstream by the AdmissionController caps when
+        # configured (engine/overload.py); unbounded growth is the
+        # operator's explicit choice via max_waiting_requests=0.
+        # vdt-lint: disable=unbounded-queue — bound enforced at admission
         self.waiting: deque[Request] = deque()
+        # Prompt tokens awaiting (re-)prefill across self.waiting — an
+        # integer mirror maintained at every waiting mutation so the
+        # event-loop admission check reads one int instead of iterating
+        # a deque the engine thread mutates (ISSUE 8).
+        self.num_waiting_tokens = 0
         self.running: list[Request] = []
         self.requests: dict[str, Request] = {}
         self._step_id = 0
@@ -131,6 +140,37 @@ class Scheduler:
         # eligible for lookup at admission vs tokens served from cache.
         self.prefix_cache_queries = 0
         self.prefix_cache_hits = 0
+        # Requests finished OUTSIDE update_from_output (deadline sheds,
+        # preempt-to-shed): the engine drains this after each schedule
+        # and emits their final RequestOutputs (ISSUE 8).
+        self._finished_out_of_band: list[Request] = []
+        # Cumulative overload counters (metrics).
+        self.num_timeouts = 0
+        self.num_sheds = 0
+        # True while any live request carries a deadline (sticky; reset
+        # when the scheduler empties) so deadline enforcement costs one
+        # attribute read per step when unused.
+        self._has_deadlines = False
+
+    # ---- waiting-queue mutation (ALL of it goes through these three
+    # helpers so num_waiting_tokens can never drift from the deque) ----
+    def _waiting_push(self, req: Request, left: bool = False) -> None:
+        if left:
+            self.waiting.appendleft(req)
+        else:
+            self.waiting.append(req)
+        self.num_waiting_tokens += req.prefill_target - req.num_computed_tokens
+
+    def _waiting_pop(self, req: Request, popleft: bool = False) -> None:
+        if popleft:
+            self.waiting.popleft()
+        else:
+            self.waiting.remove(req)
+        self.num_waiting_tokens = max(
+            self.num_waiting_tokens
+            - (req.prefill_target - req.num_computed_tokens),
+            0,
+        )
 
     # ---- intake ----
     def add_request(self, req: Request) -> None:
@@ -164,7 +204,9 @@ class Scheduler:
                 f"{self.config.max_num_batched_tokens}"
             )
         self.requests[req.request_id] = req
-        self.waiting.append(req)
+        if req.deadline_mono is not None:
+            self._has_deadlines = True
+        self._waiting_push(req)
 
     def abort_request(self, req_id: str) -> None:
         req = self.requests.get(req_id)
@@ -175,7 +217,7 @@ class Scheduler:
             self.running.remove(req)
             self._finished_since_last.append(req_id)
         elif req in self.waiting:
-            self.waiting.remove(req)
+            self._waiting_pop(req)
         self._release_or_defer(req)
         del self.requests[req_id]
 
@@ -203,8 +245,63 @@ class Scheduler:
     def has_unfinished_requests(self) -> bool:
         return self.num_unfinished > 0
 
+    # ---- deadlines + load shedding (ISSUE 8) ----
+    def _shed_expired(self, now_mono: float) -> None:
+        """Enforce per-request deadlines at schedule time (the cheap
+        place: one monotonic read, two short scans).  Expired WAITING
+        requests are shed before any prefill is spent on them — the
+        workers never knew them (or already dropped them at preemption),
+        so no notice is emitted.  Expired RUNNING requests finish with
+        finish_reason="timeout" and whatever partial output they have;
+        their finish notice rides this step's output like any other
+        finish."""
+        for req in [r for r in self.waiting if r.expired(now_mono)]:
+            self._waiting_pop(req)
+            req.status = RequestStatus.FINISHED_TIMEOUT
+            self._release_or_defer(req)
+            del self.requests[req.request_id]
+            self._finished_out_of_band.append(req)
+            self.num_timeouts += 1
+            get_tracer().event(
+                req.trace_ctx,
+                "engine.deadline_shed",
+                request_id=req.request_id,
+                stage="waiting",
+            )
+        for req in [r for r in self.running if r.expired(now_mono)]:
+            self.running.remove(req)
+            req.status = RequestStatus.FINISHED_TIMEOUT
+            self._finished_since_last.append(req.request_id)
+            self._release_or_defer(req)
+            del self.requests[req.request_id]
+            self._finished_out_of_band.append(req)
+            self.num_timeouts += 1
+            get_tracer().event(
+                req.trace_ctx,
+                "engine.deadline_shed",
+                request_id=req.request_id,
+                stage="running",
+                num_output_tokens=req.num_output_tokens,
+            )
+
+    def take_finished_out_of_band(self) -> list[Request]:
+        """Drain requests finished outside update_from_output (deadline
+        sheds, preempt-to-shed) so the engine can emit their final
+        outputs."""
+        if not self._finished_out_of_band:
+            return []
+        out, self._finished_out_of_band = self._finished_out_of_band, []
+        return out
+
     # ---- the step ----
     def schedule(self) -> SchedulerOutput:
+        # Sticky flag, not a per-step scan: with no deadlines anywhere
+        # (the default) this is one attribute read per step.
+        if self._has_deadlines:
+            if self.requests:
+                self._shed_expired(time.monotonic())
+            else:
+                self._has_deadlines = False
         out = SchedulerOutput(step_id=self._step_id)
         self._step_id += 1
         out.finished_req_ids = self._finished_since_last
@@ -333,7 +430,7 @@ class Scheduler:
                 ok = self.allocator.can_allocate(req, num_new)
             if not ok:
                 break
-            self.waiting.popleft()
+            self._waiting_pop(req, popleft=True)
             if self.enable_prefix_caching:
                 self.prefix_cache_queries += req.prefill_target
                 self.prefix_cache_hits += hit_tokens
@@ -426,6 +523,7 @@ class Scheduler:
     def _preempt(self, req: Request, preempted: set[str]) -> None:
         logger.debug("preempting request %s", req.request_id)
         self.num_preemptions += 1
+        req.num_preemptions += 1
         get_tracer().event(
             req.trace_ctx,
             "engine.preempted",
@@ -433,7 +531,6 @@ class Scheduler:
             num_tokens=req.num_tokens,
         )
         self.allocator.free(req)
-        req.status = RequestStatus.PREEMPTED
         req.num_computed_tokens = 0
         # In-flight sampled tokens are lost on preemption; the request
         # re-prefills to what the host has and regenerates (same PRNG
@@ -446,7 +543,28 @@ class Scheduler:
         # no entry in _finished_since_last (it would collide with the
         # request's own resume in a later step's new_requests).
         preempted.add(req.request_id)
-        self.waiting.appendleft(req)
+        shed_after = self.config.preempt_shed_threshold
+        if shed_after > 0 and req.num_preemptions > shed_after:
+            # Sustained-pressure preempt-to-shed (ISSUE 8): this request
+            # has been evicted-and-recomputed past the policy budget —
+            # another resume would just thrash the allocator.  Degrade
+            # to a rejection: finish with finish_reason="overloaded"
+            # and partial output instead of re-queueing.  The worker
+            # drop-notice already rides preempted_req_ids above.
+            req.status = RequestStatus.FINISHED_SHED
+            self.requests.pop(req.request_id, None)
+            self._finished_out_of_band.append(req)
+            self.num_sheds += 1
+            get_tracer().event(
+                req.trace_ctx,
+                "engine.preempt_shed",
+                request_id=req.request_id,
+                num_preemptions=req.num_preemptions,
+                num_output_tokens=req.num_output_tokens,
+            )
+            return
+        req.status = RequestStatus.PREEMPTED
+        self._waiting_push(req, left=True)
 
     # ---- post-step bookkeeping ----
     def update_from_output(
@@ -501,6 +619,6 @@ class Scheduler:
             self.running.remove(req)
             self._finished_since_last.append(req.request_id)
         if req in self.waiting:
-            self.waiting.remove(req)
+            self._waiting_pop(req)
         self._release_or_defer(req)
         self.requests.pop(req.request_id, None)

@@ -33,6 +33,7 @@ the pre-supervisor terminal-death behavior (typed ``EngineDeadError``,
 
 from __future__ import annotations
 
+import dataclasses
 import math
 import threading
 import time
@@ -42,11 +43,43 @@ from dataclasses import dataclass, field
 from vllm_distributed_tpu import envs
 from vllm_distributed_tpu.engine.request import RequestStatus
 from vllm_distributed_tpu.logger import init_logger
-from vllm_distributed_tpu.outputs import RequestOutput
+from vllm_distributed_tpu.outputs import CompletionOutput, RequestOutput
 from vllm_distributed_tpu.sampling_params import SamplingParams
 from vllm_distributed_tpu.tracing import get_tracer
 
 logger = init_logger(__name__)
+
+
+def _timeout_output(entry: "JournalEntry", engine) -> RequestOutput:
+    """The finished output an expired journal entry's client receives
+    instead of a replay: whatever was already delivered, closed with
+    finish_reason="timeout".  Text is re-decoded whole from the emitted
+    tokens (the journal keeps tokens, not text) — best-effort parity
+    with the in-engine timeout path's partial text for non-streaming
+    clients; streaming clients already received the incremental text."""
+    text = ""
+    tokenizer = getattr(engine, "tokenizer", None)
+    if tokenizer is not None and entry.emitted_token_ids:
+        try:
+            text = tokenizer.decode(entry.emitted_token_ids)
+        except Exception:  # noqa: BLE001 — text is best-effort here
+            logger.exception(
+                "decoding expired entry %s failed", entry.request_id
+            )
+    return RequestOutput(
+        request_id=entry.request_id,
+        prompt=entry.prompt,
+        prompt_token_ids=list(entry.prompt_token_ids or ()),
+        outputs=[
+            CompletionOutput(
+                index=0,
+                text=text,
+                token_ids=list(entry.emitted_token_ids),
+                finish_reason="timeout",
+            )
+        ],
+        finished=True,
+    )
 
 
 @dataclass
@@ -74,6 +107,13 @@ class JournalEntry:
     # Root trace context (tracing.py): the replayed request keeps
     # tracing into the same trace, and the replay itself is an event.
     trace_ctx: tuple | None = None
+    # Monotonic deadline mirrored from the engine's (ISSUE 8): an
+    # already-expired request is never replayed — the supervisor
+    # synthesizes its timeout finish instead of re-prefilling work the
+    # client has given up on.  Not persisted across processes
+    # (monotonic clocks don't transfer); drain-journal resumes get a
+    # fresh deadline from the new engine's default.
+    deadline_mono: float | None = None
 
     def observe(self, out: RequestOutput) -> None:
         """Record one cumulative output about to be handed to the
@@ -126,9 +166,14 @@ class JournalEntry:
             sampling_params=self.sampling_params.clone(),
             trace_ctx=self.trace_ctx,
         )
+        req = engine.scheduler.requests[self.request_id]
+        if self.deadline_mono is not None:
+            # The ORIGINAL deadline survives the replay: recovery must
+            # not grant a request more wall-clock than an uninterrupted
+            # run would have.
+            req.deadline_mono = self.deadline_mono
         if not self.emitted_token_ids:
             return
-        req = engine.scheduler.requests[self.request_id]
         req.output_token_ids.extend(self.emitted_token_ids)
         req.resume_target = req.num_tokens
         # PREEMPTED makes admission resend prompt+outputs with the true
@@ -142,6 +187,48 @@ class JournalEntry:
             # Pre-feed the delivered tokens so post-recovery text stays
             # cumulative and stop strings spanning the blip still match.
             detok.append(list(self.emitted_token_ids))
+
+    # ---- drain-journal persistence (ISSUE 8) ----
+    def to_dict(self) -> dict:
+        """JSON-serializable form for the cross-process drain journal.
+        deadline_mono is deliberately dropped (monotonic clocks don't
+        transfer between processes; the resuming engine applies its own
+        default)."""
+        return {
+            "request_id": self.request_id,
+            "prompt": self.prompt,
+            "prompt_token_ids": self.prompt_token_ids,
+            "sampling_params": dataclasses.asdict(self.sampling_params),
+            "emitted_token_ids": list(self.emitted_token_ids),
+            "emitted_logprobs": (
+                [
+                    {str(k): v for k, v in lp.items()}
+                    for lp in self.emitted_logprobs
+                ]
+                if self.emitted_logprobs is not None
+                else None
+            ),
+            "emitted_cumulative_logprob": self.emitted_cumulative_logprob,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "JournalEntry":
+        lps = d.get("emitted_logprobs")
+        return cls(
+            request_id=d["request_id"],
+            prompt=d.get("prompt"),
+            prompt_token_ids=d.get("prompt_token_ids"),
+            sampling_params=SamplingParams(**d["sampling_params"]),
+            emitted_token_ids=list(d.get("emitted_token_ids", ())),
+            emitted_logprobs=(
+                [{int(k): v for k, v in lp.items()} for lp in lps]
+                if lps is not None
+                else None
+            ),
+            emitted_cumulative_logprob=d.get(
+                "emitted_cumulative_logprob", 0.0
+            ),
+        )
 
 
 @dataclass
@@ -177,6 +264,8 @@ class EngineSupervisor:
         self.recovering = False
         self.last_failure = None  # originating HostFailure of the cycle
         self.restarts_total = 0
+        # vdt-lint: disable=unbounded-queue — pruned to the crash-loop
+        # window on every use; length is bounded by max_restarts + 1
         self._restart_times: deque[float] = deque()
         # Guards _restart_times: can_recover is called from the event
         # loop (health checks, generate admission) while recover()
@@ -294,6 +383,9 @@ class EngineSupervisor:
                         )
                     return False
                 llm.engine = new_engine
+                # Admission reads scheduler state; point it at the
+                # rebuilt scheduler before traffic resumes.
+                llm._admission.attach_scheduler(new_engine.scheduler)
                 replayed = self._replay(new_engine)
                 metrics.record_engine_recovered()
                 metrics.record_replayed(replayed)
@@ -342,11 +434,28 @@ class EngineSupervisor:
         recovering."""
         llm = self.async_llm
         replayed = 0
+        now = time.monotonic()
         for entry in list(llm._journal.values()):
             if entry.finished or not entry.admitted:
                 # finished: final output already delivered.  not
                 # admitted: the "add" op still sits in the intake and
                 # will reach this engine through the normal drain.
+                continue
+            if entry.deadline_mono is not None and now >= entry.deadline_mono:
+                # Never replay an already-expired request (ISSUE 8):
+                # re-prefilling work the deadline killed would spend
+                # recovery time on output nobody waits for.  Deliver
+                # the timeout finish the engine would have produced.
+                entry.finished = True
+                llm._to_request_queue(
+                    entry.request_id, _timeout_output(entry, engine)
+                )
+                get_tracer().event(
+                    entry.trace_ctx,
+                    "engine.replay_expired",
+                    request_id=entry.request_id,
+                    emitted_tokens=len(entry.emitted_token_ids),
+                )
                 continue
             try:
                 entry.replay_into(engine)

@@ -90,6 +90,22 @@ def make_parser() -> argparse.ArgumentParser:
     # `vllm bench serve`, launch.py:21-25) — engine args unused.
     bench.add_argument("--url", default="http://localhost:8000")
     bench.add_argument("--concurrency", type=int, default=8)
+    bench.add_argument(
+        "--request-rate",
+        type=float,
+        default=None,
+        help="serve mode: OPEN-LOOP Poisson arrivals at this rate "
+        "(req/s) instead of closed-loop concurrency — set it above "
+        "capacity to measure overload shedding; rejected (429) and "
+        "timed-out requests are accounted separately and never "
+        "pollute the latency percentiles",
+    )
+    bench.add_argument(
+        "--deadline-ms",
+        type=int,
+        default=None,
+        help="serve mode: per-request deadline sent with every request",
+    )
     EngineArgs.add_cli_args(bench)
 
     sub.add_parser("collect-env", help="print environment diagnostics")
@@ -165,8 +181,49 @@ async def _serve_async(args: argparse.Namespace) -> None:
         ssl_certfile=args.ssl_certfile,
         ssl_keyfile=args.ssl_keyfile,
     )
+    # Graceful drain on SIGTERM (ISSUE 8): stop admission (429 + drain
+    # state in /health), let in-flight requests finish under the drain
+    # deadline, journal the rest to VDT_DRAIN_JOURNAL_PATH for the
+    # restarted process to replay, THEN exit.  A second SIGTERM (or
+    # SIGINT) skips the wait.
+    stop = asyncio.Event()
+    sigterm_seen = False
+
+    def _on_sigterm() -> None:
+        nonlocal sigterm_seen
+        if stop.is_set():
+            return
+        if sigterm_seen:
+            stop.set()  # second signal: exit now
+            return
+        sigterm_seen = True
+
+        async def _drain_and_stop() -> None:
+            try:
+                if state.engine.draining:
+                    # An HTTP-initiated /drain is already in progress
+                    # (or finished): wait it out instead of re-draining
+                    # — stopping now would cancel its journal write.
+                    while state.engine.drain_state_name == "draining":
+                        await asyncio.sleep(0.1)
+                else:
+                    await state.engine.drain()
+            except Exception:  # noqa: BLE001 — drain is best-effort
+                logger.exception("drain on SIGTERM failed")
+            finally:
+                stop.set()
+
+        logger.warning("SIGTERM: draining before shutdown")
+        asyncio.get_running_loop().create_task(_drain_and_stop())
+
+    import signal
+
     try:
-        await asyncio.Event().wait()  # serve until killed
+        loop.add_signal_handler(signal.SIGTERM, _on_sigterm)
+    except (NotImplementedError, RuntimeError):
+        pass  # non-unix platforms / nested loops: plain kill semantics
+    try:
+        await stop.wait()  # serve until drained + stopped (or killed)
     finally:
         await runner.cleanup()
         engine.shutdown()
@@ -206,6 +263,11 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
     ttfts: list[float] = []
     itls: list[float] = []
     out_tokens = 0
+    # Overload accounting (ISSUE 8): sheds are OUTCOMES, not latency
+    # samples — a 429'd or timed-out request must never pollute the
+    # percentiles of the requests the server actually served.
+    request_rate = getattr(args, "request_rate", None)
+    counts = {"completed": 0, "rejected": 0, "timed_out": 0, "errors": 0}
 
     async def scrape_metrics(session) -> dict:
         try:
@@ -220,6 +282,7 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
             "vllm:time_per_output_token_seconds_count",
             "vllm:generation_tokens_total",
             "vllm:pipeline_breaks_total",
+            "vllm:requests_rejected_total",
         }
         out = {}
         for line in text.splitlines():
@@ -231,7 +294,7 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                 out[key] = out.get(key, 0.0) + float(parts[1])
         return out
 
-    async def one(session, i: int) -> None:
+    async def drive_one(session, i: int) -> None:
         nonlocal out_tokens
         prompt = [(13 * i + j) % 900 + 1 for j in range(args.input_len)]
         body = {
@@ -246,13 +309,22 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
             # errored stream must not overstate throughput).
             "stream_options": {"include_usage": True},
         }
-        async with sem:
-            t0 = time.perf_counter()
-            chunk_times: list[float] = []
-            got_tokens = 0
+        if getattr(args, "deadline_ms", None):
+            body["deadline_ms"] = args.deadline_ms
+        t0 = time.perf_counter()
+        chunk_times: list[float] = []
+        got_tokens = 0
+        finish_reason = None
+        try:
             async with session.post(
                 f"{url}/v1/completions", json=body
             ) as resp:
+                if resp.status == 429:
+                    # Load shed: an accounted outcome, not an error and
+                    # not a latency sample.
+                    counts["rejected"] += 1
+                    await resp.read()
+                    return
                 resp.raise_for_status()
                 async for raw in resp.content:
                     line = raw.decode().strip()
@@ -269,6 +341,8 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                         )
                     choices = chunk.get("choices") or []
                     choice = choices[0] if choices else None
+                    if choice is not None and choice.get("finish_reason"):
+                        finish_reason = choice["finish_reason"]
                     # Token-bearing chunks: anything before the finish
                     # marker ("text" may be empty when the server runs
                     # without a tokenizer, e.g. dummy-weight benches).
@@ -282,6 +356,15 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                         or not chunk_times
                     ):
                         chunk_times.append(time.perf_counter())
+        except Exception:  # noqa: BLE001 — bench client: count, move on
+            counts["errors"] += 1
+            return
+        if finish_reason in ("timeout", "overloaded"):
+            # Deadline/pressure shed mid-generation: partial output —
+            # keep it out of the completed-latency distribution too.
+            counts["timed_out"] += 1
+            return
+        counts["completed"] += 1
         if chunk_times:
             ttfts.append(chunk_times[0] - t0)
             out_tokens += got_tokens
@@ -292,13 +375,33 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                 span = chunk_times[-1] - chunk_times[0]
                 itls.append(span / (got_tokens - 1))
 
+    async def one(session, i: int) -> None:
+        if request_rate is not None:
+            # Open loop: arrivals don't wait for departures — offered
+            # load is what the operator configured, not what the
+            # server can absorb.
+            await drive_one(session, i)
+        else:
+            async with sem:
+                await drive_one(session, i)
+
     timeout = aiohttp.ClientTimeout(total=None, sock_read=600)
     async with aiohttp.ClientSession(timeout=timeout) as session:
         before = await scrape_metrics(session)
         t0 = time.perf_counter()
-        await asyncio.gather(
-            *(one(session, i) for i in range(args.num_prompts))
-        )
+        if request_rate is not None:
+            import random
+
+            rng = random.Random(12345)  # reproducible arrival process
+            tasks = []
+            for i in range(args.num_prompts):
+                tasks.append(asyncio.create_task(one(session, i)))
+                await asyncio.sleep(rng.expovariate(request_rate))
+            await asyncio.gather(*tasks)
+        else:
+            await asyncio.gather(
+                *(one(session, i) for i in range(args.num_prompts))
+            )
         elapsed = time.perf_counter() - t0
         after = await scrape_metrics(session)
 
@@ -306,24 +409,33 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
         "mode": "serve",
         "url": url,
         "num_prompts": args.num_prompts,
-        "concurrency": args.concurrency,
+        "concurrency": (
+            args.concurrency if request_rate is None else None
+        ),
         "input_len": args.input_len,
         "output_len": args.output_len,
         "elapsed_s": round(elapsed, 3),
         "output_tokens_per_s": round(out_tokens / elapsed, 1),
         "requests_per_s": round(args.num_prompts / elapsed, 3),
+        # Latency percentiles cover COMPLETED requests only; sheds are
+        # reported in outcomes below.
         "ttft_s": _percentiles(ttfts) if ttfts else None,
         "itl_ms": (
             {k: round(v * 1e3, 3) for k, v in _percentiles(itls).items()}
             if itls
             else None
         ),
+        "outcomes": dict(counts),
     }
-    if itls:
+    if request_rate is not None:
+        result["offered_rps"] = request_rate
+        result["arrival_process"] = "poisson"
+    if itls and request_rate is None:
         # The dispatch tax as the CLIENT sees it (ISSUE 7): throughput
         # implied by the p50 inter-token pace at this concurrency minus
         # the wall-clock throughput.  ~0 when the driver holds the p50
-        # pace for the whole run.
+        # pace for the whole run.  Closed-loop only (open-loop
+        # concurrency is not a constant).
         itl_p50 = _percentiles(itls)["p50"]
         if itl_p50 > 0:
             result["wall_vs_p50_gap"] = round(
@@ -351,6 +463,9 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                 3,
             ),
             "generation_tokens": delta("vllm:generation_tokens_total"),
+            # Cross-check: the server's own 429 count over the window
+            # should match the client's rejected outcome.
+            "requests_rejected": delta("vllm:requests_rejected_total"),
         }
         # Engine-side pipeline flushes over the run window: the serve
         # analogue of the microbench's stall_windows (0 = the async

@@ -26,6 +26,7 @@ from vllm_distributed_tpu.engine.async_llm import (
     EngineDeadError,
     EngineRecoveringError,
 )
+from vllm_distributed_tpu.engine.overload import EngineOverloadedError
 from vllm_distributed_tpu.entrypoints.openai.protocol import (
     EmbeddingData,
     EmbeddingRequest,
@@ -66,6 +67,10 @@ logger = init_logger(__name__)
 # /debug/traces (or your OTLP backend) to see where the latency went.
 TRACE_HEADER = "X-VDT-Trace-Id"
 
+# Request header carrying the client's deadline in milliseconds from
+# arrival (the deadline_ms body field wins when both are present).
+DEADLINE_HEADER = "X-VDT-Deadline-Ms"
+
 
 @dataclass
 class ServerState:
@@ -85,8 +90,11 @@ class ServerState:
 _UNAUTHENTICATED = {"/health", "/ping", "/version", "/metrics"}
 
 # Probe/scrape endpoints never open a root span (they would drown the
-# trace ring in noise and trace nothing request-shaped).
-_UNTRACED = {"/health", "/ping", "/version", "/metrics", "/debug/traces"}
+# trace ring in noise and trace nothing request-shaped).  /drain can
+# block for the full drain timeout — a span that long is noise too.
+_UNTRACED = {
+    "/health", "/ping", "/version", "/metrics", "/debug/traces", "/drain",
+}
 
 
 @web.middleware
@@ -150,10 +158,47 @@ def _engine_dead_response(e: EngineDeadError) -> web.Response:
     )
 
 
+def _overloaded_response(e: EngineOverloadedError) -> web.Response:
+    """Load-shed rejection: 429 + Retry-After (ISSUE 8), deliberately
+    DISTINCT from the dead/recovering 503s — this backend is healthy
+    but full, so a load balancer should retry it soon, not eject it."""
+    body = ErrorResponse(
+        message=str(e), type="overloaded_error", code=429
+    ).model_dump()
+    body["reason"] = getattr(e, "reason", "overloaded")
+    return web.json_response(
+        body,
+        status=429,
+        headers={"Retry-After": str(getattr(e, "retry_after", 1))},
+    )
+
+
 def _request_error(e: Exception) -> web.Response:
+    if isinstance(e, EngineOverloadedError):
+        return _overloaded_response(e)
     if isinstance(e, EngineDeadError):
         return _engine_dead_response(e)
     return _error(str(e), 400)
+
+
+def _apply_deadline(request: web.Request, params) -> web.Response | None:
+    """Fold the X-VDT-Deadline-Ms header into the sampling params (the
+    body field wins).  Returns an error response for a malformed
+    header, else None."""
+    header = request.headers.get(DEADLINE_HEADER)
+    if header is None or params.deadline_ms is not None:
+        return None
+    try:
+        ms = int(header)
+        if ms < 1:
+            raise ValueError
+    except ValueError:
+        return _error(
+            f"{DEADLINE_HEADER} must be a positive integer, got "
+            f"{header!r}"
+        )
+    params.deadline_ms = ms
+    return None
 
 
 def _apply_chat_template(state: ServerState, req: ChatCompletionRequest) -> str:
@@ -217,6 +262,27 @@ async def _collect(gen) -> RequestOutput:
     return last
 
 
+def _shed_response(outs: list[RequestOutput]) -> web.Response | None:
+    """Map engine-side preempt-to-shed finishes to HTTP 429 on the
+    non-streaming path (ISSUE 8): an admitted request the scheduler
+    shed under sustained pressure IS a rejection, even though it
+    carries partial output.  Streaming responses instead deliver
+    finish_reason="overloaded" in the final chunk (headers are long
+    gone)."""
+    if any(
+        out.outputs[0].finish_reason == "overloaded" for out in outs
+    ):
+        return _overloaded_response(
+            EngineOverloadedError(
+                "request shed under sustained KV pressure "
+                "(preempt-to-shed); retry later",
+                reason="overloaded",
+                retry_after=envs.VDT_OVERLOAD_RETRY_AFTER_SECONDS,
+            )
+        )
+    return None
+
+
 # ---- route handlers ----
 async def health(request: web.Request) -> web.Response:
     state: ServerState = request.app["state"]
@@ -249,11 +315,42 @@ async def health(request: web.Request) -> web.Response:
             status=503,
             headers={"Retry-After": str(envs.VDT_RETRY_AFTER_SECONDS)},
         )
+    if state.engine.draining:
+        # Fourth engine state (ISSUE 8): healthy but not admitting —
+        # in-flight work is finishing (draining) or has been journaled
+        # for hand-off (drained).  503 takes this backend out of LB
+        # rotation; the body says why.
+        return web.json_response(
+            {"status": state.engine.drain_state_name},
+            status=503,
+            headers={"Retry-After": str(envs.VDT_RETRY_AFTER_SECONDS)},
+        )
     return web.Response(status=200)
 
 
 async def version(request: web.Request) -> web.Response:
     return web.json_response({"version": __version__})
+
+
+async def drain(request: web.Request) -> web.Response:
+    """Graceful drain (ISSUE 8): stop admission (new requests 429,
+    /health reports the drain state), let in-flight requests finish for
+    up to ``?timeout=<seconds>`` (default VDT_DRAIN_TIMEOUT_SECONDS),
+    then journal what remains to VDT_DRAIN_JOURNAL_PATH so a restarted
+    engine — or another replica — replays it with zero lost admitted
+    work.  The SIGTERM handler calls the same path."""
+    state: ServerState = request.app["state"]
+    timeout = None
+    raw = request.query.get("timeout")
+    if raw is not None:
+        try:
+            timeout = float(raw)
+            if timeout < 0:
+                raise ValueError
+        except ValueError:
+            return _error(f"timeout must be a non-negative number, got {raw!r}")
+    result = await state.engine.drain(timeout)
+    return web.json_response(result)
 
 
 async def list_models(request: web.Request) -> web.Response:
@@ -312,6 +409,21 @@ async def chat_completions(request: web.Request) -> web.Response:
         params = req.to_sampling_params(default_max, is_chat=True)
     except ValueError as e:
         return _error(str(e))
+    err = _apply_deadline(request, params)
+    if err is not None:
+        return err
+
+    # Admission pre-check (no reservation): overload rejects become
+    # proper 429s HERE, before any SSE stream opens; generate() runs
+    # the authoritative reserving check per choice.
+    try:
+        state.engine.check_admission(
+            num_requests=req.n,
+            est_tokens=(len(prompt_ids) if prompt_ids else 0) * req.n,
+            prompt_token_ids=prompt_ids,
+        )
+    except EngineOverloadedError as e:
+        return _overloaded_response(e)
 
     if req.stream:
         return await _stream_chat(request, state, req, request_id, prompt_ids, prompt, params)
@@ -331,8 +443,11 @@ async def chat_completions(request: web.Request) -> web.Response:
                 for i in range(req.n)
             )
         )
-    except (EngineDeadError, ValueError) as e:
+    except (EngineOverloadedError, EngineDeadError, ValueError) as e:
         return _request_error(e)
+    shed = _shed_response(outs)
+    if shed is not None:
+        return shed
 
     choices = []
     usage = UsageInfo()
@@ -471,6 +586,14 @@ async def _stream_chat(
                 )
             )
         await send("[DONE]")
+    except EngineOverloadedError as e:
+        # Mid-stream shed/drain: headers are long sent, so the reject
+        # rides the stream as a typed error frame with the 429 code.
+        await send(
+            json.dumps(
+                {"error": str(e), "code": 429, "reason": e.reason}
+            )
+        )
     except (EngineDeadError, ValueError) as e:
         await send(json.dumps({"error": str(e)}))
     except (ConnectionResetError, asyncio.CancelledError):
@@ -521,6 +644,18 @@ async def completions(request: web.Request) -> web.Response:
         params = req.to_sampling_params(default_max, is_chat=False)
     except ValueError as e:
         return _error(str(e))
+    err = _apply_deadline(request, params)
+    if err is not None:
+        return err
+
+    try:
+        state.engine.check_admission(
+            num_requests=len(resolved) * req.n,
+            est_tokens=sum(len(ids) for _, ids in resolved) * req.n,
+            prompt_token_ids=resolved[0][1],
+        )
+    except EngineOverloadedError as e:
+        return _overloaded_response(e)
 
     if req.stream:
         return await _stream_completion(
@@ -543,8 +678,11 @@ async def completions(request: web.Request) -> web.Response:
             )
     try:
         outs = await asyncio.gather(*gens)
-    except (EngineDeadError, ValueError) as e:
+    except (EngineOverloadedError, EngineDeadError, ValueError) as e:
         return _request_error(e)
+    shed = _shed_response(outs)
+    if shed is not None:
+        return shed
 
     choices = []
     usage = UsageInfo()
@@ -677,6 +815,12 @@ async def _stream_completion(
             )
             await send_json(json.dumps(final.model_dump(exclude_none=True)))
         await send_json("[DONE]")
+    except EngineOverloadedError as e:
+        await send_json(
+            json.dumps(
+                {"error": str(e), "code": 429, "reason": e.reason}
+            )
+        )
     except (EngineDeadError, ValueError) as e:
         await send_json(json.dumps({"error": str(e)}))
     except (ConnectionResetError, asyncio.CancelledError):
@@ -825,6 +969,7 @@ def build_app(state: ServerState) -> web.Application:
     app.router.add_get("/health", health)
     app.router.add_get("/ping", health)
     app.router.add_get("/version", version)
+    app.router.add_post("/drain", drain)
     app.router.add_get("/v1/models", list_models)
     app.router.add_post("/tokenize", tokenize)
     app.router.add_post("/detokenize", detokenize)
@@ -873,7 +1018,15 @@ async def serve_http(
         ssl_context = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_SERVER)
         ssl_context.load_cert_chain(ssl_certfile, ssl_keyfile)
     runner = web.AppRunner(
-        app, keepalive_timeout=envs.VDT_HTTP_TIMEOUT_KEEP_ALIVE
+        app,
+        keepalive_timeout=envs.VDT_HTTP_TIMEOUT_KEEP_ALIVE,
+        # Cancel handler tasks when the client disconnects (aiohttp
+        # disables this by default since 3.9): a non-streaming
+        # completion whose client hung up must not keep generating —
+        # the cancelled handler's generate() iterators abort their
+        # engine-side requests (ISSUE 8 satellite; the streaming path
+        # already aborted via its write failing).
+        handler_cancellation=True,
     )
     await runner.setup()
     site = web.TCPSite(runner, host, port, ssl_context=ssl_context)

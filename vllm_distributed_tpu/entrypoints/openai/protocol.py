@@ -65,6 +65,10 @@ class _SamplingFields(BaseModel):
     stream_options: dict | None = None
     skip_special_tokens: bool = True
     include_stop_str_in_output: bool = False
+    # Per-request deadline in ms from arrival (the X-VDT-Deadline-Ms
+    # header sets it too; an explicit body field wins).  None = server
+    # default.
+    deadline_ms: int | None = None
 
     def to_sampling_params(
         self, default_max_tokens: int, is_chat: bool
@@ -105,6 +109,7 @@ class _SamplingFields(BaseModel):
             seed=self.seed,
             ignore_eos=self.ignore_eos,
             include_stop_str_in_output=self.include_stop_str_in_output,
+            deadline_ms=self.deadline_ms,
         )
 
 

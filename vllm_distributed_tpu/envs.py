@@ -86,6 +86,62 @@ environment_variables: dict[str, Callable[[], Any]] = {
     "VDT_STEP_STREAM_DEPTH": lambda: int(
         os.environ.get("VDT_STEP_STREAM_DEPTH", "8")
     ),
+    # --- overload resilience (ISSUE 8) ---
+    # Bounded admission: caps on the admission queue (waiting requests
+    # not yet scheduled + adds still in the intake).  0 = unbounded —
+    # the seed behavior.  Exceeding a cap rejects the request with a
+    # typed EngineOverloadedError (HTTP 429 + Retry-After), never an
+    # unbounded queue.
+    "VDT_MAX_WAITING_REQUESTS": lambda: int(
+        os.environ.get("VDT_MAX_WAITING_REQUESTS", "0")
+    ),
+    # Cap on queued PROMPT tokens awaiting prefill (same scope as
+    # above); bounds admission memory independently of request count so
+    # a few huge prompts can't evade the depth cap.  0 = unbounded.
+    "VDT_MAX_QUEUED_TOKENS": lambda: int(
+        os.environ.get("VDT_MAX_QUEUED_TOKENS", "0")
+    ),
+    # KV backpressure: reject admission when the prompt's estimated
+    # page demand (prefix-cache-aware) would leave fewer than this
+    # fraction of usable KV pages free.  0 = off.
+    "VDT_KV_ADMISSION_WATERMARK": lambda: float(
+        os.environ.get("VDT_KV_ADMISSION_WATERMARK", "0")
+    ),
+    # Server-default per-request deadline (milliseconds) when the
+    # client sends none (X-VDT-Deadline-Ms header / deadline_ms body
+    # field).  Expired waiting requests are shed before prefill;
+    # expired running requests finish with finish_reason="timeout" and
+    # partial output.  0 = no default deadline.
+    "VDT_DEFAULT_DEADLINE_MS": lambda: int(
+        os.environ.get("VDT_DEFAULT_DEADLINE_MS", "0")
+    ),
+    # Sustained-pressure preempt-to-shed: a request preempted more than
+    # this many times while others still wait is finished with
+    # finish_reason="overloaded" (HTTP 429 on the non-streaming path)
+    # instead of thrashing the allocator with recompute cycles.
+    # 0 = off (preempt/resume forever, the seed policy).
+    "VDT_PREEMPT_SHED_THRESHOLD": lambda: int(
+        os.environ.get("VDT_PREEMPT_SHED_THRESHOLD", "0")
+    ),
+    # Retry-After hint (seconds) on 429 overload rejections (distinct
+    # from VDT_RETRY_AFTER_SECONDS, the dead/recovering 503 hint:
+    # overload clears in ITL-scale time, a dead engine in restart-scale
+    # time).
+    "VDT_OVERLOAD_RETRY_AFTER_SECONDS": lambda: int(
+        os.environ.get("VDT_OVERLOAD_RETRY_AFTER_SECONDS", "1")
+    ),
+    # Graceful drain: how long /drain (and the SIGTERM handler) lets
+    # in-flight requests finish before journaling the rest.
+    "VDT_DRAIN_TIMEOUT_SECONDS": lambda: float(
+        os.environ.get("VDT_DRAIN_TIMEOUT_SECONDS", "30")
+    ),
+    # Where the drain journal is written (and loaded from at boot).
+    # Empty = drain finishes by aborting unfinished requests instead of
+    # journaling them.  Per-host: a replica's journal must never be
+    # replicated onto its workers.
+    "VDT_DRAIN_JOURNAL_PATH": lambda: os.environ.get(
+        "VDT_DRAIN_JOURNAL_PATH", ""
+    ),
     # --- observability ---
     # Per-request tracing (tracing.py): default off; the engine step
     # loop runs the no-op tracer path and /debug/traces answers 404.
@@ -169,6 +225,10 @@ NON_REPLICATED_ENV_VARS = {
     "VDT_FAULT_CONNECT_DELAY_SECONDS",
     "VDT_ADVERTISE_NUM_CHIPS",
     "VDT_ADVERTISE_PLATFORM",
+    # A replica's drain journal is local state: replicating the path
+    # onto remote workers would have every host writing (and on boot,
+    # consuming) the same file.
+    "VDT_DRAIN_JOURNAL_PATH",
 }
 
 # Extra vars replicated even though they are not VDT_* (launch.py:70-72).

@@ -88,6 +88,9 @@ DOCUMENTED_METRICS = (
     "vllm:step_gather_time_seconds",
     "vllm:request_success_total",
     "vllm:pipeline_breaks_total",
+    "vllm:requests_rejected_total",
+    "vllm:engine_drain_state",
+    "vllm:admission_queued_tokens",
     "vllm:host_up",
     "vllm:heartbeat_latency_seconds",
     "vllm:engine_dead_info",
@@ -237,6 +240,24 @@ class EngineMetrics:
             ["model_name", "finished_reason"],
             registry=self.registry,
         )
+        # ---- overload resilience (ISSUE 8) ----
+        self._rejected = Counter(
+            "vllm:requests_rejected",
+            "Admission rejections (HTTP 429) by reason: queue_full | "
+            "queued_tokens | kv_pressure | draining",
+            ["model_name", "reason"],
+            registry=self.registry,
+        )
+        self.drain_state = gauge(
+            "vllm:engine_drain_state",
+            "0 serving, 1 draining (admission stopped, in-flight work "
+            "finishing), 2 drained (unfinished work journaled/aborted)",
+        )
+        self.admission_queued_tokens = gauge(
+            "vllm:admission_queued_tokens",
+            "Prompt tokens queued for admission (waiting requests "
+            "awaiting (re-)prefill)",
+        )
         # ---- control-plane liveness ----
         self._host_up = Gauge(
             "vllm:host_up",
@@ -279,11 +300,26 @@ class EngineMetrics:
         self._model_name = model_name
 
     # ---- engine-loop hooks ----
-    def record_queues(self, running: int, waiting: int) -> None:
+    def record_queues(
+        self, running: int, waiting: int, waiting_tokens: int | None = None
+    ) -> None:
         if not self.enabled:
             return
         self.num_running.set(running)
         self.num_waiting.set(waiting)
+        if waiting_tokens is not None:
+            self.admission_queued_tokens.set(waiting_tokens)
+
+    def record_rejected(self, reason: str) -> None:
+        """One admission rejection (typed EngineOverloadedError -> 429)."""
+        if self.enabled:
+            self._rejected.labels(
+                model_name=self._model_name, reason=reason
+            ).inc()
+
+    def record_drain_state(self, state: int) -> None:
+        if self.enabled:
+            self.drain_state.set(state)
 
     def record_preemptions(self, n: int) -> None:
         if self.enabled and n:

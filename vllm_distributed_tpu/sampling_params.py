@@ -32,6 +32,13 @@ class SamplingParams:
     # Greedy iff temperature == 0.
     detokenize: bool = True
     include_stop_str_in_output: bool = False
+    # Per-request deadline in milliseconds from arrival (client-supplied
+    # via the deadline_ms body field or X-VDT-Deadline-Ms header); None
+    # falls back to the server default (SchedulerConfig
+    # default_deadline_ms, 0 = no deadline).  An expired waiting request
+    # is shed before prefill; an expired running request finishes with
+    # finish_reason="timeout" and partial output.
+    deadline_ms: int | None = None
 
     def __post_init__(self) -> None:
         if self.temperature < 0.0:
@@ -46,6 +53,10 @@ class SamplingParams:
             raise ValueError(f"n must be >= 1, got {self.n}")
         if not 0.0 <= self.min_p <= 1.0:
             raise ValueError(f"min_p must be in [0, 1], got {self.min_p}")
+        if self.deadline_ms is not None and self.deadline_ms < 1:
+            raise ValueError(
+                f"deadline_ms must be >= 1, got {self.deadline_ms}"
+            )
 
     @property
     def is_greedy(self) -> bool:
@@ -70,4 +81,5 @@ class SamplingParams:
             seed=self.seed,
             detokenize=self.detokenize,
             include_stop_str_in_output=self.include_stop_str_in_output,
+            deadline_ms=self.deadline_ms,
         )
