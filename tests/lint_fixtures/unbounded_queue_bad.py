@@ -19,3 +19,11 @@ class Intake:
         self.zero_positional = queue.Queue(0)  # EXPECT
         self.none_maxlen = deque([], maxlen=None)  # EXPECT
         self.lifo = queue.LifoQueue()  # EXPECT
+
+
+class RouterResumeFanIn:
+    # The ISSUE 10 router pattern gone wrong: per-choice resume pumps
+    # feeding an unbounded frame queue turn a slow client into memory
+    # growth instead of backpressure on the upstream reads.
+    def __init__(self):
+        self.frames = asyncio.Queue()  # EXPECT

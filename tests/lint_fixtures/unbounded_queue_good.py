@@ -18,3 +18,11 @@ class Intake:
         self.window2 = collections.deque([1, 2], 4)
         # vdt-lint: disable=unbounded-queue — producers bounded by admission caps
         self.waived = SimpleQueue()
+
+
+class RouterResumeFanIn:
+    # The ISSUE 10 router pattern done right: a bounded frame queue
+    # backpressures the per-choice resume pumps when the client reads
+    # slowly.
+    def __init__(self):
+        self.frames = asyncio.Queue(maxsize=64)

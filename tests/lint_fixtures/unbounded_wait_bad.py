@@ -23,3 +23,12 @@ def step_queue_loop(inbox, stop_event):
     frame = inbox.get()  # EXPECT
     stop_event.wait()  # EXPECT
     return frame
+
+
+async def router_forwarding_loop(session, frames, resp):
+    # The ISSUE 10 router patterns gone wrong: a silently dead replica
+    # wedges the client stream instead of triggering migration.
+    body = await resp.read()  # EXPECT
+    frame = await frames.get()  # EXPECT
+    await asyncio.gather(one(), two())  # EXPECT
+    return body, frame

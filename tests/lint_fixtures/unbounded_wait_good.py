@@ -36,6 +36,19 @@ def sync_result(fut):
     return fut.result(timeout=5)
 
 
+async def router_forwarding_loop(session, frames, resp, read_timeout):
+    # The ISSUE 10 router patterns done right: every upstream read and
+    # every queue wait is deadline-bounded, so a silently dead replica
+    # triggers migration instead of wedging the client stream.
+    body = await asyncio.wait_for(resp.read(), timeout=read_timeout)
+    frame = await asyncio.wait_for(frames.get(), timeout=read_timeout)
+    await asyncio.wait_for(
+        asyncio.gather(helper(session), helper(session)),
+        timeout=read_timeout,
+    )
+    return body, frame
+
+
 def step_queue_loop(inbox, stop, results):
     # The step-queue wait pattern (worker/step_stream.py): bounded poll
     # plus stop-flag re-check, so stop() always wins within one tick.

@@ -27,11 +27,14 @@ duplicated, or restarted-from-scratch tokens) changes the sequence.
 
 from __future__ import annotations
 
+import concurrent.futures
 import os
 import queue
 import time
 
+from vllm_distributed_tpu.executor.abstract import Executor
 from vllm_distributed_tpu.outputs import ModelRunnerOutput
+from vllm_distributed_tpu.utils import run_method
 
 # Simulated device time per fused dispatch in the two-phase protocol
 # (per-process override: VDT_MOCK_STEP_SECONDS — the dispatch
@@ -217,3 +220,36 @@ class MockWorker:
 
     def get_lifecycle(self) -> list[str]:
         return list(self.calls)
+
+
+class MockUniProcExecutor(Executor):
+    """In-process single-worker executor over MockWorker: the lightest
+    way to boot a whole AsyncLLM + api_server 'replica' without chips or
+    agent processes (router tests and chaos_soak --replicas spin up N
+    of these behind the router).  Honors VDT_MOCK_TOKEN_SEQ /
+    VDT_MOCK_EXECUTE_SLEEP_SECONDS like the multihost mock deployments.
+    """
+
+    def _init_executor(self) -> None:
+        self.worker = MockWorker(
+            self.config, rank=0, is_driver_worker=True
+        )
+        self.collective_rpc("init_device")
+        self.collective_rpc("load_model")
+
+    def collective_rpc(
+        self,
+        method: str,
+        args: tuple = (),
+        kwargs: dict | None = None,
+        *,
+        unique_reply_rank: int | None = None,
+        non_block: bool = False,
+        timeout: float | None = None,
+    ):
+        result = run_method(self.worker, method, args, kwargs or {})
+        if non_block:
+            fut: concurrent.futures.Future = concurrent.futures.Future()
+            fut.set_result(result)
+            return fut
+        return result if unique_reply_rank is not None else [result]

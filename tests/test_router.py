@@ -1,0 +1,1006 @@
+"""Multi-replica router suite (ISSUE 10): affinity placement + live
+request migration over journal-replay.
+
+Layered like the feature: unit tests for the prefix-affinity index
+(scoring, block granularity, LRU eviction), placement policy, the
+router journal, and the exposition merger; replica-side tests for the
+ISSUE 10 metadata surfaces (replica_id, vdt_token_ids stream metadata,
+/internal/resume); and mocked 2-replica e2e tests asserting the
+acceptance criteria — killing or draining the replica serving an
+in-flight SSE request migrates it to the survivor with the stream
+uninterrupted and greedy output bit-identical (the mock worker's
+VDT_MOCK_TOKEN_SEQ position-token mode makes any dropped, duplicated,
+or restarted token change the sequence), and affinity routing beats
+round-robin on prefix-cache hits for a shared-prefix workload.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from tests.mock_worker import MockUniProcExecutor, MockWorker  # noqa: F401
+from vllm_distributed_tpu.config import EngineArgs
+from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+from vllm_distributed_tpu.entrypoints.openai.api_server import (
+    build_app,
+    init_app_state,
+    serve_http,
+)
+from vllm_distributed_tpu.router.affinity import PrefixAffinityIndex
+from vllm_distributed_tpu.router.app import RouterState, build_router_app
+from vllm_distributed_tpu.router.journal import RouterJournal
+from vllm_distributed_tpu.router.metrics import merge_expositions
+from vllm_distributed_tpu.router.pool import parse_load_gauges
+from vllm_distributed_tpu.testing import write_llama_config
+from vllm_distributed_tpu.utils import get_open_port
+
+pytestmark = pytest.mark.router
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------
+# affinity index units
+# ---------------------------------------------------------------------
+def test_affinity_longest_prefix_scoring():
+    idx = PrefixAffinityIndex(block_tokens=4, capacity=64)
+    base = list(range(16))
+    idx.observe("r1", idx.keys_for(prompt_token_ids=base))
+    # Full match: all 16 tokens warm.
+    assert idx.score(idx.keys_for(prompt_token_ids=base)) == {"r1": 16}
+    # Shared 8-token prefix, divergent tail: only the prefix counts.
+    probe = base[:8] + [99] * 8
+    assert idx.score(idx.keys_for(prompt_token_ids=probe)) == {"r1": 8}
+    # Divergence INSIDE the first block breaks the whole chain.
+    probe = [99] + base[1:]
+    assert idx.score(idx.keys_for(prompt_token_ids=probe)) == {}
+    # Sub-block leftovers don't create a key (nothing page-aligned to
+    # reuse).
+    assert idx.keys_for(prompt_token_ids=[1, 2]) != []
+    assert len(idx.keys_for(prompt_token_ids=[1, 2])) == 1
+
+
+def test_affinity_scores_are_per_replica():
+    idx = PrefixAffinityIndex(block_tokens=4, capacity=64)
+    a, b = list(range(8)), list(range(100, 108))
+    idx.observe("rA", idx.keys_for(prompt_token_ids=a))
+    idx.observe("rB", idx.keys_for(prompt_token_ids=b))
+    assert idx.score(idx.keys_for(prompt_token_ids=a)) == {"rA": 8}
+    assert idx.score(idx.keys_for(prompt_token_ids=b)) == {"rB": 8}
+    idx.forget("rA")
+    assert idx.score(idx.keys_for(prompt_token_ids=a)) == {}
+    assert idx.num_blocks("rA") == 0 and idx.num_blocks("rB") == 2
+
+
+def test_affinity_lru_eviction():
+    idx = PrefixAffinityIndex(block_tokens=4, capacity=4)
+    old = idx.keys_for(prompt_token_ids=list(range(8)))  # 2 blocks
+    new = idx.keys_for(prompt_token_ids=list(range(50, 70)))  # 5 blocks
+    idx.observe("r1", old)
+    idx.observe("r1", new)
+    # Capacity 4 < 2 + 5: the old chain was evicted first.
+    assert idx.num_blocks("r1") == 4
+    assert idx.score(old) == {}
+    # The newest chain's most recent blocks survive; its head may have
+    # been evicted by its own tail, so only assert boundedness + that
+    # re-observing refreshes.
+    idx.observe("r1", old)
+    assert idx.score(old) == {"r1": 8}
+
+
+def test_affinity_text_and_token_namespaces_disjoint():
+    idx = PrefixAffinityIndex(block_tokens=4, capacity=64)
+    idx.observe("r1", idx.keys_for(prompt_text="abcd" * 8))
+    # The same bytes as token ids must not cross-match the text chain.
+    assert idx.score(idx.keys_for(prompt_token_ids=[1, 2, 3, 4])) == {}
+    assert idx.score(idx.keys_for(prompt_text="abcd" * 8)) == {"r1": 8}
+    # Text chains match on shared prefixes too.
+    assert idx.score(idx.keys_for(prompt_text="abcd" * 4 + "zz")) == {
+        "r1": 4
+    }
+
+
+# ---------------------------------------------------------------------
+# placement units
+# ---------------------------------------------------------------------
+def _router_state(policy="affinity", **kw) -> RouterState:
+    kw.setdefault("affinity_block_tokens", 4)
+    kw.setdefault("affinity_min_tokens", 8)
+    kw.setdefault("max_migrations", 3)
+    kw.setdefault("health_interval", 60.0)
+    kw.setdefault("connect_timeout", 1.0)
+    kw.setdefault("read_timeout", 5.0)
+    state = RouterState(
+        ["http://a:1", "http://b:2"], policy=policy, **kw
+    )
+    for r in state.pool.replicas:
+        r.state = "healthy"
+    return state
+
+
+def test_placement_affinity_wins_over_load():
+    state = _router_state()
+    ra, rb = state.pool.replicas
+    keys = state.index.keys_for(prompt_token_ids=list(range(16)))
+    state.index.observe(ra.replica_id, keys)
+    # rb is idle, ra is loaded — affinity still picks ra (the warm
+    # cache saves more than the queue costs).
+    ra.waiting = 5.0
+    replica, how = state.place(keys, set())
+    assert (replica, how) == (ra, "affinity")
+    # Below the min-token threshold the affinity signal is noise:
+    # fall back to least-loaded (rb).
+    weak = state.index.keys_for(prompt_token_ids=list(range(4)) + [99] * 12)
+    replica, how = state.place(weak, set())
+    assert (replica, how) == (rb, "least_loaded")
+
+
+def test_placement_excludes_unhealthy_and_backed_off():
+    state = _router_state(policy="least_loaded")
+    ra, rb = state.pool.replicas
+    rb.state = "draining"
+    replica, _ = state.place([], set())
+    assert replica is ra
+    state.pool.note_backoff(ra, 30.0)
+    assert state.place([], set()) == (None, "none")
+    rb.state = "healthy"
+    replica, _ = state.place([], set())
+    assert replica is rb
+    # Explicit exclusion (a migration's victim) wins over everything.
+    assert state.place([], {rb.url}) == (None, "none")
+
+
+def test_placement_round_robin_cycles():
+    state = _router_state(policy="round_robin")
+    picks = {state.place([], set())[0].replica_id for _ in range(4)}
+    assert len(picks) == 2  # both replicas used
+
+
+# ---------------------------------------------------------------------
+# journal units
+# ---------------------------------------------------------------------
+def test_journal_strips_metadata_and_accumulates():
+    j = RouterJournal(
+        "rtr-1", "completions", {"prompt": [1, 2, 3], "n": 1, "stream": True}
+    )
+    out = j.observe_choice(
+        {
+            "index": 0,
+            "text": "ab",
+            "vdt_token_ids": [3, 4],
+            "vdt_prompt_token_ids": [1, 2, 3],
+            "finish_reason": None,
+        }
+    )
+    assert "vdt_token_ids" not in out
+    assert "vdt_prompt_token_ids" not in out
+    j.observe_choice(
+        {"index": 0, "text": "cd", "vdt_token_ids": [5], "finish_reason": None}
+    )
+    c = j.choices[0]
+    assert c.emitted_token_ids == [3, 4, 5]
+    assert c.forwarded_text_len == 4
+    assert c.prompt_token_ids == [1, 2, 3]
+    assert not c.finished and j.unfinished() == [c]
+    j.observe_choice({"index": 0, "text": "", "finish_reason": "length"})
+    assert c.finished and j.unfinished() == []
+    payload = j.resume_payload(c)
+    assert payload["prompt_token_ids"] == [1, 2, 3]
+    assert payload["emitted_token_ids"] == [3, 4, 5]
+    assert payload["kind"] == "completions"
+    assert payload["body"]["prompt"] == [1, 2, 3]
+    # Unique per (migration, choice): a resume id can never collide
+    # with the victim's engine-side id.
+    j.migrations = 2
+    assert payload != j.resume_payload(c)
+
+
+def test_journal_multi_prompt_choice_indexing():
+    j = RouterJournal(
+        "rtr-2",
+        "completions",
+        {"prompt": [[1, 2], [3, 4]], "n": 2, "stream": True},
+    )
+    # prompt-major, sample-minor — the order the replica assigns.
+    assert sorted(j.choices) == [0, 1, 2, 3]
+    assert j.choices[0].prompt_token_ids == [1, 2]
+    assert j.choices[1].prompt_token_ids == [1, 2]
+    assert j.choices[2].prompt_token_ids == [3, 4]
+    text, ids = j.affinity_source()
+    assert ids == [1, 2]
+
+
+def test_journal_chat_affinity_source():
+    j = RouterJournal(
+        "rtr-3",
+        "chat",
+        {
+            "messages": [
+                {"role": "system", "content": "be brief"},
+                {"role": "user", "content": "hello"},
+            ],
+            "stream": True,
+        },
+    )
+    text, ids = j.affinity_source()
+    assert ids is None
+    assert "be brief" in text and "hello" in text
+
+
+# ---------------------------------------------------------------------
+# metrics merging / gauge parsing units
+# ---------------------------------------------------------------------
+def test_merge_expositions_labels_and_dedupes():
+    ra = (
+        "# HELP vllm:x doc\n# TYPE vllm:x gauge\n"
+        'vllm:x{model_name="m"} 1.0\n'
+    )
+    rb = (
+        "# HELP vllm:x doc\n# TYPE vllm:x gauge\n"
+        "vllm:x 2.0\n"
+    )
+    merged = merge_expositions([("r0", ra), ("r1", rb)])
+    assert merged.count("# TYPE vllm:x gauge") == 1
+    assert 'vllm:x{model_name="m",replica="r0"} 1.0' in merged
+    assert 'vllm:x{replica="r1"} 2.0' in merged
+
+
+def test_parse_load_gauges():
+    text = (
+        "# TYPE vllm:num_requests_waiting gauge\n"
+        'vllm:num_requests_waiting{model_name="m"} 3.0\n'
+        'vllm:admission_queued_tokens{model_name="m"} 128.0\n'
+        "vllm:other 9\n"
+    )
+    gauges = parse_load_gauges(text)
+    assert gauges["vllm:num_requests_waiting"] == 3.0
+    assert gauges["vllm:admission_queued_tokens"] == 128.0
+    assert "vllm:other" not in gauges
+
+
+# ---------------------------------------------------------------------
+# replica-side surfaces (mock uniproc engine behind the real app)
+# ---------------------------------------------------------------------
+def _mk_engine(model_dir: str, **kw) -> AsyncLLM:
+    args = dict(
+        model=model_dir,
+        skip_tokenizer_init=True,
+        load_format="dummy",
+        num_kv_pages=64,
+        max_model_len=128,
+        num_decode_steps=1,
+        distributed_executor_backend=MockUniProcExecutor,
+    )
+    args.update(kw)
+    return AsyncLLM.from_engine_args(EngineArgs(**args))
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    return write_llama_config(
+        str(tmp_path_factory.mktemp("router") / "m")
+    )
+
+
+def _sse_chunks(body: str) -> list[dict]:
+    out = []
+    for line in body.splitlines():
+        if line.startswith("data: ") and line[6:] != "[DONE]":
+            out.append(json.loads(line[6:]))
+    return out
+
+
+def test_replica_id_and_stream_metadata(model_dir, monkeypatch):
+    """ISSUE 10 satellites on the replica: /health body + response
+    header carry the replica id, the vllm:replica_info gauge renders,
+    and vdt_* stream metadata appears ONLY under the router header."""
+    monkeypatch.setenv("VDT_MOCK_TOKEN_SEQ", "1")
+    engine = _mk_engine(model_dir)
+    state = init_app_state(
+        engine, served_model_name="meta", replica_id="replica-7"
+    )
+
+    async def go():
+        server = TestServer(build_app(state))
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            r = await client.get("/health")
+            assert r.status == 200
+            assert (await r.json())["replica_id"] == "replica-7"
+            assert r.headers["X-VDT-Replica-Id"] == "replica-7"
+            metrics_text = await (await client.get("/metrics")).text()
+            assert 'replica_id="replica-7"' in metrics_text
+            assert "vllm:replica_info" in metrics_text
+
+            body = {
+                "prompt": [1, 2, 3],
+                "max_tokens": 4,
+                "temperature": 0.0,
+                "ignore_eos": True,
+                "stream": True,
+            }
+            r = await client.post(
+                "/v1/completions", json=body,
+                headers={"X-VDT-Router": "1"},
+            )
+            assert r.headers["X-VDT-Replica-Id"] == "replica-7"
+            chunks = _sse_chunks(await r.text())
+            ids = [
+                t
+                for c in chunks
+                for ch in c.get("choices") or ()
+                for t in ch.get("vdt_token_ids") or ()
+            ]
+            assert ids == [3, 4, 5, 6]
+            assert chunks[0]["choices"][0]["vdt_prompt_token_ids"] == [
+                1, 2, 3,
+            ]
+            # Without the router header the wire format is untouched.
+            r = await client.post("/v1/completions", json=body)
+            for c in _sse_chunks(await r.text()):
+                for ch in c.get("choices") or ():
+                    assert "vdt_token_ids" not in ch
+                    assert "vdt_prompt_token_ids" not in ch
+        finally:
+            await client.close()
+
+    try:
+        _run(go())
+    finally:
+        engine.shutdown()
+
+
+def test_internal_resume_bit_identical(model_dir, monkeypatch):
+    """The migration primitive in isolation: a resume with k delivered
+    tokens restored continues with EXACTLY the tokens an uninterrupted
+    run produces after position k (VDT_MOCK_TOKEN_SEQ: token i = i)."""
+    monkeypatch.setenv("VDT_MOCK_TOKEN_SEQ", "1")
+    engine = _mk_engine(model_dir)
+    state = init_app_state(engine, served_model_name="resume")
+    body = {
+        "prompt": [1, 2, 3],
+        "max_tokens": 6,
+        "temperature": 0.0,
+        "ignore_eos": True,
+        "stream": True,
+    }
+    expected = list(range(3, 9))  # positions 3..8
+
+    async def go():
+        server = TestServer(build_app(state))
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/internal/resume",
+                json={
+                    "request_id": "mig-1",
+                    "kind": "completions",
+                    "body": body,
+                    "prompt_token_ids": [1, 2, 3],
+                    "emitted_token_ids": expected[:2],
+                },
+            )
+            assert r.status == 200
+            frames = _sse_chunks(await r.text())
+            new_ids = [
+                t for f in frames for t in f.get("token_ids") or ()
+            ]
+            assert new_ids == expected[2:]
+            assert frames[0]["prompt_token_ids"] == [1, 2, 3]
+            final = frames[-1]
+            assert final["finish_reason"] == "length"
+            assert final["usage"]["completion_tokens"] == 6
+            # A draining replica refuses migrations (503).
+            await engine.drain(0.0)
+            r = await client.post(
+                "/internal/resume",
+                json={
+                    "request_id": "mig-2",
+                    "kind": "completions",
+                    "body": body,
+                    "prompt_token_ids": [1, 2, 3],
+                    "emitted_token_ids": [],
+                },
+            )
+            assert r.status == 503
+        finally:
+            await client.close()
+
+    try:
+        _run(go())
+    finally:
+        engine.shutdown()
+
+
+def test_trace_header_parents_replica_span(model_dir, monkeypatch):
+    """PR 4 trace context through the router hop: a request arriving
+    with X-VDT-Trace-Id '<trace>-<span>' parents the replica's
+    api.request span under it instead of rooting a new trace."""
+    from vllm_distributed_tpu.tracing import get_tracer
+
+    monkeypatch.setenv("VDT_MOCK_TOKEN_SEQ", "1")
+    tracer = get_tracer()
+    # Engine boot reconfigures the global tracer from its config, so
+    # tracing must be enabled THROUGH the engine args.
+    engine = _mk_engine(model_dir, enable_tracing=True)
+    state = init_app_state(engine, served_model_name="trace")
+    trace_id, span_id = "ab" * 16, "cd" * 8
+
+    async def go():
+        server = TestServer(build_app(state))
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/v1/completions",
+                json={
+                    "prompt": [1, 2, 3],
+                    "max_tokens": 2,
+                    "temperature": 0.0,
+                    "ignore_eos": True,
+                },
+                headers={"X-VDT-Trace-Id": f"{trace_id}-{span_id}"},
+            )
+            assert r.status == 200
+            assert r.headers["X-VDT-Trace-Id"] == trace_id
+        finally:
+            await client.close()
+
+    try:
+        _run(go())
+        trace = tracer.get_trace(trace_id)
+        assert trace is not None
+        api_spans = [
+            s for s in trace["spans"] if s["name"] == "api.request"
+        ]
+        assert api_spans and api_spans[0]["parent_id"] == span_id
+    finally:
+        engine.shutdown()
+        tracer.reset()
+        tracer.configure(enabled=False)
+
+
+# ---------------------------------------------------------------------
+# mocked 2-replica e2e: the acceptance criteria
+# ---------------------------------------------------------------------
+async def _boot_replicas(model_dir, n=2, **engine_kw):
+    """N mock-uniproc replicas on real loopback ports (hard-kill-able
+    via runner.cleanup with a tiny shutdown timeout)."""
+    engines, runners, urls = [], [], []
+    for i in range(n):
+        engine = _mk_engine(model_dir, **engine_kw)
+        state = init_app_state(
+            engine, served_model_name="e2e", replica_id=f"replica-{i}"
+        )
+        port = get_open_port()
+        runner = await serve_http(
+            build_app(state),
+            host="127.0.0.1",
+            port=port,
+            shutdown_timeout=0.05,
+        )
+        engines.append(engine)
+        runners.append(runner)
+        urls.append(f"http://127.0.0.1:{port}")
+    return engines, runners, urls
+
+
+async def _teardown(client, runners, engines):
+    if client is not None:
+        await client.close()
+    for runner in runners:
+        if runner is not None:
+            try:
+                await runner.cleanup()
+            except Exception:  # noqa: BLE001 — already torn down
+                pass
+    for engine in engines:
+        try:
+            engine.shutdown()
+        except Exception:  # noqa: BLE001 — already torn down
+            pass
+
+
+async def _stream_tokens(client, body, on_chunk=None):
+    """Stream a completion through the router (debug passthrough on);
+    returns (token_ids, finish_reason, serving_replica_id, error)."""
+    toks: list[int] = []
+    finish = None
+    error = None
+    r = await client.post(
+        "/v1/completions", json=body, headers={"X-VDT-Router": "1"}
+    )
+    assert r.status == 200, await r.text()
+    served = r.headers.get("X-VDT-Replica-Id")
+    async for raw in r.content:
+        line = raw.decode().strip()
+        if not line.startswith("data:"):
+            continue
+        payload = line[5:].strip()
+        if payload == "[DONE]":
+            break
+        obj = json.loads(payload)
+        if "error" in obj and not obj.get("choices"):
+            error = obj
+            break
+        for ch in obj.get("choices") or ():
+            toks += ch.get("vdt_token_ids") or []
+            if ch.get("finish_reason"):
+                finish = ch["finish_reason"]
+        if on_chunk is not None:
+            await on_chunk(toks)
+    return toks, finish, served, error
+
+
+def _migration_case(model_dir, monkeypatch, mode: str):
+    """Shared body of the two acceptance tests: start a stream through
+    the router, kill/drain the serving replica after 3 tokens, assert
+    the stream finishes with the exact uninterrupted greedy sequence."""
+    monkeypatch.setenv("VDT_MOCK_TOKEN_SEQ", "1")
+    monkeypatch.setenv("VDT_MOCK_EXECUTE_SLEEP_SECONDS", "0.05")
+    max_tokens = 12
+    expected = list(range(3, 3 + max_tokens))
+    body = {
+        "prompt": [1, 2, 3],
+        "max_tokens": max_tokens,
+        "temperature": 0.0,
+        "ignore_eos": True,
+        "stream": True,
+    }
+
+    async def go():
+        import aiohttp
+
+        engines, runners, urls = await _boot_replicas(model_dir)
+        state = RouterState(
+            urls,
+            policy="round_robin",
+            health_interval=0.3,
+            connect_timeout=2.0,
+            read_timeout=20.0,
+        )
+        server = TestServer(build_router_app(state))
+        client = TestClient(server)
+        await client.start_server()
+        fired = {"done": False}
+
+        async def chaos(toks):
+            if fired["done"] or len(toks) < 3:
+                return
+            fired["done"] = True
+            victim = int(served["id"].rsplit("-", 1)[1])
+            if mode == "kill":
+                runner, runners[victim] = runners[victim], None
+                await runner.cleanup()
+                engines[victim].shutdown()
+            else:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        f"{urls[victim]}/drain", params={"timeout": "0"}
+                    ) as dr:
+                        assert dr.status == 200
+                        await dr.read()
+
+        served: dict = {}
+        try:
+            # Wrap to capture the serving replica id before chaos.
+            r = await client.post(
+                "/v1/completions", json=body,
+                headers={"X-VDT-Router": "1"},
+            )
+            assert r.status == 200
+            served["id"] = r.headers["X-VDT-Replica-Id"]
+            toks: list[int] = []
+            finish = None
+            async for raw in r.content:
+                line = raw.decode().strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == "[DONE]":
+                    break
+                obj = json.loads(payload)
+                assert "error" not in obj or obj.get("choices"), obj
+                for ch in obj.get("choices") or ():
+                    toks += ch.get("vdt_token_ids") or []
+                    if ch.get("finish_reason"):
+                        finish = ch["finish_reason"]
+                await chaos(toks)
+            # Bit-identical across the switch: no token dropped,
+            # duplicated, or recomputed from the wrong boundary.
+            assert toks == expected, (toks, expected)
+            assert finish == "length"
+            assert fired["done"], "chaos never fired"
+            router_state = await (
+                await client.get("/router/state")
+            ).json()
+            migrated = {
+                k: v
+                for k, v in router_state["counters"].items()
+                if k.startswith("migrations.")
+            }
+            assert sum(migrated.values()) >= 1, router_state
+            assert (
+                router_state["counters"].get(
+                    "requests.completions.migrated_completed"
+                )
+                == 1
+            )
+        finally:
+            await _teardown(client, runners, engines)
+
+    _run(go())
+
+
+def test_kill_mid_stream_migrates_bit_identical(model_dir, monkeypatch):
+    _migration_case(model_dir, monkeypatch, "kill")
+
+
+def test_drain_mid_stream_migrates_bit_identical(model_dir, monkeypatch):
+    _migration_case(model_dir, monkeypatch, "drain")
+
+
+def test_migration_waits_out_backed_off_survivor(model_dir, monkeypatch):
+    """A replica in 429 Retry-After backoff is busy, not failed: when
+    the serving replica dies and the only survivor is backed off, the
+    migration loop waits one backoff beat and still completes the
+    stream there (regression for conflating busy-once with
+    failed-for-this-request)."""
+    monkeypatch.setenv("VDT_MOCK_TOKEN_SEQ", "1")
+    monkeypatch.setenv("VDT_MOCK_EXECUTE_SLEEP_SECONDS", "0.05")
+    max_tokens = 10
+    expected = list(range(3, 3 + max_tokens))
+    body = {
+        "prompt": [1, 2, 3],
+        "max_tokens": max_tokens,
+        "temperature": 0.0,
+        "ignore_eos": True,
+        "stream": True,
+    }
+
+    async def go():
+        engines, runners, urls = await _boot_replicas(model_dir)
+        state = RouterState(
+            urls,
+            policy="least_loaded",
+            health_interval=0.3,
+            connect_timeout=2.0,
+            read_timeout=20.0,
+        )
+        server = TestServer(build_router_app(state))
+        client = TestClient(server)
+        await client.start_server()
+        fired = {"done": False}
+
+        async def chaos(toks):
+            if fired["done"] or len(toks) < 3:
+                return
+            fired["done"] = True
+            victim = int(served["id"].rsplit("-", 1)[1])
+            survivor = state.pool.replicas[1 - victim]
+            # Emulate a just-received 429 from the survivor: it is in
+            # Retry-After backoff when the migration needs it.
+            state.pool.note_backoff(survivor, 0.8)
+            runner, runners[victim] = runners[victim], None
+            await runner.cleanup()
+            engines[victim].shutdown()
+
+        served: dict = {}
+        try:
+            r = await client.post(
+                "/v1/completions", json=body,
+                headers={"X-VDT-Router": "1"},
+            )
+            assert r.status == 200
+            served["id"] = r.headers["X-VDT-Replica-Id"]
+            toks: list[int] = []
+            async for raw in r.content:
+                line = raw.decode().strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == "[DONE]":
+                    break
+                obj = json.loads(payload)
+                assert "error" not in obj or obj.get("choices"), obj
+                for ch in obj.get("choices") or ():
+                    toks += ch.get("vdt_token_ids") or []
+                await chaos(toks)
+            assert fired["done"]
+            assert toks == expected, (toks, expected)
+        finally:
+            await _teardown(client, runners, engines)
+
+    _run(go())
+
+
+def test_router_health_and_metrics_aggregation(model_dir, monkeypatch):
+    monkeypatch.setenv("VDT_MOCK_TOKEN_SEQ", "1")
+
+    async def go():
+        engines, runners, urls = await _boot_replicas(model_dir)
+        state = RouterState(
+            urls,
+            policy="least_loaded",
+            health_interval=0.2,
+            connect_timeout=2.0,
+            read_timeout=10.0,
+        )
+        server = TestServer(build_router_app(state))
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            r = await client.get("/health")
+            body = await r.json()
+            assert r.status == 200
+            assert body["status"] == "ok"
+            assert body["replicas_routable"] == 2
+            ids = {rep["replica_id"] for rep in body["replicas"]}
+            assert ids == {"replica-0", "replica-1"}
+
+            # One request so per-replica engine metrics exist.
+            resp = await client.post(
+                "/v1/completions",
+                json={
+                    "prompt": [1, 2, 3],
+                    "max_tokens": 2,
+                    "temperature": 0.0,
+                    "ignore_eos": True,
+                },
+            )
+            assert resp.status == 200
+            assert resp.headers["X-VDT-Replica-Id"] in ids
+
+            metrics_text = await (await client.get("/metrics")).text()
+            # Replica families present once, samples labeled per
+            # replica, and the router's own families alongside.
+            assert metrics_text.count(
+                "# TYPE vllm:num_requests_running gauge"
+            ) == 1
+            assert 'replica="replica-0"' in metrics_text
+            assert 'replica="replica-1"' in metrics_text
+            assert "vdt_router:placements" in metrics_text
+
+            # /v1/models proxies from a live replica.
+            models = await (await client.get("/v1/models")).json()
+            assert models["data"][0]["id"] == "e2e"
+
+            # Kill one replica: /health degrades but stays 200.
+            runner, runners[0] = runners[0], None
+            await runner.cleanup()
+            engines[0].shutdown()
+            for _ in range(40):
+                body = await (await client.get("/health")).json()
+                if body["replicas_routable"] == 1:
+                    break
+                await asyncio.sleep(0.1)
+            assert body["replicas_routable"] == 1
+            assert body["status"] == "degraded"
+        finally:
+            await _teardown(client, runners, engines)
+
+    _run(go())
+
+
+def test_affinity_routing_sticks_and_beats_round_robin(
+    model_dir, monkeypatch
+):
+    """Affinity A/B (acceptance): on a shared-prefix workload with
+    prefix caching enabled on the replicas, affinity routing yields a
+    strictly higher vllm:prefix_cache_hits total than round_robin —
+    and repeat prompts stick to the warm replica even when it looks
+    more loaded."""
+    monkeypatch.setenv("VDT_MOCK_TOKEN_SEQ", "1")
+    shared = [(7 * j) % 900 + 1 for j in range(32)]
+
+    async def run_policy(policy: str) -> float:
+        engines, runners, urls = await _boot_replicas(
+            model_dir, enable_prefix_caching=True
+        )
+        state = RouterState(
+            urls,
+            policy=policy,
+            health_interval=0.2,
+            affinity_block_tokens=16,
+            affinity_min_tokens=16,
+            connect_timeout=2.0,
+            read_timeout=10.0,
+        )
+        server = TestServer(build_router_app(state))
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            served_by = []
+            for i in range(6):
+                body = {
+                    "prompt": shared + [900 + i] * 4,
+                    "max_tokens": 2,
+                    "temperature": 0.0,
+                    "ignore_eos": True,
+                }
+                r = await client.post("/v1/completions", json=body)
+                assert r.status == 200, await r.text()
+                await r.read()
+                served_by.append(r.headers["X-VDT-Replica-Id"])
+                if policy == "affinity" and i == 0:
+                    # Make the warm replica LOOK more loaded: affinity
+                    # must still prefer it over the idle cold one.
+                    state.pool.by_id(served_by[0]).waiting = 5.0
+            metrics_text = await (await client.get("/metrics")).text()
+            hits = 0.0
+            for line in metrics_text.splitlines():
+                if line.startswith("vllm:prefix_cache_hits_total{"):
+                    hits += float(line.rsplit(" ", 1)[1])
+            if policy == "affinity":
+                # Sticky: every request after the first followed the
+                # warm cache.
+                assert len(set(served_by)) == 1, served_by
+            else:
+                assert len(set(served_by)) == 2, served_by
+            return hits
+        finally:
+            await _teardown(client, runners, engines)
+
+    async def go():
+        hits_affinity = await run_policy("affinity")
+        hits_rr = await run_policy("round_robin")
+        assert hits_affinity > hits_rr, (hits_affinity, hits_rr)
+
+    _run(go())
+
+
+def test_chat_kill_mid_stream_real_model_bit_identical(
+    tmp_path_factory, monkeypatch
+):
+    """Migration on the REAL text path: two tiny-llama replicas (same
+    weights, real tokenizer), a streaming CHAT request killed
+    mid-stream — the migrated stream's concatenated text must equal an
+    unmigrated run's exactly (the router's cumulative-text dedupe and
+    the replica's detokenizer pre-feed must agree on the boundary)."""
+    import time as _time
+
+    from tests.utils import add_tiny_tokenizer, make_tiny_llama
+
+    # vocab_size matches the 30-word tokenizer so every greedy token
+    # decodes to a real word — the text-dedupe path must carry actual
+    # characters across the migration boundary.
+    model = make_tiny_llama(
+        str(tmp_path_factory.mktemp("router-real") / "m"), vocab_size=30
+    )
+    add_tiny_tokenizer(model)
+    body = {
+        "messages": [
+            {"role": "system", "content": "the cat"},
+            {"role": "user", "content": "hello world the cat sat"},
+        ],
+        "max_tokens": 16,
+        "temperature": 0.0,
+        "ignore_eos": True,
+        "stream": True,
+    }
+
+    async def go():
+        engines, runners, urls = [], [], []
+        for i in range(2):
+            engine = AsyncLLM.from_engine_args(
+                EngineArgs(
+                    model=model,
+                    num_kv_pages=128,
+                    max_model_len=256,
+                    max_num_seqs=8,
+                    num_decode_steps=1,
+                )
+            )
+            state = init_app_state(
+                engine,
+                served_model_name="tiny",
+                replica_id=f"replica-{i}",
+            )
+            port = get_open_port()
+            runner = await serve_http(
+                build_app(state),
+                host="127.0.0.1",
+                port=port,
+                shutdown_timeout=0.05,
+            )
+            engines.append(engine)
+            runners.append(runner)
+            urls.append(f"http://127.0.0.1:{port}")
+        # Slow both engines so the kill reliably lands mid-stream.
+        for engine in engines:
+            real_step = engine.engine.step
+
+            def slow_step(_real=real_step):
+                _time.sleep(0.05)
+                return _real()
+
+            engine.engine.step = slow_step
+        state = RouterState(
+            urls,
+            policy="round_robin",
+            health_interval=0.3,
+            connect_timeout=2.0,
+            read_timeout=20.0,
+        )
+        server = TestServer(build_router_app(state))
+        client = TestClient(server)
+        await client.start_server()
+
+        async def stream_chat(chaos=None):
+            r = await client.post("/v1/chat/completions", json=body)
+            assert r.status == 200, await r.text()
+            served = r.headers["X-VDT-Replica-Id"]
+            text = ""
+            finish = None
+            async for raw in r.content:
+                line = raw.decode().strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == "[DONE]":
+                    break
+                obj = json.loads(payload)
+                assert "error" not in obj or obj.get("choices"), obj
+                for ch in obj.get("choices") or ():
+                    text += (ch.get("delta") or {}).get("content") or ""
+                    if ch.get("finish_reason"):
+                        finish = ch["finish_reason"]
+                if chaos is not None:
+                    await chaos(served, text)
+            return text, finish
+
+        try:
+            baseline_text, baseline_finish = await stream_chat()
+            assert baseline_finish == "length" and baseline_text
+            fired = {"done": False}
+
+            async def chaos(served, text):
+                # Kill after a few characters of content arrived.
+                if fired["done"] or len(text) < 2:
+                    return
+                fired["done"] = True
+                victim = int(served.rsplit("-", 1)[1])
+                runner, runners[victim] = runners[victim], None
+                await runner.cleanup()
+                engines[victim].shutdown()
+
+            migrated_text, migrated_finish = await stream_chat(chaos)
+            assert fired["done"], "kill never fired"
+            assert migrated_text == baseline_text
+            assert migrated_finish == "length"
+            counters = (
+                await (await client.get("/router/state")).json()
+            )["counters"]
+            assert (
+                counters.get("requests.chat.migrated_completed") == 1
+            ), counters
+        finally:
+            await _teardown(client, runners, engines)
+
+    _run(go())
+
+
+def test_router_soak_smoke(model_dir):
+    """2-cycle --replicas smoke of tools/chaos_soak.py (one kill cycle,
+    one drain cycle, background load): zero lost admitted work, zero
+    token mismatches, bounded client stall."""
+    from tools.chaos_soak import run_router_soak
+
+    report = run_router_soak(
+        replicas=2, cycles=2, load_concurrency=2
+    )
+    assert report["bounded"], report
+    assert report["lost"] == 0 and report["mismatches"] == 0
+    assert report["migrations"] >= 1, report

@@ -393,6 +393,319 @@ def run_soak(
                         os.environ[k] = v
 
 
+# ---------------------------------------------------------------------
+# Router soak (ISSUE 10): kill/drain replicas BEHIND the router under
+# load and assert zero lost admitted work + bounded client stall.
+# ---------------------------------------------------------------------
+ROUTER_AGENT_ENV = {
+    "VDT_MOCK_TOKEN_SEQ": "1",
+    "VDT_MOCK_EXECUTE_SLEEP_SECONDS": "0.03",
+}
+
+
+def run_router_soak(
+    replicas: int = 2,
+    cycles: int = 4,
+    *,
+    max_tokens: int = 14,
+    kill_after_tokens: int = 3,
+    load_concurrency: int = 3,
+    policy: str = "least_loaded",
+    stall_bound_s: float = 15.0,
+) -> dict:
+    """N mock uniproc replicas behind the router; each cycle kills
+    (even cycles) or drains (odd cycles) the replica serving a
+    mid-stream victim request while background load runs, then revives
+    it.  Every admitted stream must complete with the mock worker's
+    exact position-token sequence (VDT_MOCK_TOKEN_SEQ) — a migration
+    that drops, duplicates, or restarts tokens is a mismatch — and the
+    client-visible stall across the migration must stay bounded.
+
+    Mutates (and restores) os.environ; call from a dedicated process or
+    a test that tolerates env churn."""
+    import asyncio
+
+    from tests.mock_worker import MockUniProcExecutor
+    from vllm_distributed_tpu.config import EngineArgs
+    from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+    from vllm_distributed_tpu.entrypoints.openai.api_server import (
+        build_app,
+        init_app_state,
+        serve_http,
+    )
+    from vllm_distributed_tpu.router.app import (
+        RouterState,
+        build_router_app,
+    )
+    from vllm_distributed_tpu.testing import write_llama_config
+    from vllm_distributed_tpu.utils import get_open_port
+
+    saved = {k: os.environ.get(k) for k in ROUTER_AGENT_ENV}
+    os.environ.update(ROUTER_AGENT_ENV)
+    tmpdir = tempfile.mkdtemp(prefix="vdt_router_soak_")
+    model_dir = write_llama_config(os.path.join(tmpdir, "m"))
+    prompt = [1, 2, 3]
+    expected = list(range(len(prompt), len(prompt) + max_tokens))
+
+    def mk_engine() -> AsyncLLM:
+        return AsyncLLM.from_engine_args(
+            EngineArgs(
+                model=model_dir,
+                skip_tokenizer_init=True,
+                load_format="dummy",
+                num_kv_pages=128,
+                max_model_len=256,
+                num_decode_steps=1,
+                distributed_executor_backend=MockUniProcExecutor,
+            )
+        )
+
+    stats = {
+        "admitted": 0,
+        "completed": 0,
+        "mismatches": 0,
+        "lost": 0,  # admitted but never finished (the contract breach)
+        "rejected": 0,
+    }
+    stalls: list[float] = []
+
+    async def go() -> dict:
+        import aiohttp
+
+        engines: list = [mk_engine() for _ in range(replicas)]
+        ports = [get_open_port() for _ in range(replicas)]
+        runners: list = [None] * replicas
+
+        async def start_replica(i: int) -> None:
+            state = init_app_state(
+                engines[i],
+                served_model_name="router-soak",
+                replica_id=f"replica-{i}",
+            )
+            # Tiny shutdown_timeout: "kill" must sever live streams,
+            # not wait them out.
+            for attempt in range(50):
+                try:
+                    runners[i] = await serve_http(
+                        build_app(state),
+                        host="127.0.0.1",
+                        port=ports[i],
+                        shutdown_timeout=0.05,
+                    )
+                    return
+                except OSError:
+                    # The killed predecessor's socket may linger a beat.
+                    await asyncio.sleep(0.1)
+            raise RuntimeError(f"could not rebind replica {i}")
+
+        for i in range(replicas):
+            await start_replica(i)
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+        router_state = RouterState(
+            urls,
+            policy=policy,
+            health_interval=0.3,
+            connect_timeout=2,
+            read_timeout=30,
+        )
+        router_port = get_open_port()
+        router_runner = await serve_http(
+            build_router_app(router_state),
+            host="127.0.0.1",
+            port=router_port,
+        )
+        router_url = f"http://127.0.0.1:{router_port}"
+        timeout = aiohttp.ClientTimeout(total=None, sock_read=60)
+
+        async def one_stream(
+            session, tag: str, on_tokens=None, served: dict | None = None
+        ) -> None:
+            """Drive one streaming completion through the router; assert
+            the exact token sequence.  ``on_tokens(count)`` fires as
+            tokens arrive (the victim uses it to trigger the kill);
+            ``served`` receives the serving replica id so the chaos
+            targets the replica actually holding the stream."""
+            body = {
+                "prompt": list(prompt),
+                "max_tokens": max_tokens,
+                "temperature": 0.0,
+                "ignore_eos": True,
+                "stream": True,
+            }
+            try:
+                async with session.post(
+                    f"{router_url}/v1/completions",
+                    json=body,
+                    headers={"X-VDT-Router": "1"},
+                    timeout=timeout,
+                ) as resp:
+                    if resp.status == 429:
+                        stats["rejected"] += 1
+                        return
+                    if resp.status != 200:
+                        stats["lost"] += 1
+                        return
+                    if served is not None:
+                        served["id"] = resp.headers.get(
+                            "X-VDT-Replica-Id", ""
+                        )
+                    stats["admitted"] += 1
+                    toks: list[int] = []
+                    finished = False
+                    last = time.monotonic()
+                    worst_gap = 0.0
+                    async for raw in resp.content:
+                        line = raw.decode().strip()
+                        if not line.startswith("data:"):
+                            continue
+                        payload = line[5:].strip()
+                        if payload == "[DONE]":
+                            finished = True
+                            break
+                        obj = json.loads(payload)
+                        if "error" in obj and not obj.get("choices"):
+                            break  # router gave up: lost work
+                        now = time.monotonic()
+                        worst_gap = max(worst_gap, now - last)
+                        last = now
+                        for ch in obj.get("choices") or ():
+                            toks += ch.get("vdt_token_ids") or []
+                        if on_tokens is not None:
+                            await on_tokens(len(toks))
+                    stalls.append(worst_gap)
+                    if not finished:
+                        stats["lost"] += 1
+                    elif toks != expected:
+                        stats["mismatches"] += 1
+                        print(
+                            f"{tag}: TOKEN MISMATCH {toks} != {expected}",
+                            file=sys.stderr,
+                        )
+                    else:
+                        stats["completed"] += 1
+            except Exception as e:  # noqa: BLE001 — an admitted stream erroring out IS lost work
+                stats["lost"] += 1
+                print(f"{tag}: stream error {e}", file=sys.stderr)
+
+        async def cycle(n: int) -> None:
+            mode = "drain" if n % 2 else "kill"
+            fired = asyncio.Event()
+            served: dict = {}
+            killed: dict = {}
+
+            async def trigger(count: int) -> None:
+                # Kill/drain the replica ACTUALLY serving the victim
+                # stream (the X-VDT-Replica-Id the router echoed).
+                if fired.is_set() or count < kill_after_tokens:
+                    return
+                fired.set()
+                victim = int(served["id"].rsplit("-", 1)[1])
+                killed["index"] = victim
+                if mode == "kill":
+                    runner, runners[victim] = runners[victim], None
+                    await runner.cleanup()
+                    engines[victim].shutdown()
+                else:
+                    async with session.post(
+                        f"{urls[victim]}/drain",
+                        params={"timeout": "0"},
+                        timeout=aiohttp.ClientTimeout(total=30),
+                    ) as dr:
+                        await dr.read()
+
+            loaders = [
+                one_stream(session, f"cycle{n}-load{j}")
+                for j in range(load_concurrency)
+            ]
+            await asyncio.wait_for(
+                asyncio.gather(
+                    one_stream(
+                        session, f"cycle{n}-victim", trigger, served
+                    ),
+                    *loaders,
+                ),
+                timeout=120,
+            )
+            # Revive the victim for the next cycle (a drained engine
+            # stays up but rejects admission, so it is swapped for a
+            # fresh one either way — the restart a deployment would do).
+            victim = killed.get("index")
+            if victim is None:
+                return
+            runner, runners[victim] = runners[victim], None
+            if runner is not None:
+                await runner.cleanup()
+            try:
+                engines[victim].shutdown()
+            except Exception:  # noqa: BLE001 — already-dead engine
+                pass
+            engines[victim] = mk_engine()
+            await start_replica(victim)
+            # Let the health poll re-admit the revived replica.
+            await asyncio.sleep(0.5)
+
+        async with aiohttp.ClientSession() as session:
+            # Warm-up sanity stream before any chaos.
+            await asyncio.wait_for(
+                one_stream(session, "warmup"), timeout=60
+            )
+            for n in range(cycles):
+                await cycle(n)
+            async with session.get(
+                f"{router_url}/router/state",
+                timeout=aiohttp.ClientTimeout(total=10),
+            ) as resp:
+                router_counters = (await resp.json())["counters"]
+        await router_runner.cleanup()
+        for runner in runners:
+            if runner is not None:
+                await runner.cleanup()
+        for engine in engines:
+            try:
+                engine.shutdown()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        return router_counters
+
+    try:
+        router_counters = (
+            asyncio.new_event_loop().run_until_complete(go())
+        )
+        migrations = sum(
+            v
+            for k, v in router_counters.items()
+            if k.startswith("migrations.")
+        )
+        report = {
+            "mode": "router",
+            "replicas": replicas,
+            "cycles": cycles,
+            "policy": policy,
+            **stats,
+            "migrations": migrations,
+            "router_counters": router_counters,
+            "stall_seconds": {
+                "p50": round(_percentile(stalls, 0.5), 3),
+                "max": round(max(stalls), 3) if stalls else 0.0,
+            },
+            # The acceptance contract: no admitted stream lost or
+            # corrupted, and the worst client-visible stall (which
+            # includes the migration) stays bounded.
+            "bounded": (
+                stats["lost"] == 0
+                and stats["mismatches"] == 0
+                and (not stalls or max(stalls) <= stall_bound_s)
+            ),
+        }
+        return report
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--cycles", type=int, default=5)
@@ -413,7 +726,35 @@ def main() -> None:
         default=8,
         help="VDT_MAX_WAITING_REQUESTS for the overload phase",
     )
+    parser.add_argument(
+        "--replicas",
+        type=int,
+        default=1,
+        help="ISSUE 10 router mode: run this many mock replicas behind "
+        "the router and kill/drain them under load, asserting zero "
+        "lost admitted work and bounded client stall (1 = classic "
+        "single-engine kill-recover soak)",
+    )
+    parser.add_argument(
+        "--router-policy",
+        type=str,
+        default="least_loaded",
+        choices=["affinity", "least_loaded", "round_robin"],
+        help="router placement policy for --replicas mode",
+    )
     args = parser.parse_args()
+    if args.replicas > 1:
+        report = run_router_soak(
+            replicas=args.replicas,
+            cycles=args.cycles,
+            max_tokens=args.max_tokens,
+            kill_after_tokens=args.kill_after_tokens,
+            policy=args.router_policy,
+        )
+        print(json.dumps(report))
+        if not report["bounded"]:
+            sys.exit(1)
+        return
     report = run_soak(
         cycles=args.cycles,
         max_tokens=args.max_tokens,

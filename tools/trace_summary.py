@@ -21,8 +21,12 @@ import sys
 import urllib.request
 
 # Stages printed first, in pipeline order; any other span names found in
-# the dump follow alphabetically.
+# the dump follow alphabetically.  The router hop (ISSUE 10) sits above
+# the replica's api.request: the router forwards its trace context in
+# X-VDT-Trace-Id, so a dump merged from the router's and the replica's
+# /debug/traces shows the whole path under one trace id.
 _STAGE_ORDER = [
+    "router.request",
     "api.request",
     "engine.queue",
     "engine.prefill",

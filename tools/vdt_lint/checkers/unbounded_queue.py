@@ -71,7 +71,7 @@ class UnboundedQueueChecker(Checker):
         "memory growth and tail latency instead of load shedding; "
         "bound it, or waive with what bounds it upstream"
     )
-    scope = ("engine/", "entrypoints/", "distributed/")
+    scope = ("engine/", "entrypoints/", "distributed/", "router/")
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
         for node in ast.walk(ctx.tree):

@@ -208,7 +208,17 @@ class UnboundedWaitChecker(Checker):
         "an unbounded wait turns a silent host into a wedged driver; "
         "every control-plane wait needs a deadline"
     )
-    scope = ("distributed/", "executor/", "worker/", "engine/supervisor.py")
+    scope = (
+        "distributed/",
+        "executor/",
+        "worker/",
+        "engine/supervisor.py",
+        # ISSUE 10: the router IS a control plane over replicas — a
+        # silently dead backend must trigger migration, never a wedged
+        # client stream (Llumnix-style migration is only safe on a
+        # deadline-disciplined control plane).
+        "router/",
+    )
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
         visitor = _Visitor(self, ctx)

@@ -373,6 +373,100 @@ class EngineConfig:
 
 
 @dataclass
+class RouterArgs:
+    """CLI-buildable config for the multi-replica router front-end
+    (ISSUE 10, router/app.py) — `vdt router`.  None fields resolve late
+    from the VDT_ROUTER_* env registry so every knob works on both the
+    CLI and the programmatic path."""
+
+    replicas: list[str] = field(default_factory=list)
+    policy: str | None = None  # affinity | least_loaded | round_robin
+    max_migrations: int | None = None
+    affinity_block_tokens: int | None = None
+    affinity_capacity: int | None = None
+    affinity_min_tokens: int | None = None
+    health_interval: float | None = None
+    connect_timeout: float | None = None
+    read_timeout: float | None = None
+    api_key: str | None = None
+
+    @staticmethod
+    def add_cli_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+        parser.add_argument(
+            "--replica",
+            dest="replicas",
+            action="append",
+            default=None,
+            metavar="URL",
+            help="replica base URL (repeatable); defaults to "
+            "$VDT_ROUTER_REPLICAS (comma-separated)",
+        )
+        parser.add_argument(
+            "--policy",
+            type=str,
+            default=None,
+            choices=["affinity", "least_loaded", "round_robin"],
+            help="placement policy (default: $VDT_ROUTER_POLICY or "
+            "affinity)",
+        )
+        parser.add_argument(
+            "--max-migrations",
+            type=int,
+            default=None,
+            help="live migrations allowed per request (default: "
+            "$VDT_ROUTER_MAX_MIGRATIONS or 3)",
+        )
+        parser.add_argument(
+            "--affinity-block-tokens", type=int, default=None,
+            help="prefix-chain block size in tokens (default: "
+            "$VDT_ROUTER_AFFINITY_BLOCK_TOKENS or 16; match the engine "
+            "page size)",
+        )
+        parser.add_argument(
+            "--affinity-capacity", type=int, default=None,
+            help="blocks remembered per replica, LRU beyond (default: "
+            "$VDT_ROUTER_AFFINITY_CAPACITY or 8192)",
+        )
+        parser.add_argument(
+            "--affinity-min-tokens", type=int, default=None,
+            help="matched tokens before affinity outranks least-loaded "
+            "(default: $VDT_ROUTER_AFFINITY_MIN_TOKENS or 16)",
+        )
+        parser.add_argument(
+            "--health-interval", type=float, default=None,
+            help="replica health-poll interval in seconds (default: "
+            "$VDT_ROUTER_HEALTH_INTERVAL_SECONDS or 2)",
+        )
+        parser.add_argument(
+            "--connect-timeout", type=float, default=None,
+            help="router→replica TCP connect deadline in seconds "
+            "(default: $VDT_ROUTER_CONNECT_TIMEOUT_SECONDS or 5)",
+        )
+        parser.add_argument(
+            "--read-timeout", type=float, default=None,
+            help="router→replica per-read (SSE) deadline in seconds — "
+            "bounds how long a silent replica stalls a stream before "
+            "migration (default: $VDT_ROUTER_READ_TIMEOUT_SECONDS or "
+            "600)",
+        )
+        return parser
+
+    @classmethod
+    def from_cli_args(cls, args: argparse.Namespace) -> "RouterArgs":
+        attrs = [f.name for f in dataclasses.fields(cls)]
+        kwargs = {a: getattr(args, a) for a in attrs if hasattr(args, a)}
+        if kwargs.get("replicas") is None:
+            kwargs["replicas"] = []
+        return cls(**kwargs)
+
+    def resolved_replicas(self) -> list[str]:
+        urls = [u.rstrip("/") for u in self.replicas if u]
+        if not urls:
+            urls = list(envs.VDT_ROUTER_REPLICAS)
+        return urls
+
+
+@dataclass
 class EngineArgs:
     """CLI-buildable engine args (parity: AsyncEngineArgs.from_cli_args,
     launch.py:29, 399)."""

@@ -547,6 +547,16 @@ class AsyncLLM:
     def drain_state_name(self) -> str:
         return self._admission.drain_state_name
 
+    def register_resumable(self, entry: JournalEntry) -> None:
+        """Live-migration intake (router/): register a journal entry
+        another replica (or the router's own journal) handed off, so the
+        next ``generate()`` with the same request id resumes it with the
+        already-delivered tokens restored as output state — the same
+        preemption-resume path a drain-journal pickup takes.  Bypasses
+        admission caps: migrated work was already admitted somewhere,
+        and dropping it would violate the zero-lost-work contract."""
+        self._resumable[entry.request_id] = entry
+
     def resumable_request_ids(self) -> list[str]:
         """Request ids a previous process drained into the journal; a
         router (ROADMAP item 1) re-drives each through generate() to

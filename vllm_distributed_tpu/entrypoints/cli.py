@@ -75,6 +75,24 @@ def make_parser() -> argparse.ArgumentParser:
     remote.add_argument("server_ip", type=str)
     remote.add_argument("--server-port", type=int, default=None)
 
+    router = sub.add_parser(
+        "router",
+        help="multi-replica front-end: cache-affinity placement + live "
+        "request migration over N `vdt serve` replicas",
+    )
+    router.add_argument("--host", type=str, default="0.0.0.0")
+    router.add_argument("--port", type=int, default=8080)
+    router.add_argument(
+        "--api-key",
+        type=str,
+        default=None,
+        help="require 'Authorization: Bearer <key>' on API endpoints "
+        "(forwarded verbatim to replicas)",
+    )
+    from vllm_distributed_tpu.config import RouterArgs
+
+    RouterArgs.add_cli_args(router)
+
     bench = sub.add_parser(
         "bench",
         help="latency/throughput bench (offline) or serve (live HTTP)",
@@ -105,6 +123,15 @@ def make_parser() -> argparse.ArgumentParser:
         type=int,
         default=None,
         help="serve mode: per-request deadline sent with every request",
+    )
+    bench.add_argument(
+        "--shared-prefix-len",
+        type=int,
+        default=0,
+        help="serve mode: prepend this many SHARED prompt tokens to "
+        "every request (router affinity A/B workload: with "
+        "--enable-prefix-caching replicas, affinity routing should "
+        "show a higher vllm:prefix_cache_hits rate than round_robin)",
     )
     EngineArgs.add_cli_args(bench)
 
@@ -165,6 +192,8 @@ async def _serve_async(args: argparse.Namespace) -> None:
                 chat_template = f.read()
         else:
             chat_template = args.chat_template
+    from vllm_distributed_tpu import envs
+
     state = init_app_state(
         engine,
         served_model_name=args.served_model_name,
@@ -172,6 +201,9 @@ async def _serve_async(args: argparse.Namespace) -> None:
         enable_auto_tool_choice=args.enable_auto_tool_choice,
         chat_template=chat_template,
         api_key=args.api_key,
+        # Stable replica identity (ISSUE 10 satellite): operator-pinned
+        # via VDT_REPLICA_ID, else this server's host:port.
+        replica_id=envs.VDT_REPLICA_ID or f"{args.host}:{args.port}",
     )
     app = build_app(state)
     runner = await serve_http(
@@ -240,6 +272,69 @@ def cmd_remote(args: argparse.Namespace) -> None:
     remote_main(args.server_ip, args.server_port)
 
 
+# ---- router ----
+async def _router_async(args: argparse.Namespace) -> None:
+    from vllm_distributed_tpu.config import RouterArgs
+    from vllm_distributed_tpu.entrypoints.openai.api_server import (
+        serve_http,
+    )
+    from vllm_distributed_tpu.router.app import (
+        RouterState,
+        build_router_app,
+    )
+    from vllm_distributed_tpu.tracing import configure_from_env
+
+    configure_from_env(host="router")
+    router_args = RouterArgs.from_cli_args(args)
+    urls = router_args.resolved_replicas()
+    if not urls:
+        raise SystemExit(
+            "router needs replicas: pass --replica URL (repeatable) or "
+            "set VDT_ROUTER_REPLICAS"
+        )
+    state = RouterState(
+        urls,
+        policy=router_args.policy,
+        max_migrations=router_args.max_migrations,
+        affinity_block_tokens=router_args.affinity_block_tokens,
+        affinity_capacity=router_args.affinity_capacity,
+        affinity_min_tokens=router_args.affinity_min_tokens,
+        health_interval=router_args.health_interval,
+        connect_timeout=router_args.connect_timeout,
+        read_timeout=router_args.read_timeout,
+        api_key=args.api_key,
+    )
+    app = build_router_app(state)
+    runner = await serve_http(app, host=args.host, port=args.port)
+    logger.info(
+        "router fronting %d replica(s) with policy=%s: %s",
+        len(urls),
+        state.policy,
+        ", ".join(urls),
+    )
+    stop = asyncio.Event()
+
+    def _on_signal() -> None:
+        stop.set()
+
+    import signal
+
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, _on_signal)
+        except (NotImplementedError, RuntimeError):
+            pass
+    try:
+        await stop.wait()
+    finally:
+        await runner.cleanup()
+
+
+def cmd_router(args: argparse.Namespace) -> None:
+    asyncio.run(_router_async(args))
+
+
 # ---- bench ----
 def _percentiles(xs: list[float]) -> dict:
     xs = sorted(xs)
@@ -283,6 +378,13 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
             "vllm:generation_tokens_total",
             "vllm:pipeline_breaks_total",
             "vllm:requests_rejected_total",
+            # Router affinity A/B (ISSUE 10): the hit-rate delta between
+            # --shared-prefix-len runs under affinity vs round_robin
+            # routing is the placement-quality signal.  Scraping the
+            # router sums these across replicas (the merged exposition
+            # keeps per-replica labels; the sum is what A/B needs).
+            "vllm:prefix_cache_queries_total",
+            "vllm:prefix_cache_hits_total",
         }
         out = {}
         for line in text.splitlines():
@@ -294,9 +396,14 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                 out[key] = out.get(key, 0.0) + float(parts[1])
         return out
 
+    shared_prefix_len = getattr(args, "shared_prefix_len", 0) or 0
+    shared_prefix = [(7 * j) % 900 + 1 for j in range(shared_prefix_len)]
+
     async def drive_one(session, i: int) -> None:
         nonlocal out_tokens
-        prompt = [(13 * i + j) % 900 + 1 for j in range(args.input_len)]
+        prompt = shared_prefix + [
+            (13 * i + j) % 900 + 1 for j in range(args.input_len)
+        ]
         body = {
             "model": args.model or "bench",
             "prompt": prompt,
@@ -467,6 +574,18 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
             # should match the client's rejected outcome.
             "requests_rejected": delta("vllm:requests_rejected_total"),
         }
+        queries = delta("vllm:prefix_cache_queries_total")
+        hits = delta("vllm:prefix_cache_hits_total")
+        if queries > 0:
+            # The affinity A/B readout: run once with the router in
+            # affinity mode and once in round_robin; the shared-prefix
+            # workload should show a higher hit rate under affinity.
+            result["server_metrics"]["prefix_cache_hit_rate"] = round(
+                hits / queries, 4
+            )
+            result["server_metrics"]["prefix_cache_hits"] = hits
+        if shared_prefix_len:
+            result["shared_prefix_len"] = shared_prefix_len
         # Engine-side pipeline flushes over the run window: the serve
         # analogue of the microbench's stall_windows (0 = the async
         # scheduler never had to drain and re-plan mid-run).
@@ -662,6 +781,8 @@ def main(argv: list[str] | None = None) -> None:
         cmd_serve(args)
     elif args.command == "remote":
         cmd_remote(args)
+    elif args.command == "router":
+        cmd_router(args)
     elif args.command == "bench":
         cmd_bench(args)
     elif args.command == "collect-env":

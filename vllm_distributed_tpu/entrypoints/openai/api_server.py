@@ -71,6 +71,18 @@ TRACE_HEADER = "X-VDT-Trace-Id"
 # arrival (the deadline_ms body field wins when both are present).
 DEADLINE_HEADER = "X-VDT-Deadline-Ms"
 
+# Stable identity of this serving replica (VDT_REPLICA_ID, default
+# host:port), stamped on every response so a router/bench/log reader can
+# attribute behavior per replica (ISSUE 10 satellite).
+REPLICA_HEADER = "X-VDT-Replica-Id"
+
+# Internal hop marker set by the multi-replica router (router/): when
+# present, streaming chunks carry per-choice ``vdt_token_ids`` (and
+# ``vdt_prompt_token_ids`` on the first chunk) so the router can journal
+# emitted tokens for live migration and feed its prefix-affinity index.
+# The router strips these fields before the client sees them.
+ROUTER_HEADER = "X-VDT-Router"
+
 
 @dataclass
 class ServerState:
@@ -81,6 +93,7 @@ class ServerState:
     enable_auto_tool_choice: bool = False
     chat_template: str | None = None
     api_key: str | None = None
+    replica_id: str = ""
     request_counter: Counter = field(default_factory=Counter)
     metrics: Any = None
 
@@ -110,18 +123,52 @@ async def auth_middleware(request: web.Request, handler):
 
 
 @web.middleware
+async def replica_middleware(request: web.Request, handler):
+    """Stamp X-VDT-Replica-Id on every unprepared response (streamed
+    responses add it to their own headers before prepare())."""
+    response = await handler(request)
+    state: ServerState = request.app["state"]
+    if state.replica_id and not response.prepared:
+        response.headers.setdefault(REPLICA_HEADER, state.replica_id)
+    return response
+
+
+def _parent_ctx(request: web.Request) -> tuple | None:
+    """Incoming trace context from the router hop (ISSUE 10 satellite):
+    the router forwards ``X-VDT-Trace-Id: <trace_id>-<span_id>`` so this
+    replica's spans parent under the router's root span and the whole
+    request shares one trace id across processes."""
+    header = request.headers.get(TRACE_HEADER)
+    if not header:
+        return None
+    trace_id, _, span_id = header.partition("-")
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16)
+        int(span_id, 16)
+    except ValueError:
+        return None
+    return (trace_id, span_id)
+
+
+@web.middleware
 async def trace_middleware(request: web.Request, handler):
     """Root span per API request (tracing.py).  The trace id is echoed
     in the X-VDT-Trace-Id response header; handlers pick the context up
     from ``request['trace_ctx']`` and thread it through the engine so
-    queue/prefill/decode/RPC spans share the trace.  With tracing off
-    this is one attribute read per request."""
+    queue/prefill/decode/RPC spans share the trace.  A request arriving
+    from the router carries a parent context in the same header, and the
+    span parents under it instead of rooting a new trace.  With tracing
+    off this is one attribute read per request."""
     tracer = get_tracer()
     if not tracer.enabled or request.path in _UNTRACED:
         return await handler(request)
+    parent = _parent_ctx(request)
     with tracer.span(
         "api.request",
-        trace_root=True,
+        parent=parent,
+        trace_root=parent is None,
         method=request.method,
         path=request.path,
     ) as span:
@@ -325,7 +372,10 @@ async def health(request: web.Request) -> web.Response:
             status=503,
             headers={"Retry-After": str(envs.VDT_RETRY_AFTER_SECONDS)},
         )
-    return web.Response(status=200)
+    body = {"status": "ok"}
+    if state.replica_id:
+        body["replica_id"] = state.replica_id
+    return web.json_response(body)
 
 
 async def version(request: web.Request) -> web.Response:
@@ -491,6 +541,8 @@ async def _stream_chat(
     trace_ctx = request.get("trace_ctx")
     if trace_ctx is not None:
         headers[TRACE_HEADER] = trace_ctx[0]
+    if state.replica_id:
+        headers[REPLICA_HEADER] = state.replica_id
     response = web.StreamResponse(headers=headers)
     await response.prepare(request)
 
@@ -500,6 +552,9 @@ async def _stream_chat(
         )
         await response.write(f"data: {payload}\n\n".encode())
 
+    # Router hop (ISSUE 10): chunks carry vdt_token_ids metadata so the
+    # router can journal emitted tokens for live migration.
+    router_meta = request.headers.get(ROUTER_HEADER) == "1"
     include_usage = bool(
         (req.stream_options or {}).get("include_usage", False)
     )
@@ -516,6 +571,7 @@ async def _stream_chat(
     async def stream_one(i: int) -> None:
         first = True
         sent = 0
+        sent_toks = 0
         finish = None
         stream_parser = (
             ToolParserManager.get(state.tool_call_parser).streaming()
@@ -524,19 +580,22 @@ async def _stream_chat(
         )
         sent_tool_deltas = False
 
-        async def emit(delta: ChatDelta, finish_reason=None) -> None:
-            await send(
-                ChatCompletionStreamResponse(
-                    id=request_id,
-                    model=state.model_name,
-                    choices=[
-                        ChatStreamChoice(
-                            index=i, delta=delta,
-                            finish_reason=finish_reason,
-                        )
-                    ],
-                )
-            )
+        async def emit(
+            delta: ChatDelta, finish_reason=None, meta: dict | None = None
+        ) -> None:
+            payload = ChatCompletionStreamResponse(
+                id=request_id,
+                model=state.model_name,
+                choices=[
+                    ChatStreamChoice(
+                        index=i, delta=delta,
+                        finish_reason=finish_reason,
+                    )
+                ],
+            ).model_dump(exclude_none=True)
+            if meta:
+                payload["choices"][0].update(meta)
+            await send(json.dumps(payload))
 
         async for out in state.engine.generate(
             f"{request_id}-{i}",
@@ -548,6 +607,8 @@ async def _stream_chat(
             comp = out.outputs[0]
             delta_text = comp.text[sent:]
             sent = len(comp.text)
+            new_ids = list(comp.token_ids[sent_toks:])
+            sent_toks = len(comp.token_ids)
             finish = comp.finish_reason
             tool_deltas: list[dict] = []
             if stream_parser is not None:
@@ -559,15 +620,24 @@ async def _stream_chat(
                 sent_tool_deltas |= bool(tool_deltas)
             if comp.finished and sent_tool_deltas:
                 finish = "tool_calls"
-            if first or delta_text or tool_deltas or comp.finished:
+            if first or delta_text or tool_deltas or comp.finished or (
+                router_meta and new_ids
+            ):
                 delta = ChatDelta(
                     role="assistant" if first else None,
                     content=delta_text or ("" if first else None),
                     tool_calls=tool_deltas or None,
                 )
+                meta = None
+                if router_meta:
+                    meta = {"vdt_token_ids": new_ids}
+                    if first:
+                        meta["vdt_prompt_token_ids"] = list(
+                            out.prompt_token_ids
+                        )
                 first = False
                 await emit(
-                    delta, finish if comp.finished else None
+                    delta, finish if comp.finished else None, meta
                 )
             if comp.finished:
                 usage.prompt_tokens += len(out.prompt_token_ids)
@@ -595,7 +665,16 @@ async def _stream_chat(
             )
         )
     except (EngineDeadError, ValueError) as e:
-        await send(json.dumps({"error": str(e)}))
+        # The code tells a fronting router whether this is migratable
+        # (503: the backend died, replay elsewhere) or final (400).
+        await send(
+            json.dumps(
+                {
+                    "error": str(e),
+                    "code": 503 if isinstance(e, EngineDeadError) else 400,
+                }
+            )
+        )
     except (ConnectionResetError, asyncio.CancelledError):
         logger.info("client disconnected from %s", request_id)
     await response.write_eof()
@@ -744,6 +823,8 @@ async def _stream_completion(
     trace_ctx = request.get("trace_ctx")
     if trace_ctx is not None:
         headers[TRACE_HEADER] = trace_ctx[0]
+    if state.replica_id:
+        headers[REPLICA_HEADER] = state.replica_id
     response = web.StreamResponse(headers=headers)
     await response.prepare(request)
 
@@ -751,6 +832,7 @@ async def _stream_completion(
         await response.write(f"data: {payload}\n\n".encode())
 
     no_tokenizer = state.engine.tokenizer is None
+    router_meta = request.headers.get(ROUTER_HEADER) == "1"
     include_usage = bool(
         (req.stream_options or {}).get("include_usage", False)
     )
@@ -759,6 +841,7 @@ async def _stream_completion(
     async def stream_one(choice_idx: int, text, ids) -> None:
         sent = 0
         sent_toks = 0
+        first = True
         async for out in state.engine.generate(
             f"{request_id}-{choice_idx}",
             prompt=text,
@@ -769,7 +852,7 @@ async def _stream_completion(
             comp = out.outputs[0]
             delta = comp.text[sent:]
             sent = len(comp.text)
-            new_toks = len(comp.token_ids) - sent_toks
+            new_ids = list(comp.token_ids[sent_toks:])
             sent_toks = len(comp.token_ids)
             if comp.finished:
                 usage.prompt_tokens += len(out.prompt_token_ids)
@@ -777,7 +860,9 @@ async def _stream_completion(
             # Without a tokenizer (dummy-weight serving/benches) there is
             # no text to delta — stream empty chunks on token arrival so
             # SSE timing still reflects token delivery.
-            if delta or comp.finished or (no_tokenizer and new_toks):
+            if delta or comp.finished or (
+                new_ids and (no_tokenizer or router_meta)
+            ):
                 chunk = CompletionResponse(
                     id=request_id,
                     model=state.model_name,
@@ -790,10 +875,15 @@ async def _stream_completion(
                             ),
                         )
                     ],
-                )
-                await send_json(
-                    json.dumps(chunk.model_dump(exclude_none=True))
-                )
+                ).model_dump(exclude_none=True)
+                if router_meta:
+                    chunk["choices"][0]["vdt_token_ids"] = new_ids
+                    if first:
+                        chunk["choices"][0]["vdt_prompt_token_ids"] = list(
+                            out.prompt_token_ids
+                        )
+                first = False
+                await send_json(json.dumps(chunk))
 
     try:
         tasks = []
@@ -822,7 +912,15 @@ async def _stream_completion(
             )
         )
     except (EngineDeadError, ValueError) as e:
-        await send_json(json.dumps({"error": str(e)}))
+        # 503 = backend death (a router live-migrates), 400 = final.
+        await send_json(
+            json.dumps(
+                {
+                    "error": str(e),
+                    "code": 503 if isinstance(e, EngineDeadError) else 400,
+                }
+            )
+        )
     except (ConnectionResetError, asyncio.CancelledError):
         logger.info("client disconnected from %s", request_id)
     await response.write_eof()
@@ -959,11 +1057,151 @@ async def tokenizer_info(request: web.Request) -> web.Response:
     return web.json_response(info)
 
 
+async def internal_resume(request: web.Request) -> web.Response:
+    """Live-migration hand-off target (ISSUE 10, router/).  The router
+    posts one journaled in-flight request — original OpenAI body (for
+    sampling-param parity with the first admission), prompt token ids,
+    and the tokens already delivered to the client — and this replica
+    re-admits it with the emitted tokens restored as OUTPUT tokens (the
+    ``engine/supervisor.py`` JournalEntry preemption-resume semantics,
+    via AsyncLLM.register_resumable), so the continuation's greedy
+    tokens are bit-identical to an unmigrated run.
+
+    The reply is an internal SSE stream, one JSON frame per output:
+    cumulative ``text``, the NEW ``token_ids`` beyond the restored ones
+    (the first frame also carries ``prompt_token_ids``), a final frame
+    with ``finish_reason`` + ``usage``, then ``[DONE]``.  The router
+    converts frames back into client-facing OpenAI chunks.  Logprobs
+    are not journaled or restored — a non-issue for streams (the SSE
+    chunk format never carries logprobs) and non-streaming requests
+    are resubmitted whole, regenerating them."""
+    from vllm_distributed_tpu.engine.supervisor import JournalEntry
+
+    state: ServerState = request.app["state"]
+    try:
+        d = await request.json()
+        kind = d.get("kind", "completions")
+        rid = str(d["request_id"])
+        emitted = [int(t) for t in d.get("emitted_token_ids") or ()]
+        body = d.get("body") or {}
+    except Exception as e:  # noqa: BLE001
+        return _error(f"invalid resume payload: {e}")
+    engine = state.engine
+    if engine.draining:
+        # A draining replica is leaving rotation: accepting a migration
+        # here would just migrate it again moments later.
+        return web.json_response(
+            ErrorResponse(
+                message="replica is draining; not accepting migrations",
+                code=503,
+            ).model_dump(),
+            status=503,
+            headers={"Retry-After": str(envs.VDT_RETRY_AFTER_SECONDS)},
+        )
+    try:
+        if kind == "chat":
+            req = ChatCompletionRequest(**body)
+        else:
+            req = CompletionRequest(**body)
+    except Exception as e:  # noqa: BLE001
+        return _error(f"invalid resume body: {e}")
+    prompt_ids = d.get("prompt_token_ids")
+    if prompt_ids is None:
+        # No ids learned from the dead replica's metadata: re-derive
+        # them locally (deterministic given the shared model/template).
+        tokenizer = engine.tokenizer
+        prompt_text = d.get("prompt")
+        if kind == "chat":
+            prompt_text = _apply_chat_template(state, req)
+        if prompt_text is None or tokenizer is None:
+            return _error(
+                "resume payload carries neither prompt_token_ids nor a "
+                "tokenizable prompt"
+            )
+        prompt_ids = tokenizer.encode(prompt_text)
+    prompt_ids = [int(t) for t in prompt_ids]
+    if len(prompt_ids) >= state.max_model_len:
+        return _error(
+            f"prompt has {len(prompt_ids)} tokens, exceeding "
+            f"max_model_len {state.max_model_len}"
+        )
+    default_max = state.max_model_len - len(prompt_ids) - 1
+    try:
+        params = req.to_sampling_params(default_max, kind == "chat")
+    except ValueError as e:
+        return _error(str(e))
+    err = _apply_deadline(request, params)
+    if err is not None:
+        return err
+    engine.register_resumable(
+        JournalEntry(
+            request_id=rid,
+            prompt=None,
+            prompt_token_ids=prompt_ids,
+            sampling_params=params,
+            emitted_token_ids=emitted,
+            trace_ctx=request.get("trace_ctx"),
+        )
+    )
+
+    headers = {
+        "Content-Type": "text/event-stream",
+        "Cache-Control": "no-cache",
+    }
+    trace_ctx = request.get("trace_ctx")
+    if trace_ctx is not None:
+        headers[TRACE_HEADER] = trace_ctx[0]
+    if state.replica_id:
+        headers[REPLICA_HEADER] = state.replica_id
+    response = web.StreamResponse(headers=headers)
+    await response.prepare(request)
+
+    async def send_frame(obj: dict) -> None:
+        await response.write(f"data: {json.dumps(obj)}\n\n".encode())
+
+    sent_toks = len(emitted)
+    first = True
+    try:
+        async for out in engine.generate(rid, trace_ctx=trace_ctx):
+            comp = out.outputs[0]
+            new_ids = list(comp.token_ids[sent_toks:])
+            sent_toks = len(comp.token_ids)
+            if not (new_ids or comp.finished or first):
+                continue
+            frame: dict = {
+                "text": comp.text,
+                "token_ids": new_ids,
+                "finish_reason": (
+                    comp.finish_reason if comp.finished else None
+                ),
+            }
+            if first:
+                frame["prompt_token_ids"] = list(out.prompt_token_ids)
+                first = False
+            if comp.finished:
+                frame["usage"] = {
+                    "prompt_tokens": len(out.prompt_token_ids),
+                    "completion_tokens": len(comp.token_ids),
+                }
+            await send_frame(frame)
+        await response.write(b"data: [DONE]\n\n")
+    except EngineOverloadedError as e:
+        await send_frame(
+            {"error": str(e), "code": 429, "reason": e.reason}
+        )
+    except (EngineDeadError, ValueError) as e:
+        await send_frame({"error": str(e), "code": 503})
+    except (ConnectionResetError, asyncio.CancelledError):
+        logger.info("router disconnected from resumed %s", rid)
+    await response.write_eof()
+    return response
+
+
 # ---- app assembly ----
 def build_app(state: ServerState) -> web.Application:
     app = web.Application(
         client_max_size=64 * 2**20,
-        middlewares=[auth_middleware, trace_middleware],
+        middlewares=[replica_middleware, auth_middleware, trace_middleware],
     )
     app["state"] = state
     app.router.add_get("/health", health)
@@ -979,6 +1217,7 @@ def build_app(state: ServerState) -> web.Application:
     app.router.add_post("/v1/embeddings", embeddings)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/traces", debug_traces)
+    app.router.add_post("/internal/resume", internal_resume)
     return app
 
 
@@ -990,8 +1229,13 @@ def init_app_state(
     enable_auto_tool_choice: bool = False,
     chat_template: str | None = None,
     api_key: str | None = None,
+    replica_id: str | None = None,
 ) -> ServerState:
     model_config = engine.get_model_config()
+    if replica_id is None:
+        replica_id = envs.VDT_REPLICA_ID
+    if replica_id:
+        engine.metrics.record_replica_info(replica_id)
     return ServerState(
         engine=engine,
         model_name=served_model_name or model_config.model,
@@ -1000,6 +1244,7 @@ def init_app_state(
         enable_auto_tool_choice=enable_auto_tool_choice,
         chat_template=chat_template,
         api_key=api_key,
+        replica_id=replica_id,
     )
 
 
@@ -1009,14 +1254,21 @@ async def serve_http(
     port: int = 8000,
     ssl_certfile: str | None = None,
     ssl_keyfile: str | None = None,
+    shutdown_timeout: float | None = None,
 ) -> web.AppRunner:
-    """Start serving; returns the runner (caller owns shutdown)."""
+    """Start serving; returns the runner (caller owns shutdown).
+    ``shutdown_timeout`` caps how long cleanup() waits for in-flight
+    requests — the router tests/chaos harness pass a tiny value so
+    'kill a replica' means connections actually die mid-stream."""
     ssl_context = None
     if ssl_certfile:
         import ssl as ssl_mod
 
         ssl_context = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_SERVER)
         ssl_context.load_cert_chain(ssl_certfile, ssl_keyfile)
+    runner_kwargs = {}
+    if shutdown_timeout is not None:
+        runner_kwargs["shutdown_timeout"] = shutdown_timeout
     runner = web.AppRunner(
         app,
         keepalive_timeout=envs.VDT_HTTP_TIMEOUT_KEEP_ALIVE,
@@ -1027,6 +1279,7 @@ async def serve_http(
         # engine-side requests (ISSUE 8 satellite; the streaming path
         # already aborted via its write failing).
         handler_cancellation=True,
+        **runner_kwargs,
     )
     await runner.setup()
     site = web.TCPSite(runner, host, port, ssl_context=ssl_context)

@@ -142,6 +142,59 @@ environment_variables: dict[str, Callable[[], Any]] = {
     "VDT_DRAIN_JOURNAL_PATH": lambda: os.environ.get(
         "VDT_DRAIN_JOURNAL_PATH", ""
     ),
+    # --- multi-replica routing (ISSUE 10) ---
+    # Stable identity of this serving replica, surfaced in /health, the
+    # X-VDT-Replica-Id response header, and the vllm:replica_info gauge
+    # so router logs/traces/bench can attribute per-replica behavior.
+    # Empty = derived from the API server's host:port at boot.
+    "VDT_REPLICA_ID": lambda: os.environ.get("VDT_REPLICA_ID", ""),
+    # Router backend set: comma-separated replica base URLs
+    # (e.g. "http://h1:8000,http://h2:8000").  The `vdt router`
+    # --replica flag extends/overrides this.
+    "VDT_ROUTER_REPLICAS": lambda: [
+        u.strip().rstrip("/")
+        for u in os.environ.get("VDT_ROUTER_REPLICAS", "").split(",")
+        if u.strip()
+    ],
+    # Placement policy: "affinity" (prefix-cache affinity, falling back
+    # to least-loaded), "least_loaded", or "round_robin" (the A/B
+    # baseline bench-serve compares against).
+    "VDT_ROUTER_POLICY": lambda: os.environ.get(
+        "VDT_ROUTER_POLICY", "affinity"
+    ),
+    # Replica health-poll interval (seconds); each probe is
+    # deadline-bounded by the connect/read timeouts below.
+    "VDT_ROUTER_HEALTH_INTERVAL_SECONDS": lambda: float(
+        os.environ.get("VDT_ROUTER_HEALTH_INTERVAL_SECONDS", "2")
+    ),
+    # Affinity index granularity: tokens (or ~4-byte text chunks) per
+    # hash-chain block — match the engine page_size so a router block
+    # maps onto one cached KV page.
+    "VDT_ROUTER_AFFINITY_BLOCK_TOKENS": lambda: int(
+        os.environ.get("VDT_ROUTER_AFFINITY_BLOCK_TOKENS", "16")
+    ),
+    # Per-replica cap on remembered prefix blocks (LRU beyond it).
+    "VDT_ROUTER_AFFINITY_CAPACITY": lambda: int(
+        os.environ.get("VDT_ROUTER_AFFINITY_CAPACITY", "8192")
+    ),
+    # Minimum matched tokens before affinity outranks least-loaded
+    # placement (below it the signal is noise, not a warm cache).
+    "VDT_ROUTER_AFFINITY_MIN_TOKENS": lambda: int(
+        os.environ.get("VDT_ROUTER_AFFINITY_MIN_TOKENS", "16")
+    ),
+    # How many times one request may be live-migrated (journal-replayed
+    # onto another replica) before the router gives up on it.
+    "VDT_ROUTER_MAX_MIGRATIONS": lambda: int(
+        os.environ.get("VDT_ROUTER_MAX_MIGRATIONS", "3")
+    ),
+    # Upstream deadlines: TCP connect, and the per-read socket timeout
+    # on proxied (SSE) responses.
+    "VDT_ROUTER_CONNECT_TIMEOUT_SECONDS": lambda: float(
+        os.environ.get("VDT_ROUTER_CONNECT_TIMEOUT_SECONDS", "5")
+    ),
+    "VDT_ROUTER_READ_TIMEOUT_SECONDS": lambda: float(
+        os.environ.get("VDT_ROUTER_READ_TIMEOUT_SECONDS", "600")
+    ),
     # --- observability ---
     # Per-request tracing (tracing.py): default off; the engine step
     # loop runs the no-op tracer path and /debug/traces answers 404.
@@ -229,6 +282,19 @@ NON_REPLICATED_ENV_VARS = {
     # onto remote workers would have every host writing (and on boot,
     # consuming) the same file.
     "VDT_DRAIN_JOURNAL_PATH",
+    # Replica identity and router knobs are per-process: replicating a
+    # replica's id onto its workers (or a router's backend set onto
+    # anything) would be meaningless at best and confusing in logs.
+    "VDT_REPLICA_ID",
+    "VDT_ROUTER_REPLICAS",
+    "VDT_ROUTER_POLICY",
+    "VDT_ROUTER_HEALTH_INTERVAL_SECONDS",
+    "VDT_ROUTER_AFFINITY_BLOCK_TOKENS",
+    "VDT_ROUTER_AFFINITY_CAPACITY",
+    "VDT_ROUTER_AFFINITY_MIN_TOKENS",
+    "VDT_ROUTER_MAX_MIGRATIONS",
+    "VDT_ROUTER_CONNECT_TIMEOUT_SECONDS",
+    "VDT_ROUTER_READ_TIMEOUT_SECONDS",
 }
 
 # Extra vars replicated even though they are not VDT_* (launch.py:70-72).

@@ -91,6 +91,7 @@ DOCUMENTED_METRICS = (
     "vllm:requests_rejected_total",
     "vllm:engine_drain_state",
     "vllm:admission_queued_tokens",
+    "vllm:replica_info",
     "vllm:host_up",
     "vllm:heartbeat_latency_seconds",
     "vllm:engine_dead_info",
@@ -258,6 +259,16 @@ class EngineMetrics:
             "Prompt tokens queued for admission (waiting requests "
             "awaiting (re-)prefill)",
         )
+        # ---- multi-replica identity (ISSUE 10 satellite) ----
+        self._replica_info = Gauge(
+            "vllm:replica_info",
+            "Constant 1; the replica_id label is this serving "
+            "replica's stable identity (VDT_REPLICA_ID, default "
+            "host:port) so multi-replica dashboards can attribute "
+            "series per replica",
+            ["model_name", "replica_id"],
+            registry=self.registry,
+        )
         # ---- control-plane liveness ----
         self._host_up = Gauge(
             "vllm:host_up",
@@ -372,6 +383,13 @@ class EngineMetrics:
             for _ in range(n_after_first):
                 self.itl.observe(per_tok)
         req_metrics.last_token_time_mono = now
+
+    def record_replica_info(self, replica_id: str) -> None:
+        """Publish this replica's stable identity (API-server boot)."""
+        if self.enabled and replica_id:
+            self._replica_info.labels(
+                model_name=self._model_name, replica_id=replica_id
+            ).set(1)
 
     # ---- control-plane liveness hooks (called from the executor's
     # heartbeat loop and the engine failure callback; every caller

@@ -1,0 +1,27 @@
+"""Multi-replica router (ISSUE 10): cache-affinity placement + live
+request migration over journal-replay.  See router/app.py for the
+subsystem overview."""
+
+from vllm_distributed_tpu.router.affinity import PrefixAffinityIndex
+from vllm_distributed_tpu.router.app import (
+    RouterState,
+    build_router_app,
+)
+from vllm_distributed_tpu.router.journal import ChoiceState, RouterJournal
+from vllm_distributed_tpu.router.metrics import (
+    RouterMetrics,
+    merge_expositions,
+)
+from vllm_distributed_tpu.router.pool import Replica, ReplicaPool
+
+__all__ = [
+    "ChoiceState",
+    "PrefixAffinityIndex",
+    "Replica",
+    "ReplicaPool",
+    "RouterJournal",
+    "RouterMetrics",
+    "RouterState",
+    "build_router_app",
+    "merge_expositions",
+]

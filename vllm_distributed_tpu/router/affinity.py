@@ -1,0 +1,120 @@
+"""Router-side prefix-cache affinity index (ISSUE 10 tentpole).
+
+Placement should follow the KV cache (SGLang's cache-aware scheduling,
+Zheng et al. 2024, PAPERS.md): a request whose prompt shares a prefix
+with work a replica recently served hits that replica's prefix cache
+(PR 1) and skips most of its prefill.  The router cannot see replica
+allocators directly, so it mirrors the allocator's own indexing scheme —
+a hash chain over fixed-size prompt blocks (``PrefixCachingAllocator``
+hashes page-aligned token blocks the same way) — over the prompts it has
+routed, per replica, fed from response metadata when the replica
+confirms service.
+
+Prompts arrive in two forms and each gets its own key namespace (they
+must never collide):
+
+- token ids (``t:``): hashed in ``block_tokens``-sized blocks, exactly
+  page-granular when ``block_tokens`` matches the engine page size;
+- text (``s:``): hashed in ``4 * block_tokens``-byte chunks (~4 UTF-8
+  bytes per token), used when the router has no tokenizer — both the
+  observe and score sides use the same chunking, so matching stays
+  consistent even though the block boundary is approximate.
+
+Bounded: each replica remembers at most ``capacity`` block keys, LRU
+beyond that (a router restart simply starts cold).  Single-threaded:
+every call happens on the router's event loop.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+
+_TEXT_BYTES_PER_TOKEN = 4
+
+
+def chain_keys(
+    prompt_text: str | None,
+    prompt_token_ids: list[int] | None,
+    block_tokens: int,
+) -> list[str]:
+    """Hash-chain keys for a prompt, most-significant (longest-prefix)
+    last: key i covers blocks 0..i, so a replica holding keys 0..k has
+    (approximately) the first (k+1) blocks warm."""
+    keys: list[str] = []
+    prev = b""
+    if prompt_token_ids is not None:
+        ns = b"t:"
+        units = [
+            prompt_token_ids[i : i + block_tokens]
+            for i in range(0, len(prompt_token_ids), block_tokens)
+        ]
+        blocks = [
+            ",".join(str(t) for t in u).encode() for u in units
+        ]
+    else:
+        ns = b"s:"
+        data = (prompt_text or "").encode("utf-8", "surrogateescape")
+        step = block_tokens * _TEXT_BYTES_PER_TOKEN
+        blocks = [data[i : i + step] for i in range(0, len(data), step)]
+    for block in blocks:
+        digest = hashlib.sha256(ns + prev + block).digest()
+        keys.append(digest.hex())
+        prev = digest
+    return keys
+
+
+class PrefixAffinityIndex:
+    """Per-replica LRU sets of prefix-chain block keys + longest-prefix
+    scoring over them."""
+
+    def __init__(self, block_tokens: int = 16, capacity: int = 8192):
+        self.block_tokens = max(1, block_tokens)
+        self.capacity = max(1, capacity)
+        # replica_id -> OrderedDict[key -> None], most recent last.
+        self._blocks: dict[str, OrderedDict[str, None]] = {}
+
+    def keys_for(
+        self,
+        prompt_text: str | None = None,
+        prompt_token_ids: list[int] | None = None,
+    ) -> list[str]:
+        return chain_keys(prompt_text, prompt_token_ids, self.block_tokens)
+
+    def observe(self, replica_id: str, keys: list[str]) -> None:
+        """Record that ``replica_id`` served a prompt with this chain
+        (call when the replica confirms service — first token or
+        completed response — so the index tracks caches that exist,
+        not placements that failed)."""
+        blocks = self._blocks.setdefault(replica_id, OrderedDict())
+        for key in keys:
+            if key in blocks:
+                blocks.move_to_end(key)
+            else:
+                blocks[key] = None
+        while len(blocks) > self.capacity:
+            blocks.popitem(last=False)
+
+    def score(self, keys: list[str]) -> dict[str, int]:
+        """Approximate warm-prefix length per replica, in tokens: the
+        number of consecutive leading chain keys the replica holds,
+        times the block size.  Touches matched keys (LRU refresh)."""
+        scores: dict[str, int] = {}
+        for replica_id, blocks in self._blocks.items():
+            matched = 0
+            for key in keys:
+                if key not in blocks:
+                    break
+                blocks.move_to_end(key)
+                matched += 1
+            if matched:
+                scores[replica_id] = matched * self.block_tokens
+        return scores
+
+    def forget(self, replica_id: str) -> None:
+        """Drop a replica's chains (its process died or drained: the
+        KV cache backing them is gone)."""
+        self._blocks.pop(replica_id, None)
+
+    def num_blocks(self, replica_id: str) -> int:
+        return len(self._blocks.get(replica_id, ()))

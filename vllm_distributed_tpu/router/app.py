@@ -1,0 +1,1026 @@
+"""Multi-replica router front-end (ISSUE 10 tentpole).
+
+An OpenAI-compatible aiohttp process that fans requests out over N
+engine replicas (each an independent api_server + mesh slice):
+
+- **Proxy**: ``/v1/completions`` + ``/v1/chat/completions`` with SSE
+  passthrough, ``/v1/models`` from a live replica, aggregated
+  ``/health`` + ``/metrics`` (every replica's exposition re-labeled
+  ``replica="<id>"``), and ``/router/state`` introspection.
+- **Placement**: prefix-cache affinity first (PrefixAffinityIndex over
+  recently served prompts per replica, fed from response metadata —
+  SGLang's cache-aware scheduling), falling back to least-loaded by the
+  PR 7 admission gauges scraped from ``/metrics``; 429s put a replica
+  in Retry-After backoff instead of marking it down.
+- **Live migration** (Llumnix, recompute-based): the router journals
+  each proxied request's prompt + streamed tokens
+  (``router/journal.py``, mirroring the engine JournalEntry), and when
+  the serving replica dies, drains, or sheds the request under
+  pressure, re-submits the journal to a healthy replica over
+  ``/internal/resume`` with the emitted tokens restored — the client's
+  SSE stream continues and greedy outputs are bit-identical to an
+  unmigrated run.
+
+The router deliberately holds no model state: it can restart cold (the
+affinity index refills from traffic) and it never interprets sampling
+params — the original body rides along so the resumed admission is
+parameter-identical to the first one.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import AsyncIterator
+
+from aiohttp import web
+
+from vllm_distributed_tpu import envs
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.router.affinity import PrefixAffinityIndex
+from vllm_distributed_tpu.router.journal import RouterJournal
+from vllm_distributed_tpu.router.metrics import (
+    RouterMetrics,
+    merge_expositions,
+)
+from vllm_distributed_tpu.router.pool import Replica, ReplicaPool
+from vllm_distributed_tpu.tracing import get_tracer
+from vllm_distributed_tpu.utils import Counter
+from vllm_distributed_tpu.version import __version__
+
+logger = init_logger(__name__)
+
+# Wire-protocol headers shared with entrypoints/openai/api_server.py
+# (duplicated by value: the router process must not import the engine
+# stack just for four strings).
+TRACE_HEADER = "X-VDT-Trace-Id"
+DEADLINE_HEADER = "X-VDT-Deadline-Ms"
+REPLICA_HEADER = "X-VDT-Replica-Id"
+ROUTER_HEADER = "X-VDT-Router"
+
+_PATHS = {"completions": "/v1/completions", "chat": "/v1/chat/completions"}
+
+
+class MigrationNeeded(Exception):
+    """Internal control flow: the current replica can no longer serve
+    this stream; re-place the remainder.  ``exclude``/``forget`` are
+    False for transient signals (a busy 429 target): the replica stays
+    eligible once its Retry-After backoff expires and keeps its
+    affinity history — its caches are intact."""
+
+    def __init__(
+        self, reason: str, *, exclude: bool = True, forget: bool = True
+    ) -> None:
+        super().__init__(reason)
+        self.reason = reason
+        self.exclude = exclude
+        self.forget = forget
+
+
+class StreamAbort(Exception):
+    """Internal control flow: a terminal error frame has already been
+    written to the client — end the stream, do NOT migrate further."""
+
+
+class RouterState:
+    def __init__(
+        self,
+        replica_urls: list[str],
+        *,
+        policy: str | None = None,
+        max_migrations: int | None = None,
+        affinity_block_tokens: int | None = None,
+        affinity_capacity: int | None = None,
+        affinity_min_tokens: int | None = None,
+        health_interval: float | None = None,
+        connect_timeout: float | None = None,
+        read_timeout: float | None = None,
+        api_key: str | None = None,
+    ) -> None:
+        def _env(value, name):
+            return getattr(envs, name) if value is None else value
+
+        self.policy = _env(policy, "VDT_ROUTER_POLICY")
+        if self.policy not in ("affinity", "least_loaded", "round_robin"):
+            raise ValueError(f"unknown router policy {self.policy!r}")
+        self.max_migrations = _env(
+            max_migrations, "VDT_ROUTER_MAX_MIGRATIONS"
+        )
+        self.affinity_min_tokens = _env(
+            affinity_min_tokens, "VDT_ROUTER_AFFINITY_MIN_TOKENS"
+        )
+        self.connect_timeout = _env(
+            connect_timeout, "VDT_ROUTER_CONNECT_TIMEOUT_SECONDS"
+        )
+        self.read_timeout = _env(
+            read_timeout, "VDT_ROUTER_READ_TIMEOUT_SECONDS"
+        )
+        self.api_key = api_key
+        self.pool = ReplicaPool(
+            replica_urls,
+            health_interval=_env(
+                health_interval, "VDT_ROUTER_HEALTH_INTERVAL_SECONDS"
+            ),
+            connect_timeout=self.connect_timeout,
+        )
+        self.index = PrefixAffinityIndex(
+            block_tokens=_env(
+                affinity_block_tokens, "VDT_ROUTER_AFFINITY_BLOCK_TOKENS"
+            ),
+            capacity=_env(
+                affinity_capacity, "VDT_ROUTER_AFFINITY_CAPACITY"
+            ),
+        )
+        self.metrics = RouterMetrics()
+        self.request_counter = Counter()
+        self._rr = 0
+        self.session = None  # aiohttp.ClientSession, set on startup
+
+    # ---- placement ----
+    def place(
+        self, keys: list[str], exclude: set[str]
+    ) -> tuple[Replica | None, str]:
+        """Pick a replica for a prompt with affinity chain ``keys``.
+        Returns (replica, deciding_policy)."""
+        cands = self.pool.candidates(exclude)
+        if not cands:
+            return None, "none"
+        if self.policy == "round_robin":
+            self._rr += 1
+            return cands[self._rr % len(cands)], "round_robin"
+        if self.policy == "affinity" and keys:
+            scores = self.index.score(keys)
+            scored = [
+                (scores.get(r.replica_id, 0), r) for r in cands
+            ]
+            best = max(s for s, _ in scored)
+            if best >= self.affinity_min_tokens:
+                tied = [r for s, r in scored if s == best]
+                return min(tied, key=lambda r: r.load_key), "affinity"
+        return min(cands, key=lambda r: r.load_key), "least_loaded"
+
+
+# ---- helpers ----
+def _error(message: str, status: int = 400, retry_after: int | None = None):
+    headers = (
+        {"Retry-After": str(retry_after)} if retry_after is not None else None
+    )
+    return web.json_response(
+        {
+            "object": "error",
+            "message": message,
+            "type": "router_error",
+            "code": status,
+        },
+        status=status,
+        headers=headers,
+    )
+
+
+def _forward_headers(request: web.Request, trace_ctx) -> dict[str, str]:
+    """Headers for the router→replica hop: the internal metadata marker,
+    the client's auth and deadline verbatim, and the trace parent so the
+    replica's spans land under the router's root span."""
+    headers = {ROUTER_HEADER: "1"}
+    auth = request.headers.get("Authorization")
+    if auth:
+        headers["Authorization"] = auth
+    deadline = request.headers.get(DEADLINE_HEADER)
+    if deadline:
+        headers[DEADLINE_HEADER] = deadline
+    if trace_ctx is not None:
+        headers[TRACE_HEADER] = f"{trace_ctx[0]}-{trace_ctx[1]}"
+    return headers
+
+
+async def _sse_payloads(resp, read_timeout: float) -> AsyncIterator[str]:
+    """Yield the payload of each ``data:`` SSE line, line-buffered (TCP
+    chunk boundaries need not align with event boundaries).  Each read
+    is deadline-bounded: a silently wedged replica must trigger
+    migration, not hang the client stream forever."""
+    buf = b""
+    while True:
+        chunk = await asyncio.wait_for(
+            resp.content.readany(), timeout=read_timeout
+        )
+        if not chunk:
+            return
+        buf += chunk
+        while b"\n" in buf:
+            line, _, buf = buf.partition(b"\n")
+            line = line.strip()
+            if line.startswith(b"data:"):
+                yield line[5:].strip().decode("utf-8", "replace")
+
+
+def _upstream_timeout(state: RouterState, streaming: bool):
+    import aiohttp
+
+    if streaming:
+        return aiohttp.ClientTimeout(
+            total=None,
+            connect=state.connect_timeout,
+            sock_read=state.read_timeout,
+        )
+    return aiohttp.ClientTimeout(
+        total=state.read_timeout, connect=state.connect_timeout
+    )
+
+
+# ---- the proxy ----
+async def _proxy(request: web.Request, kind: str) -> web.StreamResponse:
+    state: RouterState = request.app["router_state"]
+    try:
+        body = await request.json()
+        if not isinstance(body, dict):
+            raise ValueError("body must be a JSON object")
+    except Exception as e:  # noqa: BLE001
+        state.metrics.record_request(kind, "bad_request")
+        return _error(f"invalid request: {e}")
+    request_id = f"rtr-{next(state.request_counter)}"
+    journal = RouterJournal(request_id, kind, body)
+    text, ids = journal.affinity_source()
+    keys = state.index.keys_for(text, ids)
+    tracer = get_tracer()
+    with tracer.span(
+        "router.request",
+        trace_root=True,
+        kind=kind,
+        request_id=request_id,
+    ) as span:
+        fwd = _forward_headers(request, span.ctx)
+        if journal.stream:
+            response = await _proxy_stream(
+                request, state, journal, keys, fwd, span
+            )
+        else:
+            response = await _proxy_unary(
+                request, state, journal, keys, fwd, span
+            )
+        span.set_attribute("migrations", journal.migrations)
+        span.set_attribute("served_by", journal.served_by)
+    return response
+
+
+def _soonest_backoff_expiry(
+    state: RouterState, exclude: set[str]
+) -> float | None:
+    """Seconds until the first healthy-but-backed-off candidate frees
+    up (capped), or None when no candidate is merely busy."""
+    now = time.monotonic()
+    waits = [
+        r.backoff_until - now
+        for r in state.pool.replicas
+        if r.state == "healthy"
+        and r.url not in exclude
+        and r.backoff_until > now
+    ]
+    if not waits:
+        return None
+    return min(max(min(waits) + 0.05, 0.1), 5.0)
+
+
+def _place_or_none(
+    state: RouterState, keys: list[str], exclude: set[str], span
+) -> Replica | None:
+    replica, how = state.place(keys, exclude)
+    if replica is not None:
+        state.metrics.record_placement(how)
+        get_tracer().event(
+            span.ctx,
+            "router.placed",
+            replica_id=replica.replica_id,
+            policy=how,
+        )
+    return replica
+
+
+async def _proxy_unary(
+    request, state: RouterState, journal, keys, fwd, span
+) -> web.Response:
+    """Non-streaming proxy.  Nothing reaches the client until a replica
+    answers, so 'migration' here is whole-request resubmission — greedy
+    regeneration is bit-identical anyway, and no delivered token is
+    ever lost because none were delivered."""
+    kind = journal.kind
+    path = _PATHS[kind]
+    exclude: set[str] = set()
+    last_429: tuple[bytes, int, dict] | None = None
+    while True:
+        replica = _place_or_none(state, keys, exclude, span)
+        if replica is None:
+            if last_429 is not None:
+                raw, status, headers = last_429
+                state.metrics.record_request(kind, "rejected")
+                return web.Response(
+                    body=raw,
+                    status=status,
+                    content_type="application/json",
+                    headers=headers,
+                )
+            state.metrics.record_request(kind, "failed")
+            return _error(
+                "no healthy replica available", 503, retry_after=5
+            )
+        try:
+            async with state.session.post(
+                f"{replica.url}{path}",
+                json=journal.body,
+                headers=fwd,
+                timeout=_upstream_timeout(state, streaming=False),
+            ) as resp:
+                raw = await asyncio.wait_for(
+                    resp.read(), timeout=state.read_timeout
+                )
+                status = resp.status
+                served_id = resp.headers.get(
+                    REPLICA_HEADER, replica.replica_id
+                )
+                retry_after = resp.headers.get("Retry-After", "1")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — any transport failure = resubmit elsewhere
+            state.pool.note_unreachable(replica, f"{type(e).__name__}: {e}")
+            state.index.forget(replica.replica_id)
+            exclude.add(replica.url)
+            journal.migrations += 1
+            state.metrics.record_migration("unreachable")
+            if journal.migrations > state.max_migrations:
+                state.metrics.record_request(kind, "failed")
+                return _error(
+                    f"replica failed and migration budget exhausted: {e}",
+                    502,
+                )
+            continue
+        if status == 429:
+            # Healthy but full: back the replica off for Retry-After
+            # and try the next candidate; only when every replica is
+            # full does the client see the 429.  Deliberately NOT added
+            # to ``exclude`` — backoff expiry re-admits it (busy once
+            # is not failed-for-this-request).
+            try:
+                backoff = float(retry_after)
+            except ValueError:
+                backoff = 1.0
+            state.pool.note_backoff(replica, backoff)
+            last_429 = (
+                raw, status, {"Retry-After": retry_after},
+            )
+            continue
+        if status in (502, 503):
+            exclude.add(replica.url)
+            journal.migrations += 1
+            state.metrics.record_migration("dead")
+            if journal.migrations > state.max_migrations:
+                state.metrics.record_request(kind, "failed")
+                break
+            continue
+        if status == 200:
+            journal.served_by = served_id
+            state.index.observe(served_id, keys)
+            state.metrics.record_request(
+                kind,
+                "migrated_completed" if journal.migrations else "completed",
+            )
+        else:
+            state.metrics.record_request(kind, "bad_request")
+        return web.Response(
+            body=raw,
+            status=status,
+            content_type="application/json",
+            headers={REPLICA_HEADER: served_id},
+        )
+    return web.Response(
+        body=raw, status=status, content_type="application/json"
+    )
+
+
+async def _proxy_stream(
+    request, state: RouterState, journal, keys, fwd, span
+) -> web.StreamResponse:
+    """Streaming proxy with live migration.  The first replica is
+    engaged before the client response commits (pre-stream failures are
+    silent re-placements); once the SSE stream is open, failures turn
+    into journal-replay onto the next replica and the client stream
+    simply continues."""
+    kind = journal.kind
+    path = _PATHS[kind]
+    exclude: set[str] = set()
+    # Debug/bench passthrough: a client that speaks the internal header
+    # keeps the vdt_token_ids metadata (chaos_soak and the router tests
+    # assert exact token sequences end-to-end with it).
+    client_debug = request.headers.get(ROUTER_HEADER) == "1"
+
+    # ---- engage the first replica before committing client headers ----
+    resp = None
+    replica = None
+    last_429: tuple[bytes, str] | None = None
+    while resp is None:
+        replica = _place_or_none(state, keys, exclude, span)
+        if replica is None:
+            if last_429 is not None:
+                raw, retry_after = last_429
+                state.metrics.record_request(kind, "rejected")
+                return web.Response(
+                    body=raw,
+                    status=429,
+                    content_type="application/json",
+                    headers={"Retry-After": retry_after},
+                )
+            state.metrics.record_request(kind, "failed")
+            return _error(
+                "no healthy replica available", 503, retry_after=5
+            )
+        try:
+            candidate = await state.session.post(
+                f"{replica.url}{path}",
+                json=journal.body,
+                headers=fwd,
+                timeout=_upstream_timeout(state, streaming=True),
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — pre-stream failure: silently try the next replica
+            state.pool.note_unreachable(replica, f"{type(e).__name__}: {e}")
+            exclude.add(replica.url)
+            continue
+        if candidate.status == 429:
+            raw = await asyncio.wait_for(
+                candidate.read(), timeout=state.read_timeout
+            )
+            retry_after = candidate.headers.get("Retry-After", "1")
+            try:
+                state.pool.note_backoff(replica, float(retry_after))
+            except ValueError:
+                state.pool.note_backoff(replica, 1.0)
+            candidate.release()
+            # Backoff, not ``exclude``: a busy replica stays a valid
+            # migration target for this stream once it frees up.
+            last_429 = (raw, retry_after)
+            continue
+        if candidate.status != 200:
+            raw = await asyncio.wait_for(
+                candidate.read(), timeout=state.read_timeout
+            )
+            status = candidate.status
+            candidate.release()
+            if status in (502, 503):
+                exclude.add(replica.url)
+                continue
+            state.metrics.record_request(kind, "bad_request")
+            return web.Response(
+                body=raw,
+                status=status,
+                content_type="application/json",
+                headers={REPLICA_HEADER: replica.replica_id},
+            )
+        resp = candidate
+    journal.served_by = resp.headers.get(REPLICA_HEADER, replica.replica_id)
+
+    headers = {
+        "Content-Type": "text/event-stream",
+        "Cache-Control": "no-cache",
+        REPLICA_HEADER: journal.served_by,
+    }
+    if span.ctx is not None:
+        headers[TRACE_HEADER] = span.ctx[0]
+    response = web.StreamResponse(headers=headers)
+    await response.prepare(request)
+
+    async def write(payload: str) -> None:
+        await response.write(f"data: {payload}\n\n".encode())
+
+    completed = False
+    try:
+        try:
+            try:
+                completed = await _forward_primary(
+                    state, journal, replica, resp, write, client_debug
+                )
+            except MigrationNeeded as m:
+                completed = await _migrate_loop(
+                    state, journal, keys, exclude, replica, m,
+                    fwd, write, client_debug, span,
+                )
+        except StreamAbort:
+            completed = False
+        finally:
+            resp.close()
+        if completed:
+            state.index.observe(journal.served_by, keys)
+            state.metrics.record_request(
+                kind,
+                "migrated_completed" if journal.migrations else "completed",
+            )
+        else:
+            state.metrics.record_request(kind, "failed")
+    except (ConnectionResetError, asyncio.CancelledError):
+        logger.info("client disconnected from %s", journal.request_id)
+    await response.write_eof()
+    return response
+
+
+async def _migrate_loop(
+    state, journal, keys, exclude, victim, mig: MigrationNeeded,
+    fwd, write, client_debug, span,
+) -> bool:
+    """Re-place the unfinished remainder of a live stream until it
+    completes, the migration budget runs out, or no replica is left."""
+    while True:
+        if mig.exclude:
+            exclude.add(victim.url)
+        if mig.forget:
+            # The victim's prefix cache is gone (dead) or going
+            # (drain): stop steering siblings toward it.  Transient
+            # busy signals keep their affinity history.
+            state.index.forget(victim.replica_id)
+        journal.migrations += 1
+        state.metrics.record_migration(mig.reason)
+        get_tracer().event(
+            span.ctx,
+            "router.migrated",
+            reason=mig.reason,
+            from_replica=victim.replica_id,
+            migrations=journal.migrations,
+        )
+        if journal.migrations > state.max_migrations:
+            await write(
+                json.dumps(
+                    {
+                        "error": "migration budget exhausted "
+                        f"(last trigger: {mig.reason})",
+                        "code": 502,
+                    }
+                )
+            )
+            return False
+        target = _place_or_none(state, keys, exclude, span)
+        if target is None:
+            # Every candidate may just be in Retry-After backoff (busy,
+            # not dead): wait out the earliest expiry (capped) and look
+            # again before declaring the admitted work lost.
+            delay = _soonest_backoff_expiry(state, exclude)
+            if delay is not None:
+                await asyncio.sleep(delay)
+                target = _place_or_none(state, keys, exclude, span)
+        if target is None:
+            await write(
+                json.dumps(
+                    {
+                        "error": "no healthy replica to migrate to",
+                        "code": 503,
+                    }
+                )
+            )
+            return False
+        logger.warning(
+            "migrating %s (%d choice(s) live) %s -> %s after %s",
+            journal.request_id,
+            len(journal.unfinished()),
+            victim.replica_id,
+            target.replica_id,
+            mig.reason,
+        )
+        try:
+            await _forward_resumed(
+                state, journal, target, fwd, write, client_debug
+            )
+        except MigrationNeeded as m:
+            victim, mig = target, m
+            continue
+        journal.served_by = target.replica_id
+        return True
+
+
+async def _forward_primary(
+    state, journal, replica: Replica, resp, write, client_debug
+) -> bool:
+    """Pump the initial upstream SSE stream to the client, journaling
+    every chunk.  Returns True when the stream completed; raises
+    MigrationNeeded when the replica died, drained, or shed mid-flight.
+    """
+    try:
+        async for payload in _sse_payloads(resp, state.read_timeout):
+            if payload == "[DONE]":
+                await write("[DONE]")
+                return True
+            try:
+                obj = json.loads(payload)
+            except ValueError:
+                continue  # malformed frame: drop, journal stays truthful
+            if "error" in obj and not obj.get("choices"):
+                # Typed mid-stream error frame (api_server's streaming
+                # handlers emit these for drain/shed/death/overload):
+                # every 429/503-coded frame is recoverable work — the
+                # journal restores whatever was delivered — so migrate;
+                # only final (400-class) errors surface to the client.
+                reason = str(obj.get("reason") or "")
+                code = obj.get("code")
+                if reason in ("draining", "overloaded"):
+                    raise MigrationNeeded(reason)
+                if code == 503:
+                    raise MigrationNeeded("dead")
+                if code == 429:
+                    raise MigrationNeeded(reason or "overloaded")
+                await write(payload)
+                return False
+            if journal.upstream_id is None and obj.get("id"):
+                journal.upstream_id = obj["id"]
+                journal.model = obj.get("model")
+            migrate = False
+            for choice in obj.get("choices") or []:
+                if choice.get("finish_reason") == "overloaded":
+                    # Hot-replica shed (preempt-to-shed): take the
+                    # content but not the finish — the remainder
+                    # migrates instead of the client eating a partial
+                    # "overloaded" result.
+                    choice["finish_reason"] = None
+                    migrate = True
+                kept = dict(choice) if client_debug else None
+                journal.observe_choice(choice)
+                if kept is not None:
+                    choice.update(
+                        {
+                            k: v
+                            for k, v in kept.items()
+                            if k.startswith("vdt_")
+                        }
+                    )
+            await write(json.dumps(obj))
+            if migrate:
+                raise MigrationNeeded("overloaded")
+    except asyncio.CancelledError:
+        raise
+    except (MigrationNeeded, ConnectionResetError):
+        raise
+    except Exception as e:  # noqa: BLE001 — any upstream transport failure = migrate
+        state.pool.note_unreachable(replica, f"{type(e).__name__}: {e}")
+        raise MigrationNeeded("unreachable") from e
+    # EOF without [DONE]: the replica vanished mid-stream.
+    raise MigrationNeeded("eof")
+
+
+def _synth_chunk(journal, choice, delta_text, new_ids, finish, client_debug):
+    """A client-facing OpenAI chunk for a resumed continuation, keeping
+    the identity (id/model) the client saw in the first chunk."""
+    rid = journal.upstream_id or journal.request_id
+    model = journal.model or ""
+    if journal.kind == "chat":
+        delta: dict = {}
+        if not choice.role_sent:
+            delta["role"] = "assistant"
+            delta["content"] = delta_text or ""
+        elif delta_text:
+            delta["content"] = delta_text
+        chunk = {
+            "id": rid,
+            "object": "chat.completion.chunk",
+            "created": int(time.time()),
+            "model": model,
+            "choices": [
+                {
+                    "index": choice.index,
+                    "delta": delta,
+                    "finish_reason": finish,
+                }
+            ],
+        }
+    else:
+        chunk = {
+            "id": rid,
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": model,
+            "choices": [
+                {
+                    "index": choice.index,
+                    "text": delta_text,
+                    "finish_reason": finish,
+                }
+            ],
+        }
+    if client_debug:
+        chunk["choices"][0]["vdt_token_ids"] = list(new_ids or ())
+    return chunk
+
+
+async def _forward_resumed(
+    state, journal, target: Replica, fwd, write, client_debug
+) -> None:
+    """Resume every unfinished choice on ``target`` over
+    /internal/resume, converting internal frames back into client
+    chunks.  Returns when all choices finish; raises MigrationNeeded if
+    the target fails mid-continuation."""
+    pending = journal.unfinished()
+    if not pending:
+        await write("[DONE]")
+        return
+    # Per-choice pump tasks feed one bounded queue; this coroutine is
+    # the only consumer and the client stream's only writer.
+    frames: asyncio.Queue = asyncio.Queue(maxsize=64)
+
+    async def pump(choice) -> None:
+        try:
+            resp = await state.session.post(
+                f"{target.url}/internal/resume",
+                json=journal.resume_payload(choice),
+                headers=fwd,
+                timeout=_upstream_timeout(state, streaming=True),
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — reported to the consumer as a failure frame
+            await frames.put(("failed", choice, str(e)))
+            return
+        try:
+            if resp.status == 429:
+                # Busy, not broken: report separately so the consumer
+                # backs the target off instead of writing it off.
+                await resp.text()
+                await frames.put(
+                    ("busy", choice, resp.headers.get("Retry-After", "1"))
+                )
+                return
+            if resp.status != 200:
+                body = await resp.text()
+                await frames.put(
+                    ("failed", choice, f"HTTP {resp.status}: {body[:200]}")
+                )
+                return
+            async for payload in _sse_payloads(resp, state.read_timeout):
+                if payload == "[DONE]":
+                    break
+                try:
+                    obj = json.loads(payload)
+                except ValueError:
+                    continue
+                await frames.put(("frame", choice, obj))
+            await frames.put(("eof", choice, None))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — reported to the consumer as a failure frame
+            await frames.put(("failed", choice, str(e)))
+        finally:
+            resp.close()
+
+    tasks = [
+        asyncio.get_running_loop().create_task(pump(c)) for c in pending
+    ]
+    open_indices = {c.index for c in pending}
+    try:
+        while open_indices:
+            tag, choice, obj = await asyncio.wait_for(
+                frames.get(), timeout=state.read_timeout
+            )
+            if tag == "busy":
+                # Full target (429 + Retry-After): eject it briefly and
+                # re-place, but do NOT exclude it for this request or
+                # drop its affinity history — its caches are intact.
+                try:
+                    retry_after = float(obj)
+                except (TypeError, ValueError):
+                    retry_after = 1.0
+                state.pool.note_backoff(target, retry_after)
+                raise MigrationNeeded(
+                    "target_busy", exclude=False, forget=False
+                )
+            if tag == "failed":
+                raise MigrationNeeded("resume_failed")
+            if tag == "eof":
+                if choice.index in open_indices:
+                    # Stream ended without a finish: target died too.
+                    raise MigrationNeeded("eof")
+                continue
+            if "error" in obj:
+                if obj.get("code") in (429, 503):
+                    raise MigrationNeeded(
+                        str(obj.get("reason") or "dead")
+                    )
+                # Final (400-class) error: one clean terminal frame,
+                # then end the stream — never migrate a deterministic
+                # rejection into N duplicate error frames.
+                await write(json.dumps(obj))
+                raise StreamAbort()
+            cum_text = obj.get("text") or ""
+            delta_text = cum_text[choice.forwarded_text_len:]
+            new_ids = obj.get("token_ids") or []
+            finish = obj.get("finish_reason")
+            shed = finish == "overloaded"
+            if shed:
+                # Pressure-shed on the TARGET too: same policy as the
+                # primary path — keep the content, drop the finish, and
+                # migrate the remainder instead of surfacing a
+                # truncated "overloaded" result.
+                finish = None
+            chunk = _synth_chunk(
+                journal, choice, delta_text, new_ids, finish, client_debug
+            )
+            choice.observe(
+                new_ids, delta_text, finish, obj.get("prompt_token_ids")
+            )
+            if delta_text or new_ids or finish is not None:
+                await write(json.dumps(chunk))
+                # Only a chunk actually written can have carried the
+                # role-bearing first delta.
+                choice.role_sent = True
+            if shed:
+                raise MigrationNeeded("overloaded")
+            if finish is not None:
+                open_indices.discard(choice.index)
+    finally:
+        for t in tasks:
+            t.cancel()
+    if bool((journal.body.get("stream_options") or {}).get("include_usage")):
+        prompt_tokens = sum(
+            len(c.prompt_token_ids or ()) for c in journal.choices.values()
+        )
+        completion_tokens = sum(
+            len(c.emitted_token_ids) for c in journal.choices.values()
+        )
+        await write(
+            json.dumps(
+                {
+                    "id": journal.upstream_id or journal.request_id,
+                    "object": (
+                        "chat.completion.chunk"
+                        if journal.kind == "chat"
+                        else "text_completion"
+                    ),
+                    "created": int(time.time()),
+                    "model": journal.model or "",
+                    "choices": [],
+                    "usage": {
+                        "prompt_tokens": prompt_tokens,
+                        "completion_tokens": completion_tokens,
+                        "total_tokens": prompt_tokens + completion_tokens,
+                    },
+                }
+            )
+        )
+    await write("[DONE]")
+
+
+# ---- route handlers ----
+async def completions(request: web.Request) -> web.StreamResponse:
+    return await _proxy(request, "completions")
+
+
+async def chat_completions(request: web.Request) -> web.StreamResponse:
+    return await _proxy(request, "chat")
+
+
+async def health(request: web.Request) -> web.Response:
+    """Aggregate health: 200 while at least one replica is routable
+    (the router itself is up either way; the body carries the full
+    per-replica picture)."""
+    state: RouterState = request.app["router_state"]
+    state.metrics.update_replicas(state.pool)
+    replicas = state.pool.snapshot()
+    routable = sum(1 for r in state.pool.replicas if r.routable)
+    healthy = sum(
+        1 for r in state.pool.replicas if r.state == "healthy"
+    )
+    body = {
+        "status": "ok" if routable else "unavailable",
+        "role": "router",
+        "replicas_total": len(replicas),
+        "replicas_routable": routable,
+        "replicas_healthy": healthy,
+        "replicas": replicas,
+    }
+    if routable and healthy < len(replicas):
+        body["status"] = "degraded"
+    return web.json_response(
+        body,
+        status=200 if routable else 503,
+        headers=None if routable else {"Retry-After": "5"},
+    )
+
+
+async def metrics(request: web.Request) -> web.Response:
+    """Aggregated exposition: every replica's /metrics re-labeled with
+    ``replica="<id>"``, plus the router's own vdt_router:* families."""
+    import aiohttp
+
+    state: RouterState = request.app["router_state"]
+    state.metrics.update_replicas(state.pool)
+    timeout = aiohttp.ClientTimeout(
+        total=10, connect=state.connect_timeout
+    )
+
+    async def scrape(replica: Replica) -> tuple[str, str] | None:
+        try:
+            async with state.session.get(
+                f"{replica.url}/metrics", timeout=timeout
+            ) as resp:
+                if resp.status != 200:
+                    return None
+                return (replica.replica_id, await resp.text())
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — a dead replica just drops out of the aggregate
+            return None
+
+    parts = await asyncio.wait_for(
+        asyncio.gather(*(scrape(r) for r in state.pool.replicas)),
+        timeout=15,
+    )
+    merged = merge_expositions([p for p in parts if p is not None])
+    own = state.metrics.render().decode()
+    return web.Response(
+        text=merged + own, content_type="text/plain"
+    )
+
+
+async def router_state(request: web.Request) -> web.Response:
+    """Introspection: pool snapshot, tally counters, affinity stats."""
+    state: RouterState = request.app["router_state"]
+    return web.json_response(
+        {
+            "policy": state.policy,
+            "replicas": state.pool.snapshot(),
+            "counters": dict(state.metrics.counts),
+            "affinity_blocks": {
+                r.replica_id: state.index.num_blocks(r.replica_id)
+                for r in state.pool.replicas
+            },
+        }
+    )
+
+
+async def list_models(request: web.Request) -> web.Response:
+    state: RouterState = request.app["router_state"]
+    import aiohttp
+
+    timeout = aiohttp.ClientTimeout(total=10, connect=state.connect_timeout)
+    for replica in state.pool.candidates() or state.pool.replicas:
+        try:
+            async with state.session.get(
+                f"{replica.url}/v1/models", timeout=timeout
+            ) as resp:
+                if resp.status == 200:
+                    return web.json_response(await resp.json())
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — fall through to the next replica
+            continue
+    return _error("no replica answered /v1/models", 503, retry_after=5)
+
+
+async def version(request: web.Request) -> web.Response:
+    return web.json_response({"version": __version__, "role": "router"})
+
+
+# ---- app assembly ----
+async def _on_startup(app: web.Application) -> None:
+    import aiohttp
+
+    state: RouterState = app["router_state"]
+    state.session = aiohttp.ClientSession()
+    # One synchronous sweep so the first request after boot has health
+    # states to place against, then the steady poll loop.
+    await state.pool.probe_all(state.session)
+    state.pool.start(state.session)
+
+
+async def _on_cleanup(app: web.Application) -> None:
+    state: RouterState = app["router_state"]
+    await state.pool.stop()
+    if state.session is not None:
+        await state.session.close()
+
+
+@web.middleware
+async def router_auth_middleware(request: web.Request, handler):
+    state: RouterState = request.app["router_state"]
+    if state.api_key and request.path not in (
+        "/health", "/ping", "/version", "/metrics",
+    ):
+        import hmac
+
+        header = request.headers.get("Authorization", "")
+        expect = f"Bearer {state.api_key}".encode()
+        got = header.encode("utf-8", "surrogateescape")
+        if not hmac.compare_digest(got, expect):
+            return _error("invalid or missing API key", 401)
+    return await handler(request)
+
+
+def build_router_app(state: RouterState) -> web.Application:
+    app = web.Application(
+        client_max_size=64 * 2**20,
+        middlewares=[router_auth_middleware],
+    )
+    app["router_state"] = state
+    app.router.add_get("/health", health)
+    app.router.add_get("/ping", health)
+    app.router.add_get("/version", version)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_get("/router/state", router_state)
+    app.router.add_get("/v1/models", list_models)
+    app.router.add_post("/v1/completions", completions)
+    app.router.add_post("/v1/chat/completions", chat_completions)
+    app.on_startup.append(_on_startup)
+    app.on_cleanup.append(_on_cleanup)
+    return app

@@ -1,0 +1,176 @@
+"""Router-side request journal (ISSUE 10 tentpole).
+
+Mirrors ``engine/supervisor.py``'s ``JournalEntry`` semantics one hop
+up: for every proxied request the router remembers the original OpenAI
+body, each choice's prompt, and the cumulative tokens/text already
+forwarded to the client — exactly what a live migration needs to
+re-submit the request to another replica via ``/internal/resume`` with
+the emitted tokens restored, so the client's SSE stream continues and
+greedy outputs stay bit-identical across the switch.
+
+One ``RouterJournal`` per in-flight proxied request (bounded 1:1 by live
+router handlers), one ``ChoiceState`` per choice index — a completions
+request with P prompts and n samples has P*n flat choice indices, in the
+same order the replica assigns them (prompt-major, sample-minor).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ChoiceState:
+    """Client-visible cumulative state of one choice index."""
+
+    index: int
+    prompt: str | None = None
+    prompt_token_ids: list[int] | None = None
+    emitted_token_ids: list[int] = field(default_factory=list)
+    # Characters of completion text already forwarded to the client —
+    # a resumed stream re-sends cumulative text, and the router slices
+    # off this prefix to keep the client stream incremental.
+    forwarded_text_len: int = 0
+    finish_reason: str | None = None
+    # Chat streams: whether the role-bearing first delta went out (a
+    # migrated continuation must not repeat it — or skip it).
+    role_sent: bool = False
+
+    @property
+    def finished(self) -> bool:
+        return self.finish_reason is not None
+
+    def observe(
+        self,
+        new_token_ids: list[int] | None,
+        text_delta: str,
+        finish_reason: str | None,
+        prompt_token_ids: list[int] | None = None,
+    ) -> None:
+        if prompt_token_ids is not None and self.prompt_token_ids is None:
+            self.prompt_token_ids = list(prompt_token_ids)
+        if new_token_ids:
+            self.emitted_token_ids.extend(new_token_ids)
+        self.forwarded_text_len += len(text_delta)
+        if finish_reason is not None:
+            self.finish_reason = finish_reason
+
+
+def _normalize_prompts(body: dict) -> list[tuple[str | None, list[int] | None]]:
+    """The completions prompt forms (str | [str] | [int] | [[int]]),
+    normalized the same way the replica's handler does."""
+    p = body.get("prompt", "")
+    if isinstance(p, str):
+        return [(p, None)]
+    if isinstance(p, list) and p and isinstance(p[0], int):
+        return [(None, [int(t) for t in p])]
+    if isinstance(p, list) and p and isinstance(p[0], str):
+        return [(s, None) for s in p]
+    if isinstance(p, list) and p and isinstance(p[0], list):
+        return [(None, [int(t) for t in ids]) for ids in p]
+    return [("", None)]
+
+
+def _chat_text(body: dict) -> str:
+    """Affinity key text for a chat request: the concatenated message
+    contents.  Chat-template boilerplate is shared by every request on
+    the same model, so leaving it out keeps the signal in the turns."""
+    parts: list[str] = []
+    for m in body.get("messages") or ():
+        if not isinstance(m, dict):
+            continue
+        content = m.get("content")
+        if isinstance(content, str):
+            parts.append(f"{m.get('role', '')}:{content}")
+        elif isinstance(content, list):
+            for item in content:
+                if isinstance(item, dict) and isinstance(
+                    item.get("text"), str
+                ):
+                    parts.append(item["text"])
+    return "\n".join(parts)
+
+
+class RouterJournal:
+    """All migration state for one proxied request."""
+
+    def __init__(self, request_id: str, kind: str, body: dict) -> None:
+        assert kind in ("completions", "chat"), kind
+        self.request_id = request_id
+        self.kind = kind
+        self.body = body
+        self.stream = bool(body.get("stream"))
+        n = max(int(body.get("n") or 1), 1)
+        self.choices: dict[int, ChoiceState] = {}
+        if kind == "chat":
+            for i in range(n):
+                self.choices[i] = ChoiceState(index=i)
+        else:
+            prompts = _normalize_prompts(body)
+            idx = 0
+            for text, ids in prompts:
+                for _ in range(n):
+                    self.choices[idx] = ChoiceState(
+                        index=idx, prompt=text, prompt_token_ids=ids
+                    )
+                    idx += 1
+        # Identity the client saw in the first chunk; migrated
+        # continuations keep presenting it.
+        self.upstream_id: str | None = None
+        self.model: str | None = None
+        self.migrations = 0
+        self.served_by: str | None = None  # replica_id of current server
+
+    # ---- affinity ----
+    def affinity_source(self) -> tuple[str | None, list[int] | None]:
+        """(text, token_ids) to key the affinity index with — the first
+        prompt (multi-prompt batches rarely share placement anyway)."""
+        if self.kind == "chat":
+            return _chat_text(self.body), None
+        first = self.choices.get(0)
+        if first is None:
+            return "", None
+        if first.prompt_token_ids is not None:
+            return None, first.prompt_token_ids
+        return first.prompt, None
+
+    # ---- chunk accounting ----
+    def observe_choice(self, choice: dict) -> dict:
+        """Record one upstream SSE chunk's choice dict and return it
+        with the internal ``vdt_*`` metadata stripped (what the client
+        is allowed to see)."""
+        idx = int(choice.get("index") or 0)
+        state = self.choices.setdefault(idx, ChoiceState(index=idx))
+        new_ids = choice.pop("vdt_token_ids", None)
+        prompt_ids = choice.pop("vdt_prompt_token_ids", None)
+        if self.kind == "chat":
+            delta = choice.get("delta") or {}
+            text_delta = delta.get("content") or ""
+            if delta.get("role"):
+                state.role_sent = True
+        else:
+            text_delta = choice.get("text") or ""
+        state.observe(
+            new_ids, text_delta, choice.get("finish_reason"), prompt_ids
+        )
+        return choice
+
+    def unfinished(self) -> list[ChoiceState]:
+        return [c for c in self.choices.values() if not c.finished]
+
+    # ---- migration ----
+    def resume_payload(self, choice: ChoiceState) -> dict:
+        """The /internal/resume body for one unfinished choice: the
+        original OpenAI body (sampling parity), the choice's prompt
+        (ids when known — text re-tokenizes identically on a same-model
+        replica), and the tokens the client already holds."""
+        return {
+            "request_id": (
+                f"{self.request_id}-m{self.migrations}-{choice.index}"
+            ),
+            "kind": self.kind,
+            "body": self.body,
+            "prompt": choice.prompt,
+            "prompt_token_ids": choice.prompt_token_ids,
+            "emitted_token_ids": list(choice.emitted_token_ids),
+        }

@@ -1,0 +1,161 @@
+"""Router-side Prometheus instruments + replica exposition merging.
+
+Two halves:
+
+- ``RouterMetrics``: the router's own counters/gauges (placements,
+  migrations, outcomes, per-replica liveness), prefixed ``vdt_router:``
+  so they can never collide with the engines' ``vllm:`` families.
+  Degrades to no-op without prometheus_client, like metrics.py.
+- ``merge_expositions``: the aggregated ``/metrics`` body — every
+  replica's exposition re-labeled with ``replica="<id>"`` and grouped
+  into one valid text-format document (one HELP/TYPE per family, all
+  replicas' samples under it), so one scrape of the router sees the
+  whole deployment with per-replica attribution.
+
+Plain counters are mirrored in ``RouterMetrics.counts`` regardless of
+prometheus availability — tests and the ``/router/state`` debug endpoint
+read those.
+"""
+
+from __future__ import annotations
+
+from collections import Counter as _TallyCounter
+
+
+def _inject_label(sample_line: str, key: str, value: str) -> str:
+    """Add one label to a Prometheus text-format sample line."""
+    esc = value.replace("\\", r"\\").replace('"', r"\"")
+    if "{" in sample_line:
+        idx = sample_line.rindex("}")
+        return (
+            f'{sample_line[:idx]},{key}="{esc}"'
+            f"}}{sample_line[idx + 1:]}"
+        )
+    name, _, rest = sample_line.partition(" ")
+    return f'{name}{{{key}="{esc}"}} {rest}'
+
+
+def merge_expositions(parts: list[tuple[str, str]]) -> str:
+    """Merge ``[(replica_id, exposition_text), ...]`` into one valid
+    exposition: families deduplicated (first replica's HELP/TYPE wins),
+    every sample tagged ``replica="<id>"``."""
+    order: list[str] = []
+    families: dict[str, dict] = {}
+    for replica_id, text in parts:
+        current: dict | None = None
+        for line in text.splitlines():
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                name = line.split(" ", 3)[2]
+                fam = families.get(name)
+                if fam is None:
+                    fam = families[name] = {
+                        "help": None, "type": None, "samples": [],
+                    }
+                    order.append(name)
+                kind = "help" if line.startswith("# HELP ") else "type"
+                if fam[kind] is None:
+                    fam[kind] = line
+                current = fam
+            elif line and not line.startswith("#"):
+                if current is None:
+                    continue
+                current["samples"].append(
+                    _inject_label(line, "replica", replica_id)
+                )
+    out: list[str] = []
+    for name in order:
+        fam = families[name]
+        if fam["help"]:
+            out.append(fam["help"])
+        if fam["type"]:
+            out.append(fam["type"])
+        out.extend(fam["samples"])
+    return "\n".join(out) + ("\n" if out else "")
+
+
+class RouterMetrics:
+    """Router-process instruments; every record call also tallies into
+    ``counts`` so behavior is observable without prometheus_client."""
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.counts: _TallyCounter = _TallyCounter()
+        self.enabled = enabled
+        self.registry = None
+        if not enabled:
+            return
+        try:
+            from prometheus_client import (
+                CollectorRegistry,
+                Counter,
+                Gauge,
+            )
+        except ImportError:
+            self.enabled = False
+            return
+        self.registry = CollectorRegistry()
+        self._requests = Counter(
+            "vdt_router:requests",
+            "Proxied requests by kind and outcome (completed | "
+            "migrated_completed | rejected | failed | bad_request)",
+            ["kind", "outcome"],
+            registry=self.registry,
+        )
+        self._migrations = Counter(
+            "vdt_router:migrations",
+            "Live request migrations by trigger (unreachable | eof | "
+            "draining | overloaded | dead | resume_failed | error)",
+            ["reason"],
+            registry=self.registry,
+        )
+        self._placements = Counter(
+            "vdt_router:placements",
+            "Placement decisions by deciding policy (affinity | "
+            "least_loaded | round_robin)",
+            ["policy"],
+            registry=self.registry,
+        )
+        self._replica_up = Gauge(
+            "vdt_router:replica_up",
+            "1 while the replica answers /health with 200",
+            ["replica_id"],
+            registry=self.registry,
+        )
+        self._replica_waiting = Gauge(
+            "vdt_router:replica_waiting_requests",
+            "Last-scraped vllm:num_requests_waiting per replica",
+            ["replica_id"],
+            registry=self.registry,
+        )
+
+    def record_request(self, kind: str, outcome: str) -> None:
+        self.counts[f"requests.{kind}.{outcome}"] += 1
+        if self.enabled:
+            self._requests.labels(kind=kind, outcome=outcome).inc()
+
+    def record_migration(self, reason: str) -> None:
+        self.counts[f"migrations.{reason}"] += 1
+        if self.enabled:
+            self._migrations.labels(reason=reason).inc()
+
+    def record_placement(self, policy: str) -> None:
+        self.counts[f"placements.{policy}"] += 1
+        if self.enabled:
+            self._placements.labels(policy=policy).inc()
+
+    def update_replicas(self, pool) -> None:
+        if not self.enabled:
+            return
+        for r in pool.replicas:
+            self._replica_up.labels(replica_id=r.replica_id).set(
+                1 if r.state == "healthy" else 0
+            )
+            self._replica_waiting.labels(replica_id=r.replica_id).set(
+                r.waiting
+            )
+
+    def render(self) -> bytes:
+        if self.registry is None:
+            return b"# router metrics disabled (no prometheus_client)\n"
+        from prometheus_client import generate_latest
+
+        return generate_latest(self.registry)

@@ -1,0 +1,280 @@
+"""Replica pool: health tracking + load scraping for the router.
+
+One ``Replica`` per backend api_server.  A background poll loop (every
+``VDT_ROUTER_HEALTH_INTERVAL_SECONDS``, each probe deadline-bounded)
+reads ``/health`` — which PR 2/3/8 made four-state: healthy, recovering,
+draining/drained, dead — and scrapes the PR 7 admission gauges
+(``vllm:num_requests_waiting``, ``vllm:admission_queued_tokens``) from
+``/metrics`` so least-loaded placement ranks replicas by queue depth,
+not round-robin luck.
+
+The proxy path feeds back too: a transport error marks the replica
+unreachable immediately (placement must not wait a poll tick to stop
+picking a dead backend), and a 429 with ``Retry-After`` puts the replica
+in backoff for that long (it is healthy but full — eject it from
+placement briefly, don't mark it down).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+# /health interpretations that mean "will come back without operator
+# action" — kept out of placement but not forgotten.
+_TRANSIENT_STATES = {"recovering", "draining", "drained"}
+
+_LOAD_GAUGES = (
+    "vllm:num_requests_waiting",
+    "vllm:admission_queued_tokens",
+    "vllm:num_requests_running",
+)
+
+
+@dataclass
+class Replica:
+    url: str  # base URL, no trailing slash
+    replica_id: str = ""  # learned from /health; url until then
+    state: str = "unknown"  # healthy|recovering|draining|drained|dead|unreachable|unknown
+    waiting: float = 0.0  # vllm:num_requests_waiting
+    queued_tokens: float = 0.0  # vllm:admission_queued_tokens
+    running: float = 0.0  # vllm:num_requests_running
+    backoff_until: float = 0.0  # monotonic; 429 Retry-After ejection
+    consecutive_failures: int = 0
+    last_error: str = ""
+    last_probe_mono: float = 0.0
+
+    def __post_init__(self) -> None:
+        self.url = self.url.rstrip("/")
+        if not self.replica_id:
+            self.replica_id = self.url
+
+    @property
+    def routable(self) -> bool:
+        return (
+            self.state == "healthy"
+            and time.monotonic() >= self.backoff_until
+        )
+
+    @property
+    def load_key(self) -> tuple[float, float, float]:
+        """Least-loaded sort key: waiting depth first (the PR 7
+        admission gauge that grows first under pressure), then queued
+        prompt tokens, then running batch size."""
+        return (self.waiting, self.queued_tokens, self.running)
+
+    def snapshot(self) -> dict:
+        return {
+            "url": self.url,
+            "replica_id": self.replica_id,
+            "state": self.state,
+            "waiting": self.waiting,
+            "queued_tokens": self.queued_tokens,
+            "running": self.running,
+            "backing_off": time.monotonic() < self.backoff_until,
+            "last_error": self.last_error or None,
+        }
+
+
+def parse_load_gauges(metrics_text: str) -> dict[str, float]:
+    """Sum the admission-gauge samples out of a Prometheus exposition
+    (labels collapse: one engine per replica)."""
+    out: dict[str, float] = {}
+    for line in metrics_text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) != 2:
+            continue
+        family = parts[0].split("{")[0]
+        if family in _LOAD_GAUGES:
+            try:
+                out[family] = out.get(family, 0.0) + float(parts[1])
+            except ValueError:
+                continue
+    return out
+
+
+class ReplicaPool:
+    """Owns the replica set and the health-poll task.  All mutation
+    happens on the router's event loop (the poll task and the request
+    handlers share it), so no locking."""
+
+    def __init__(
+        self,
+        urls: list[str],
+        *,
+        health_interval: float = 2.0,
+        connect_timeout: float = 5.0,
+        probe_timeout: float = 10.0,
+    ) -> None:
+        seen: set[str] = set()
+        self.replicas: list[Replica] = []
+        for url in urls:
+            url = url.rstrip("/")
+            if url and url not in seen:
+                seen.add(url)
+                self.replicas.append(Replica(url=url))
+        if not self.replicas:
+            raise ValueError("router needs at least one replica URL")
+        self.health_interval = health_interval
+        self.connect_timeout = connect_timeout
+        self.probe_timeout = probe_timeout
+        self._task: asyncio.Task | None = None
+        self._stopped = asyncio.Event()
+
+    # ---- lookup ----
+    def by_url(self, url: str) -> Replica | None:
+        url = url.rstrip("/")
+        for r in self.replicas:
+            if r.url == url:
+                return r
+        return None
+
+    def by_id(self, replica_id: str) -> Replica | None:
+        for r in self.replicas:
+            if r.replica_id == replica_id:
+                return r
+        return None
+
+    def candidates(self, exclude: set[str] | None = None) -> list[Replica]:
+        """Routable replicas, excluding ``exclude`` (urls)."""
+        exclude = exclude or set()
+        return [
+            r
+            for r in self.replicas
+            if r.routable and r.url not in exclude
+        ]
+
+    def snapshot(self) -> list[dict]:
+        return [r.snapshot() for r in self.replicas]
+
+    # ---- request-path feedback ----
+    def note_unreachable(self, replica: Replica, error: str) -> None:
+        replica.state = "unreachable"
+        replica.consecutive_failures += 1
+        replica.last_error = error
+        logger.warning(
+            "replica %s unreachable: %s", replica.replica_id, error
+        )
+
+    def note_backoff(self, replica: Replica, retry_after: float) -> None:
+        """429 from a healthy-but-full replica: eject from placement for
+        Retry-After seconds, nothing more."""
+        replica.backoff_until = time.monotonic() + max(retry_after, 0.5)
+
+    # ---- health polling ----
+    async def probe(self, session, replica: Replica) -> None:
+        """One deadline-bounded /health + /metrics read."""
+        import aiohttp
+
+        timeout = aiohttp.ClientTimeout(
+            total=self.probe_timeout, connect=self.connect_timeout
+        )
+        replica.last_probe_mono = time.monotonic()
+        try:
+            async with session.get(
+                f"{replica.url}/health", timeout=timeout
+            ) as resp:
+                if resp.status == 200:
+                    try:
+                        body = await resp.json()
+                    except Exception:  # noqa: BLE001 — pre-ISSUE-10 replicas answer 200 with an empty body
+                        body = {}
+                    replica.state = "healthy"
+                    replica.consecutive_failures = 0
+                    replica.last_error = ""
+                    rid = (body or {}).get("replica_id")
+                    if rid:
+                        replica.replica_id = str(rid)
+                else:
+                    try:
+                        body = await resp.json()
+                    except Exception:  # noqa: BLE001 — a 5xx with no JSON body is still a state signal
+                        body = {}
+                    status = str((body or {}).get("status", "dead"))
+                    replica.state = (
+                        status
+                        if status in _TRANSIENT_STATES or status == "dead"
+                        else "dead"
+                    )
+                    replica.last_error = str(
+                        (body or {}).get("error", f"HTTP {resp.status}")
+                    )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — any transport failure = unreachable
+            self.note_unreachable(replica, f"{type(e).__name__}: {e}")
+            return
+        if replica.state != "healthy":
+            return
+        try:
+            async with session.get(
+                f"{replica.url}/metrics", timeout=timeout
+            ) as resp:
+                if resp.status == 200:
+                    gauges = parse_load_gauges(await resp.text())
+                    replica.waiting = gauges.get(
+                        "vllm:num_requests_waiting", replica.waiting
+                    )
+                    replica.queued_tokens = gauges.get(
+                        "vllm:admission_queued_tokens",
+                        replica.queued_tokens,
+                    )
+                    replica.running = gauges.get(
+                        "vllm:num_requests_running", replica.running
+                    )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — load stats are advisory; /health already passed
+            logger.debug(
+                "metrics scrape of %s failed: %s", replica.replica_id, e
+            )
+
+    async def probe_all(self, session) -> None:
+        # Each probe is internally deadline-bounded; the outer bound
+        # just guarantees one wedged probe can't stall the poll loop.
+        await asyncio.wait_for(
+            asyncio.gather(
+                *(self.probe(session, r) for r in self.replicas)
+            ),
+            timeout=2 * (self.probe_timeout + self.connect_timeout) + 5,
+        )
+
+    def start(self, session) -> None:
+        if self._task is not None:
+            return
+        self._stopped.clear()
+        self._task = asyncio.get_running_loop().create_task(
+            self._poll_loop(session)
+        )
+
+    async def _poll_loop(self, session) -> None:
+        while not self._stopped.is_set():
+            try:
+                await self.probe_all(session)
+            except asyncio.CancelledError:
+                return
+            except Exception:  # noqa: BLE001 — the poll loop must outlive one bad tick
+                logger.exception("replica health poll failed")
+            try:
+                await asyncio.wait_for(
+                    self._stopped.wait(), timeout=self.health_interval
+                )
+            except asyncio.TimeoutError:
+                continue
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await asyncio.wait_for(task, timeout=5.0)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                pass
