@@ -23,6 +23,7 @@ Env knobs: VDT_BENCH_MODEL=1b|7b|tiny + VDT_BENCH_BATCH/VDT_BENCH_STEPS/
 VDT_BENCH_QUANT/VDT_BENCH_KV run one explicit config instead;
 VDT_BENCH_DISPATCHES sizes the timed window; VDT_BENCH_FAST=1 skips the
 7B and MoE configs; VDT_BENCH_SERVE=0 skips the serve probe;
+VDT_BENCH_SPEC=0 skips the speculative-decoding on/off gate;
 VDT_BENCH_PREFIX_CACHE=1 builds the engines with --enable-prefix-caching
 (details then report prefix_cache_hit_rate; `tools/ablation` is the
 dedicated on/off warm-TTFT comparison).
@@ -516,6 +517,65 @@ def _prefill_probe(engine, *, prompt_len, n_prompts) -> dict:
     }
 
 
+def _spec_probe(on_cpu: bool) -> dict:
+    """Speculative-decoding gate (ISSUE 11): tokens/s and acceptance
+    rate with spec decode on vs off on a repetitive-suffix workload
+    (tools/spec_decode_ablation.py).  The result carries `gate_pass`:
+    at the measured acceptance rate the speculative engine must beat
+    the fused-decode baseline by the configured multiple, and outputs
+    must be bit-identical (always fatal if not)."""
+    import argparse
+
+    from tools.spec_decode_ablation import run_ablation
+    from vllm_distributed_tpu.testing import LLAMA_1B, write_llama_config
+
+    if on_cpu:
+        shapes = dict(
+            vocab_size=1024, hidden=256, intermediate=512, layers=4,
+            heads=8, kv_heads=4, dtype="float32",
+        )
+        n_prompts, max_tokens = 4, 32
+    else:
+        shapes = LLAMA_1B
+        n_prompts, max_tokens = 16, 96
+    args = argparse.Namespace(
+        load_format="dummy",
+        num_prompts=n_prompts,
+        prompt_len=96,
+        pattern_len=24,
+        max_tokens=max_tokens,
+        spec_k=4,
+        num_decode_steps=8,
+        num_kv_pages=2048,
+        page_size=16,
+        gate_acceptance=0.5,
+        gate_speedup=1.3,
+    )
+    result = run_ablation(write_llama_config(**shapes), args)
+    if not result["outputs_bit_identical"]:
+        raise AssertionError(
+            "spec decode outputs diverged from the greedy baseline"
+        )
+    # The >=1.3x speedup gate only binds in the memory-bound regime the
+    # optimization targets (weights+KV streamed per micro-step).  A CPU
+    # run is compute-bound — verifying K+1 tokens costs ~K+1x the
+    # FLOPs of one — so there the numbers are reported, not asserted
+    # (the deterministic tier-1 gate in tests/test_spec_decode.py
+    # asserts the roofline model via the mock's HBM-pass cost instead).
+    result["gate_enforced"] = not on_cpu
+    if (
+        result["gate_enforced"]
+        and result["gate_applicable"]
+        and not result["gate_pass"]
+    ):
+        raise AssertionError(
+            f"spec decode gate failed: {result['decode_speedup']}x < "
+            f"{args.gate_speedup}x at acceptance "
+            f"{result['acceptance_rate']}"
+        )
+    return result
+
+
 def _serve_probe() -> dict:
     """HTTP-path serving metrics (BASELINE.md's TTFT/ITL are SERVING
     numbers): boot the OpenAI server on the 1B dummy model and drive it
@@ -755,6 +815,16 @@ def main() -> None:
             else:
                 os.environ["VDT_MOE_IMPL"] = user_impl
 
+    # Speculative-decoding gate (ISSUE 11): cheap on CPU (tiny shapes),
+    # the honest 1B measurement on TPU.  A gate failure is reported in
+    # the detail rather than sinking the whole bench.
+    spec_detail = None
+    if os.environ.get("VDT_BENCH_SPEC", "1") == "1":
+        try:
+            spec_detail = _spec_probe(on_cpu)
+        except Exception as e:  # noqa: BLE001
+            spec_detail = {"error": f"{type(e).__name__}: {e}"}
+
     serve_detail = None
     if not on_cpu and os.environ.get("VDT_BENCH_SERVE", "1") == "1":
         try:
@@ -800,6 +870,7 @@ def main() -> None:
                 "llama_1b_bf16_b32", {}
             ).get("tokens_per_sec"),
             "pallas_kernel_check": kernel_check,
+            "spec_decode": spec_detail,
             "serve_http": serve_detail,
             "configs": details,
         },

@@ -18,6 +18,20 @@ recovery/replay tests can assert bit-identical continuations: a request
 replayed as prompt+emitted-prefix continues with exactly the tokens an
 uninterrupted run would have produced, and any replay bug (dropped,
 duplicated, or restarted-from-scratch tokens) changes the sequence.
+``VDT_MOCK_TOKEN_SEQ=seq:t0,t1,...`` generalizes this to token i =
+L[i mod len(L)] so speculative-decoding tests (ISSUE 11) can force
+full-accept (a periodic history the n-gram proposer predicts exactly),
+full-reject (a prompt whose recurring n-gram continues differently
+than the emitted stream), and mixed-acceptance batches — the mock
+verifies drafts against the same function, accepting the longest
+matching prefix plus one bonus token, exactly like the real greedy
+accept kernel.
+
+``VDT_MOCK_HBM_PASS_SECONDS`` (default 0) simulates memory-bound
+device time per step as cost × HBM passes: a fused decode window of K
+micro-steps streams weights+KV K times, a speculative verify window
+streams them ONCE — the roofline asymmetry the spec-decode bench gate
+measures deterministically without chips.
 - transport faults: ``drop_writes`` / ``blackhole_writes`` /
                     ``corrupt_writes`` / ``delay_writes`` / ``hang_writes``
                     — armed with a small ``after_writes`` budget so the
@@ -70,10 +84,23 @@ class MockWorker:
         # (event, step_id, monotonic time) — lets tests assert that
         # dispatch N+1 reached this worker before fetch N completed.
         self.timeline: list[tuple[str, int, float]] = []
-        # Deterministic position-based sampling (see module docstring).
-        self._seq_mode = os.environ.get("VDT_MOCK_TOKEN_SEQ") == "1"
+        # Deterministic position-based sampling (see module docstring):
+        # "1" -> token i = i; "seq:a,b,c" -> token i = L[i % len(L)].
+        mode = os.environ.get("VDT_MOCK_TOKEN_SEQ", "")
+        self._seq_mode = mode == "1" or mode.startswith("seq:")
+        self._seq_list: list[int] | None = (
+            [int(x) for x in mode[4:].split(",")]
+            if mode.startswith("seq:")
+            else None
+        )
         # req_id -> {"total": tokens known, "computed": KV computed}.
         self._seq_state: dict[str, dict[str, int]] = {}
+        # Simulated memory-bound device time: cost per weights+KV HBM
+        # pass (fused decode pays one per micro-step, a spec verify
+        # window pays ONE for the whole window).
+        self._hbm_pass_seconds = float(
+            os.environ.get("VDT_MOCK_HBM_PASS_SECONDS", "0")
+        )
         # Simulated device time per blocking execute_model (recovery
         # tests need a stream slow enough to kill mid-generation).
         self._execute_sleep = float(
@@ -132,14 +159,47 @@ class MockWorker:
     def initialize_cache(self, num_pages: int) -> None:
         self.num_pages = num_pages
 
+    def _tok(self, pos: int) -> int:
+        """Deterministic token at absolute position ``pos``."""
+        if self._seq_list is not None:
+            return self._seq_list[pos % len(self._seq_list)]
+        return pos
+
+    def _hbm_passes(self, scheduler_output) -> int:
+        """Weights+KV HBM passes one dispatch costs: a fused decode
+        window pays one per micro-step, a spec verify window ONE for
+        the whole window (the memory-bound asymmetry spec decode
+        exploits)."""
+        if getattr(scheduler_output, "draft_token_ids", None):
+            return 1
+        return max(getattr(scheduler_output, "decode_steps", 1) or 1, 1)
+
+    def _simulate_device(self, scheduler_output) -> None:
+        if self._hbm_pass_seconds:
+            time.sleep(
+                self._hbm_pass_seconds * self._hbm_passes(scheduler_output)
+            )
+
     def _sample(self, scheduler_output) -> dict[str, list[int]]:
         """One sampled token per scheduled request: constant 42, or the
-        request's absolute position under VDT_MOCK_TOKEN_SEQ=1."""
+        deterministic position stream under VDT_MOCK_TOKEN_SEQ.  Spec
+        verify windows (draft_token_ids) emit the longest draft prefix
+        matching the stream plus one bonus token — the mock analog of
+        ops/sampling.spec_greedy_accept, so greedy bit-identity holds
+        by the same argument as on the real runner."""
+        drafts = getattr(scheduler_output, "draft_token_ids", None) or {}
         if not self._seq_mode:
-            return {
-                req_id: [42]
-                for req_id in scheduler_output.num_scheduled_tokens
-            }
+            out: dict[str, list[int]] = {}
+            for req_id in scheduler_output.num_scheduled_tokens:
+                d = drafts.get(req_id)
+                if d:
+                    a = 0
+                    while a < len(d) and d[a] == 42:
+                        a += 1
+                    out[req_id] = [42] * (a + 1)
+                else:
+                    out[req_id] = [42]
+            return out
         # Drop finished/preempted state BEFORE seeding new requests —
         # the real worker's _apply_scheduler_deltas order — so a step
         # that both finishes request id X and re-admits a new X keeps
@@ -159,18 +219,35 @@ class MockWorker:
             st = self._seq_state.get(req_id)
             if st is None:
                 continue
+            d = drafts.get(req_id)
+            if d is not None:
+                # Spec verify window: accept the longest draft prefix
+                # matching the deterministic stream, emit it plus one
+                # bonus token, and advance by the EMITTED count (the
+                # scheduler reconciles the same way).
+                pos0 = st["total"]
+                a = 0
+                while a < len(d) and d[a] == self._tok(pos0 + a):
+                    a += 1
+                emitted = [self._tok(pos0 + j) for j in range(a + 1)]
+                st["total"] += len(emitted)
+                st["computed"] = st["total"] - 1
+                sampled[req_id] = emitted
+                continue
             st["computed"] += n
             if st["computed"] >= st["total"]:
-                # Prompt fully prefetched: sample.  The token IS the
-                # absolute position, so a replayed request (longer
-                # prompt, same total) continues the identical sequence.
-                # A fused decode window (num_new > 1, engine
-                # num_decode_steps > 1) emits one position token per
-                # micro-step, exactly like the real worker's scan.
+                # Prompt fully prefetched: sample.  The token IS a
+                # function of the absolute position, so a replayed
+                # request (longer prompt, same total) continues the
+                # identical sequence.  A fused decode window (num_new
+                # > 1, engine num_decode_steps > 1) emits one position
+                # token per micro-step, exactly like the real worker's
+                # scan.
                 k = st["computed"] - st["total"] + 1
-                sampled[req_id] = list(
-                    range(st["total"], st["total"] + k)
-                )
+                sampled[req_id] = [
+                    self._tok(p)
+                    for p in range(st["total"], st["total"] + k)
+                ]
                 st["total"] += k
         return sampled
 
@@ -178,6 +255,7 @@ class MockWorker:
         self._maybe_fault()
         if self._execute_sleep:
             time.sleep(self._execute_sleep)
+        self._simulate_device(scheduler_output)
         sampled = self._sample(scheduler_output)
         if not self.is_driver_worker:
             return None
@@ -198,6 +276,7 @@ class MockWorker:
         so = self._deferred.get(timeout=10)
         assert so.step_id == step_id, (so.step_id, step_id)
         time.sleep(self._step_seconds)  # pretend the device is busy
+        self._simulate_device(so)
         self.timeline.append(("fetch_done", step_id, time.monotonic()))
         sampled = self._sample(so)
         if not self.is_driver_worker:

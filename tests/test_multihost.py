@@ -149,7 +149,8 @@ def test_dispatch_microbench():
     assert report["ok"], report
 
 
-def _engine_run(tmp_path, monkeypatch, *, streams: str, decode_steps: int):
+def _engine_run(tmp_path, monkeypatch, *, streams: str,
+                decode_steps: int, spec_k: int = 0, seq: str = "1"):
     """Boot a full LLMEngine over the mocked 2-host deployment and run
     three staggered greedy requests to completion; returns
     req_id -> tokens."""
@@ -159,7 +160,7 @@ def _engine_run(tmp_path, monkeypatch, *, streams: str, decode_steps: int):
     port = get_open_port()
     monkeypatch.setenv("VDT_SERVER_PORT", str(port))
     monkeypatch.setenv("VDT_STEP_STREAMS", streams)
-    monkeypatch.setenv("VDT_MOCK_TOKEN_SEQ", "1")
+    monkeypatch.setenv("VDT_MOCK_TOKEN_SEQ", seq)
     monkeypatch.setenv("VDT_MOCK_STEP_SECONDS", "0.01")
     monkeypatch.setenv("VDT_EXECUTE_MODEL_TIMEOUT_SECONDS", "30")
     monkeypatch.setenv("VDT_COMPILE_CACHE_DIR", str(tmp_path / "cc"))
@@ -168,7 +169,7 @@ def _engine_run(tmp_path, monkeypatch, *, streams: str, decode_steps: int):
         {
             "VDT_ADVERTISE_NUM_CHIPS": "4",
             "VDT_ADVERTISE_PLATFORM": "cpu",
-            "VDT_MOCK_TOKEN_SEQ": "1",
+            "VDT_MOCK_TOKEN_SEQ": seq,
             "VDT_MOCK_STEP_SECONDS": "0.01",
             "VDT_STEP_STREAMS": streams,
         },
@@ -178,12 +179,13 @@ def _engine_run(tmp_path, monkeypatch, *, streams: str, decode_steps: int):
         engine = LLMEngine.from_engine_args(
             EngineArgs(
                 model=write_llama_config(
-                    str(tmp_path / f"m-{streams}-{decode_steps}")
+                    str(tmp_path / f"m-{streams}-{decode_steps}-{spec_k}")
                 ),
                 skip_tokenizer_init=True,
                 load_format="dummy",
                 num_hosts=2,
                 num_decode_steps=decode_steps,
+                speculative_ngram_k=spec_k,
                 max_model_len=512,
                 distributed_executor_backend=MockedMultiHostExecutor,
             )
@@ -232,6 +234,34 @@ def test_pipelined_vs_blocking_engine_outputs_bit_identical(
     }
     assert blocking == expected
     assert overlapped == expected
+
+
+def test_spec_decode_over_step_streams_bit_identical(
+    tmp_path, monkeypatch
+):
+    """ISSUE 11: speculative verify frames (per-request drafts out,
+    realized spec_advance back) over the REAL persistent step-stream
+    protocol against a mocked 2-host deployment — outputs must match
+    the non-speculative run and the deterministic stream oracle, and
+    drafts must actually be accepted (the mirrors stayed in lockstep
+    through variable-advance windows or decode would have diverged)."""
+    seq = "seq:5,6,7,8"
+    base = _engine_run(
+        tmp_path, monkeypatch, streams="1", decode_steps=4, seq=seq
+    )
+    spec = _engine_run(
+        tmp_path, monkeypatch, streams="1", decode_steps=4, spec_k=3,
+        seq=seq,
+    )
+    expected = {
+        f"r{i}": [
+            (5, 6, 7, 8)[p % 4]
+            for p in range(3 + 2 * i, 3 + 2 * i + 9 + i)
+        ]
+        for i in range(3)
+    }
+    assert base == expected
+    assert spec == expected
 
 
 def test_short_host_rejected(tmp_path, monkeypatch):

@@ -1,0 +1,850 @@
+"""Speculative decoding (ISSUE 11): n-gram prompt-lookup drafts
+verified in one fused pass with greedy accept/reject.
+
+Layers covered:
+- proposer units (no match / prompt match / K-cap / output-history
+  match / longest-n preference);
+- the accept-length kernel (ops/sampling.spec_greedy_accept) against a
+  Python oracle, including masking and full-accept/reject extremes;
+- engine-level greedy bit-identity on the real tiny model — spec on vs
+  off through heterogeneous budgets, EOS/stop mid-window, chunked
+  prefill, and preemption/resume;
+- deterministic acceptance control through the mock worker
+  (VDT_MOCK_TOKEN_SEQ=seq:...): full-accept, full-reject, and
+  mixed-acceptance batches;
+- step-delta codec round trips with draft/accept fields (worker
+  mirrors stay in lockstep without override warnings);
+- supervisor journal replay with spec enabled;
+- the deterministic bench gate: with device time modeled as cost×HBM
+  passes (VDT_MOCK_HBM_PASS_SECONDS), spec decode on a fully
+  repetitive stream must beat fused decode by >= 1.3x.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.mock_worker import MockUniProcExecutor
+from vllm_distributed_tpu.config import EngineArgs, SchedulerConfig
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.engine.spec_decode import (
+    NgramProposer,
+    spec_eligible,
+)
+from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.testing import write_llama_config
+
+pytestmark = pytest.mark.spec
+
+
+# ---------------------------------------------------------------------
+# proposer units
+# ---------------------------------------------------------------------
+def test_propose_no_match():
+    p = NgramProposer(k=4)
+    assert p.propose([1, 2, 3, 4, 5]) == []
+    assert p.propose([]) == []
+    assert p.propose([7]) == []
+
+
+def test_propose_prompt_match():
+    # Tail [5, 6] recurs at the start; the continuation follows it.
+    p = NgramProposer(k=4)
+    assert p.propose([5, 6, 7, 9, 5, 6]) == [7, 9, 5, 6]
+
+
+def test_propose_k_cap():
+    p = NgramProposer(k=2)
+    assert p.propose([5, 6, 7, 9, 5, 6]) == [7, 9]
+    # max_draft caps below k too.
+    assert p.propose([5, 6, 7, 9, 5, 6], max_draft=1) == [7]
+    assert p.propose([5, 6, 7, 9, 5, 6], max_draft=0) == []
+
+
+def test_propose_output_history_match():
+    # The recurring n-gram lives entirely in generated output (the
+    # part after the "prompt" [1, 2]): proposals must see it.
+    p = NgramProposer(k=3)
+    history = [1, 2] + [8, 3, 4, 8, 3]
+    assert p.propose(history) == [4, 8, 3]
+
+
+def test_propose_longest_ngram_wins():
+    # 1-gram [6] matches at index 1 (continuation 9), but the 2-gram
+    # [5, 6] match is more specific and must win.
+    p = NgramProposer(k=1, min_n=1, max_n=3)
+    assert p.propose([5, 6, 9, 5, 6]) == [9]
+
+
+def test_propose_periodic_tail_overlap():
+    # Period-1 repetition: the match ends one short of the tail, so
+    # exactly the literal continuation is drafted.
+    p = NgramProposer(k=5)
+    assert p.propose([4, 4, 4, 4]) == [4]
+    # Longer cycles: the earliest match has the whole cycle ahead.
+    assert p.propose([1, 2, 3, 1, 2, 3, 1, 2]) == [3, 1, 2]
+
+
+def test_proposer_validation():
+    with pytest.raises(ValueError):
+        NgramProposer(k=0)
+    with pytest.raises(ValueError):
+        NgramProposer(k=2, min_n=3, max_n=2)
+    with pytest.raises(ValueError):
+        NgramProposer(k=2, min_n=0, max_n=2)
+
+
+def test_spec_eligible_gate():
+    assert spec_eligible(SamplingParams(temperature=0.0))
+    assert not spec_eligible(SamplingParams(temperature=0.7))
+    assert not spec_eligible(SamplingParams(temperature=0.0, logprobs=1))
+    assert not spec_eligible(
+        SamplingParams(temperature=0.0, repetition_penalty=1.2)
+    )
+    assert not spec_eligible(
+        SamplingParams(temperature=0.0, presence_penalty=0.5)
+    )
+    assert not spec_eligible(
+        SamplingParams(temperature=0.0, frequency_penalty=0.5)
+    )
+
+
+# ---------------------------------------------------------------------
+# accept kernel
+# ---------------------------------------------------------------------
+def _accept_oracle(logits, drafts, n_drafts):
+    """Reference accept/reject: sequential greedy comparison."""
+    greedy = np.argmax(logits, axis=-1)
+    out_tokens, out_n = [], []
+    for s in range(logits.shape[0]):
+        a = 0
+        while a < n_drafts[s] and drafts[s, a] == greedy[s, a]:
+            a += 1
+        out_tokens.append(greedy[s])
+        out_n.append(a + 1)
+    return np.stack(out_tokens), np.asarray(out_n)
+
+
+def test_accept_kernel_extremes():
+    from vllm_distributed_tpu.ops.sampling import spec_greedy_accept
+
+    rng = np.random.default_rng(0)
+    s, kp1, v = 4, 4, 16
+    logits = rng.normal(size=(s, kp1, v)).astype(np.float32)
+    greedy = np.argmax(logits, axis=-1)
+    drafts = np.full((s, kp1 - 1), -1, np.int32)
+    n_drafts = np.zeros(s, np.int32)
+    # Row 0: full accept (drafts copy the greedy chain).
+    drafts[0] = greedy[0, : kp1 - 1]
+    n_drafts[0] = kp1 - 1
+    # Row 1: full reject (first draft off-by-one).
+    drafts[1] = (greedy[1, : kp1 - 1] + 1) % v
+    n_drafts[1] = kp1 - 1
+    # Row 2: partial (first matches, second diverges).
+    drafts[2, 0] = greedy[2, 0]
+    drafts[2, 1] = (greedy[2, 1] + 1) % v
+    n_drafts[2] = 2
+    # Row 3: no drafts (plain decode row).
+    toks, n_emit = spec_greedy_accept(logits, drafts, n_drafts)
+    assert list(np.asarray(n_emit)) == [kp1, 1, 2, 1]
+    np.testing.assert_array_equal(np.asarray(toks), greedy)
+
+
+def test_accept_kernel_matches_oracle_randomized():
+    from vllm_distributed_tpu.ops.sampling import spec_greedy_accept
+
+    rng = np.random.default_rng(7)
+    for _ in range(10):
+        s, kp1, v = 8, 8, 32
+        logits = rng.normal(size=(s, kp1, v)).astype(np.float32)
+        greedy = np.argmax(logits, axis=-1)
+        n_drafts = rng.integers(0, kp1, size=s).astype(np.int32)
+        drafts = np.full((s, kp1 - 1), -1, np.int32)
+        for i in range(s):
+            for j in range(n_drafts[i]):
+                # Coin-flip between the matching token and a wrong one.
+                drafts[i, j] = (
+                    greedy[i, j]
+                    if rng.random() < 0.6
+                    else (greedy[i, j] + 1) % v
+                )
+        toks, n_emit = spec_greedy_accept(logits, drafts, n_drafts)
+        want_toks, want_n = _accept_oracle(logits, drafts, n_drafts)
+        np.testing.assert_array_equal(np.asarray(toks), want_toks)
+        np.testing.assert_array_equal(np.asarray(n_emit), want_n)
+        # The emitted prefix is exactly what sequential greedy decode
+        # would produce — the bit-identity invariant.
+        for i in range(s):
+            m = int(want_n[i])
+            assert 1 <= m <= n_drafts[i] + 1
+            assert list(np.asarray(toks)[i, :m]) == list(greedy[i, :m])
+
+
+# ---------------------------------------------------------------------
+# engine-level bit-identity (real tiny model, dummy weights)
+# ---------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    return write_llama_config(
+        str(tmp_path_factory.mktemp("spec") / "m")
+    )
+
+
+def _run(model_dir, reqs, *, spec_k=0, track_spec=None, **engine_kw):
+    kw = dict(
+        model=model_dir,
+        skip_tokenizer_init=True,
+        load_format="dummy",
+        num_kv_pages=128,
+        max_model_len=256,
+        num_decode_steps=4,
+        speculative_ngram_k=spec_k,
+    )
+    kw.update(engine_kw)
+    engine = LLMEngine.from_engine_args(EngineArgs(**kw))
+    for i, (prompt, sp_kw) in enumerate(reqs):
+        engine.add_request(
+            f"r{i}",
+            prompt_token_ids=list(prompt),
+            sampling_params=SamplingParams(**sp_kw),
+        )
+    results: dict[str, list[int]] = {}
+    steps = 0
+    while engine.has_unfinished_requests():
+        for out in engine.step():
+            if out.finished:
+                results[out.request_id] = out.outputs[0].token_ids
+        steps += 1
+        assert steps < 800
+    if track_spec is not None:
+        track_spec.append(
+            (
+                engine.scheduler.spec_drafted_tokens,
+                engine.scheduler.spec_accepted_tokens,
+            )
+        )
+        track_spec.append(engine.metrics.render().decode())
+    engine.shutdown()
+    return results
+
+
+REPETITIVE = [3, 7, 11, 3, 7, 11, 3, 7]
+PLAIN = [5, 9, 2, 4]
+
+
+def test_spec_greedy_bit_identity_heterogeneous_budgets(model_dir):
+    """Spec on vs off over a mixed batch — repetitive and plain
+    prompts, budgets that end mid-window — must be bit-identical, and
+    the verify passes must actually accept drafts."""
+    reqs = [
+        (REPETITIVE, dict(temperature=0.0, max_tokens=m, ignore_eos=True))
+        for m in (3, 17, 40, 5)
+    ] + [
+        (PLAIN, dict(temperature=0.0, max_tokens=23, ignore_eos=True)),
+    ]
+    base = _run(model_dir, reqs, spec_k=0)
+    stats: list = []
+    spec = _run(model_dir, reqs, spec_k=4, track_spec=stats)
+    assert spec == base
+    drafted, accepted = stats[0]
+    assert drafted > 0 and 0 < accepted <= drafted
+    # Lengths exactly honor per-request budgets (no draft overshoot).
+    assert sorted(len(v) for v in spec.values()) == [3, 5, 17, 23, 40]
+
+
+def test_spec_stop_token_mid_window(model_dir):
+    """A stop token accepted mid-verify-window must truncate exactly
+    where the sequential engine would."""
+    probe = _run(
+        model_dir,
+        [(REPETITIVE, dict(temperature=0.0, max_tokens=24, ignore_eos=True))],
+    )["r0"]
+    stop_tok = probe[7]
+    reqs = [
+        (
+            REPETITIVE,
+            dict(temperature=0.0, max_tokens=24, stop_token_ids=[stop_tok]),
+        )
+    ]
+    assert _run(model_dir, reqs, spec_k=4) == _run(model_dir, reqs)
+
+
+def test_spec_through_preemption_and_chunked_prefill(model_dir):
+    """Starved page pool (preemption/resume) + tiny token budget
+    (chunked prefill) with spec on must still match the unconstrained
+    non-speculative run."""
+    reqs = [
+        (
+            list(range(1, 30)) + REPETITIVE,
+            dict(temperature=0.0, max_tokens=8, ignore_eos=True),
+        ),
+        (
+            list(range(30, 55)) + REPETITIVE,
+            dict(temperature=0.0, max_tokens=8, ignore_eos=True),
+        ),
+    ]
+    rich = _run(model_dir, reqs, spec_k=0)
+    poor = _run(
+        model_dir,
+        reqs,
+        spec_k=4,
+        num_kv_pages=10,
+        max_num_batched_tokens=32,
+        max_num_seqs=8,
+    )
+    assert poor == rich
+
+
+def test_spec_sampling_requests_opt_out(model_dir):
+    """Seeded sampling is spec-ineligible: with spec configured the
+    batch falls back to the normal path and outputs stay identical."""
+    reqs = [
+        (
+            REPETITIVE,
+            dict(temperature=0.9, seed=41, max_tokens=12, ignore_eos=True),
+        )
+    ]
+    stats: list = []
+    spec = _run(model_dir, reqs, spec_k=4, track_spec=stats)
+    assert spec == _run(model_dir, reqs, spec_k=0)
+    assert stats[0] == (0, 0)  # nothing drafted for a sampling batch
+
+
+def test_spec_metrics_and_registry(model_dir):
+    """Spec counters flow to /metrics and the acceptance-length
+    histogram observes once per verified window."""
+    stats: list = []
+    _run(
+        model_dir,
+        [(REPETITIVE, dict(temperature=0.0, max_tokens=16, ignore_eos=True))],
+        spec_k=4,
+        track_spec=stats,
+    )
+    drafted, accepted = stats[0]
+    rendered = stats[1]
+    assert drafted > 0
+    assert (
+        f'vllm:spec_decode_draft_tokens_total{{model_name="'
+        in rendered.replace("\n", " ")
+        or "vllm:spec_decode_draft_tokens_total" in rendered
+    )
+
+    def metric(name):
+        for line in rendered.splitlines():
+            if line.startswith(name + "{"):
+                return float(line.rsplit(" ", 1)[1])
+        return None
+
+    assert metric("vllm:spec_decode_draft_tokens_total") == drafted
+    assert metric("vllm:spec_decode_accepted_tokens_total") == accepted
+    assert metric("vllm:spec_decode_acceptance_length_count") > 0
+
+
+# ---------------------------------------------------------------------
+# deterministic acceptance control (mock worker, VDT_MOCK_TOKEN_SEQ)
+# ---------------------------------------------------------------------
+def _mock_run(model_dir, prompts_and_budgets, *, spec_k, seq,
+              monkeypatch, num_decode_steps=4, hbm_pass_seconds=None):
+    monkeypatch.setenv("VDT_MOCK_TOKEN_SEQ", seq)
+    if hbm_pass_seconds is not None:
+        monkeypatch.setenv(
+            "VDT_MOCK_HBM_PASS_SECONDS", str(hbm_pass_seconds)
+        )
+    else:
+        monkeypatch.delenv("VDT_MOCK_HBM_PASS_SECONDS", raising=False)
+    engine = LLMEngine.from_engine_args(
+        EngineArgs(
+            model=model_dir,
+            skip_tokenizer_init=True,
+            load_format="dummy",
+            num_kv_pages=64,
+            max_model_len=256,
+            num_decode_steps=num_decode_steps,
+            speculative_ngram_k=spec_k,
+            distributed_executor_backend=MockUniProcExecutor,
+        )
+    )
+    for i, (prompt, max_tokens) in enumerate(prompts_and_budgets):
+        engine.add_request(
+            f"m{i}",
+            prompt_token_ids=list(prompt),
+            sampling_params=SamplingParams(
+                temperature=0.0, max_tokens=max_tokens, ignore_eos=True
+            ),
+        )
+    results: dict[str, list[int]] = {}
+    import time as _time
+
+    t0 = _time.perf_counter()
+    steps = 0
+    while engine.has_unfinished_requests():
+        for out in engine.step():
+            if out.finished:
+                results[out.request_id] = out.outputs[0].token_ids
+        steps += 1
+        assert steps < 800
+    elapsed = _time.perf_counter() - t0
+    stats = (
+        engine.scheduler.spec_drafted_tokens,
+        engine.scheduler.spec_accepted_tokens,
+    )
+    engine.shutdown()
+    return results, stats, elapsed
+
+
+def test_mock_full_accept_batch(model_dir, monkeypatch):
+    """Periodic stream whose prompt covers a full cycle: every draft
+    verifies, acceptance rate is exactly 1.0, outputs bit-identical."""
+    seq = "seq:7,8,9,10"
+    work = [([7, 8, 9, 10, 7, 8, 9, 10], 16)]
+    base, _, _ = _mock_run(
+        model_dir, work, spec_k=0, seq=seq, monkeypatch=monkeypatch
+    )
+    spec, (drafted, accepted), _ = _mock_run(
+        model_dir, work, spec_k=3, seq=seq, monkeypatch=monkeypatch
+    )
+    assert spec == base
+    assert drafted > 0 and accepted == drafted
+    # The stream really is the periodic continuation.
+    assert spec["m0"] == [(7, 8, 9, 10)[p % 4] for p in range(8, 24)]
+
+
+def test_mock_full_reject_window(model_dir, monkeypatch):
+    """History whose recurring n-gram continues differently than the
+    emitted stream: the verify window drafts and rejects everything
+    (bonus token only), outputs still bit-identical."""
+    # Prefill emits position 6 of the stream (7), making the history
+    # tail [5,6,7] — which recurs at index 0 with continuation
+    # [9,5,...]; the stream actually emits 80, 81, ... so every draft
+    # is rejected.
+    seq = "seq:5,6,7,9,5,6,7,80,81,82,83,84,85,86,87,88"
+    work = [([5, 6, 7, 9, 5, 6], 4)]
+    base, _, _ = _mock_run(
+        model_dir, work, spec_k=3, seq=seq, monkeypatch=monkeypatch,
+        num_decode_steps=1,
+    )
+    spec, (drafted, accepted), _ = _mock_run(
+        model_dir, work, spec_k=3, seq=seq, monkeypatch=monkeypatch,
+        num_decode_steps=1,
+    )
+    assert spec == base == {"m0": [7, 80, 81, 82]}
+    assert drafted >= 2 and accepted == 0
+
+
+def test_mock_mixed_acceptance_batch(model_dir, monkeypatch):
+    """One full-accept request, one partial-accept request, one
+    drafting-nothing request in the same batch."""
+    # Stream period 8.  Request A's prompt is a full double period of
+    # the first 4 -> its drafts continue [1,2,3,4] and fully accept
+    # until the stream leaves the sub-cycle; request B's tail [1,2]
+    # matches its own prompt start with continuation [3,4,...] but the
+    # stream diverges at position 7 (9 != 4) -> partial accepts;
+    # request C has no recurring n-gram and an aperiodic continuation.
+    seq = "seq:1,2,3,4,1,2,3,9"
+    work = [
+        ([1, 2, 3, 4, 1, 2, 3, 9], 10),  # aligned: high acceptance
+        ([1, 2, 3, 4, 1, 2], 6),  # diverges at the period boundary
+        ([40, 50, 60], 4),  # nothing to look up at first
+    ]
+    base, _, _ = _mock_run(
+        model_dir, work, spec_k=3, seq=seq, monkeypatch=monkeypatch
+    )
+    spec, (drafted, accepted), _ = _mock_run(
+        model_dir, work, spec_k=3, seq=seq, monkeypatch=monkeypatch
+    )
+    assert spec == base
+    assert drafted > 0
+    assert 0 < accepted < drafted  # genuinely mixed acceptance
+
+
+def test_spec_bench_gate_mock(model_dir, monkeypatch):
+    """The deterministic throughput gate: with device time modeled as
+    cost x HBM passes (fused decode pays one per micro-step, a verify
+    window pays one total), spec decode on a fully repetitive stream
+    must deliver >= 1.3x tokens/s at its measured acceptance rate."""
+    seq = "seq:7,8,9,10"
+    work = [([7, 8, 9, 10, 7, 8, 9, 10], 48)]
+    base, _, base_s = _mock_run(
+        model_dir, work, spec_k=0, seq=seq, monkeypatch=monkeypatch,
+        num_decode_steps=4, hbm_pass_seconds=0.004,
+    )
+    spec, (drafted, accepted), spec_s = _mock_run(
+        model_dir, work, spec_k=4, seq=seq, monkeypatch=monkeypatch,
+        num_decode_steps=4, hbm_pass_seconds=0.004,
+    )
+    assert spec == base
+    acceptance = accepted / max(drafted, 1)
+    assert acceptance > 0.9  # fully repetitive stream
+    speedup = base_s / spec_s
+    assert speedup >= 1.3, (
+        f"spec decode speedup {speedup:.2f}x < 1.3x "
+        f"(acceptance {acceptance:.2f})"
+    )
+
+
+def test_spec_dormant_pipelining_resumes(model_dir, monkeypatch):
+    """Hysteresis: non-repetitive greedy traffic with spec configured
+    must fall back to the async dispatch pipeline after the dry limit
+    instead of running synchronously forever — and still produce the
+    oracle token stream."""
+    monkeypatch.setenv("VDT_MOCK_TOKEN_SEQ", "1")
+    monkeypatch.delenv("VDT_MOCK_HBM_PASS_SECONDS", raising=False)
+    engine = LLMEngine.from_engine_args(
+        EngineArgs(
+            model=model_dir,
+            skip_tokenizer_init=True,
+            load_format="dummy",
+            num_kv_pages=64,
+            max_model_len=256,
+            num_decode_steps=4,
+            speculative_ngram_k=3,
+            distributed_executor_backend=MockUniProcExecutor,
+        )
+    )
+    # Identity stream + distinct prompt tokens: no n-gram ever recurs,
+    # so the proposer stays dry for the whole run.
+    engine.add_request(
+        "m0",
+        prompt_token_ids=[100, 200, 300],
+        sampling_params=SamplingParams(
+            temperature=0.0, max_tokens=60, ignore_eos=True
+        ),
+    )
+    depths = []
+    toks = None
+    steps = 0
+    while engine.has_unfinished_requests():
+        depths.append(len(engine._pending))
+        for out in engine.step():
+            if out.finished:
+                toks = out.outputs[0].token_ids
+        steps += 1
+        assert steps < 400
+    engine.shutdown()
+    assert toks == list(range(3, 63))
+    assert engine.scheduler.spec_drafted_tokens == 0
+    # The dispatch pipeline re-engaged during the dormant stretch.
+    assert max(depths) >= 1
+
+
+def test_spec_hysteresis_probe_reengages():
+    """Scheduler-level hysteresis cycle: dry streak -> dormant
+    (pipelining allowed) -> periodic probe -> repetitive text
+    re-engages spec."""
+    from vllm_distributed_tpu.config import CacheConfig
+    from vllm_distributed_tpu.engine.request import Request
+    from vllm_distributed_tpu.engine.scheduler import (
+        _SPEC_DRY_LIMIT,
+        _SPEC_PROBE_INTERVAL,
+        Scheduler,
+    )
+
+    sched = Scheduler(
+        SchedulerConfig(
+            max_num_seqs=4,
+            max_num_batched_tokens=256,
+            enable_chunked_prefill=True,
+            max_model_len=512,
+            num_decode_steps=4,
+            spec_ngram_k=3,
+        ),
+        CacheConfig(page_size=4),
+        num_pages=128,
+    )
+    sched.add_request(
+        Request(
+            request_id="a",
+            prompt_token_ids=[100, 200, 300],
+            sampling_params=SamplingParams(
+                temperature=0.0, max_tokens=400, ignore_eos=True
+            ),
+            eos_token_id=None,
+        )
+    )
+    tok = 1000
+
+    def drain_one(so):
+        nonlocal tok
+        n = so.num_scheduled_tokens.get("a", 0)
+        req = sched.requests["a"]
+        if req.num_computed_tokens + n >= req.num_tokens:
+            toks = list(range(tok, tok + n))
+            tok += n
+            sched.update_from_output(so, {"a": toks})
+        else:
+            sched.update_from_output(so, {})
+
+    drain_one(sched.schedule())  # prefill
+    assert sched.spec_wants_sync()
+    # Distinct tokens: the proposer stays dry until the limit trips.
+    for _ in range(_SPEC_DRY_LIMIT):
+        assert sched.spec_wants_sync()
+        so = sched.schedule()
+        assert not so.draft_token_ids
+        drain_one(so)
+    assert not sched.spec_wants_sync()  # dormant: pipelining allowed
+    # Pipelined continuations (no update between schedules) count
+    # toward the probe cadence; the FIRST dormant schedule still sees
+    # inflight == 0 (a free probe) and does not count.
+    pending = []
+    for _ in range(_SPEC_PROBE_INTERVAL + 1):
+        assert not sched.spec_wants_sync()
+        pending.append(sched.schedule())
+    assert sched.spec_wants_sync()  # probe drain due
+    # Drain the window; the text now turns repetitive, so the probing
+    # schedule finds drafts and spec re-engages.
+    for so in pending:
+        n = so.num_scheduled_tokens["a"]
+        sched.update_from_output(so, {"a": [7] * n})
+    so = sched.schedule()
+    assert so.draft_token_ids.get("a")
+    assert sched.spec_wants_sync()
+
+
+# ---------------------------------------------------------------------
+# step-delta codec: draft/accept fields keep mirrors in lockstep
+# ---------------------------------------------------------------------
+def test_step_delta_spec_roundtrip_lockstep():
+    from vllm_distributed_tpu.config import CacheConfig
+    from vllm_distributed_tpu.engine.request import Request
+    from vllm_distributed_tpu.engine.scheduler import Scheduler
+    from vllm_distributed_tpu.engine.step_delta import (
+        StepDeltaEncoder,
+        StepStateMirror,
+    )
+
+    sched = Scheduler(
+        SchedulerConfig(
+            max_num_seqs=8,
+            max_num_batched_tokens=64,
+            enable_chunked_prefill=True,
+            max_model_len=256,
+            num_decode_steps=1,
+            spec_ngram_k=3,
+        ),
+        CacheConfig(page_size=4),
+        num_pages=64,
+    )
+    encoder = StepDeltaEncoder()
+    mirrors = [StepStateMirror(), StepStateMirror()]
+    # Periodic prompt: the proposer drafts, the fake device accepts a
+    # varying prefix (cycling 0..k accepted) to exercise every
+    # spec_advance value.
+    sched.add_request(
+        Request(
+            request_id="a",
+            prompt_token_ids=[3, 7, 3, 7, 3],
+            sampling_params=SamplingParams(
+                temperature=0.0, max_tokens=14, ignore_eos=True
+            ),
+            eos_token_id=None,
+        )
+    )
+    accept_cycle = [3, 0, 1, 2]
+    spec_steps = 0
+    saw_advance = False
+    for step in range(200):
+        so = sched.schedule()
+        if so.is_empty:
+            break
+        frame = encoder.encode(so)
+        assert frame.raw is None
+        assert not frame.computed_overrides, (
+            "spec steps must reconcile via spec_advance, not overrides"
+        )
+        if frame.spec_advance:
+            saw_advance = True
+        for mirror in mirrors:
+            rebuilt = mirror.decode(frame)
+            assert rebuilt == so
+        sampled = {}
+        for rid, n in so.num_scheduled_tokens.items():
+            req = sched.requests[rid]
+            d = so.draft_token_ids.get(rid)
+            if d is not None:
+                spec_steps += 1
+                a = min(accept_cycle[spec_steps % 4], len(d))
+                # Accepted drafts echo the drafted tokens (the argmax
+                # chain equals them by definition of accept); the bonus
+                # stays in the {3, 7} alphabet so the proposer keeps
+                # finding matches and windows keep coming.
+                sampled[rid] = list(d[:a]) + [7]
+            elif req.num_computed_tokens + n >= req.num_tokens:
+                sampled[rid] = [7 if step % 2 else 3]
+        sched.update_from_output(so, sampled)
+    assert spec_steps >= 2 and saw_advance
+    assert encoder.num_mirrored == mirrors[0].num_mirrored
+    assert mirrors[0].num_mirrored == mirrors[1].num_mirrored
+
+
+# ---------------------------------------------------------------------
+# supervisor replay with spec enabled
+# ---------------------------------------------------------------------
+def test_replay_equivalence_with_spec(tmp_path):
+    """Kill-and-replay determinism with spec decode on: reference run
+    to completion, twin stopped partway, journal replayed onto a fresh
+    spec-enabled engine — final output bit-identical (and equal to the
+    non-speculative run)."""
+    from vllm_distributed_tpu.engine.supervisor import (
+        EngineSupervisor,
+        JournalEntry,
+        RestartPolicy,
+    )
+
+    model = write_llama_config(str(tmp_path / "m"))
+    sp = SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True)
+    prompt = list(REPETITIVE)
+
+    def engine(spec_k):
+        return LLMEngine.from_engine_args(
+            EngineArgs(
+                model=model,
+                skip_tokenizer_init=True,
+                load_format="dummy",
+                num_kv_pages=64,
+                max_model_len=128,
+                num_decode_steps=2,
+                speculative_ngram_k=spec_k,
+            )
+        )
+
+    def drain(eng, rid):
+        tokens = None
+        while eng.has_unfinished_requests():
+            for out in eng.step():
+                if out.request_id == rid:
+                    tokens = list(out.outputs[0].token_ids)
+        return tokens
+
+    ref = engine(spec_k=4)
+    try:
+        ref.add_request("x", prompt_token_ids=list(prompt),
+                        sampling_params=sp.clone())
+        reference = drain(ref, "x")
+    finally:
+        ref.shutdown()
+    off = engine(spec_k=0)
+    try:
+        off.add_request("x", prompt_token_ids=list(prompt),
+                        sampling_params=sp.clone())
+        assert drain(off, "x") == reference
+    finally:
+        off.shutdown()
+
+    cut = engine(spec_k=4)
+    emitted: list[int] = []
+    try:
+        cut.add_request("x", prompt_token_ids=list(prompt),
+                        sampling_params=sp.clone())
+        while len(emitted) < 4:
+            for out in cut.step():
+                emitted = list(out.outputs[0].token_ids)
+    finally:
+        cut.shutdown()
+    assert reference[: len(emitted)] == emitted
+
+    class _Stub:
+        def __init__(self):
+            self._journal = {}
+            self.errors = []
+
+        def _to_request_queue(self, request_id, e):
+            self.errors.append((request_id, e))
+
+    new = engine(spec_k=4)
+    try:
+        stub = _Stub()
+        sup = EngineSupervisor(
+            stub,
+            policy=RestartPolicy(
+                max_restarts=3, backoff_base=0.1, backoff_cap=1.0,
+                window=300,
+            ),
+        )
+        entry = JournalEntry(
+            request_id="x",
+            prompt=None,
+            prompt_token_ids=list(prompt),
+            sampling_params=sp.clone(),
+        )
+        entry.admitted = True
+        entry.emitted_token_ids = list(emitted)
+        stub._journal["x"] = entry
+        assert sup._replay(new) == 1
+        final = drain(new, "x")
+    finally:
+        new.shutdown()
+    assert final == reference, (final, reference)
+
+
+# ---------------------------------------------------------------------
+# config / env knobs
+# ---------------------------------------------------------------------
+def test_cli_and_env_knobs(model_dir, monkeypatch):
+    import argparse
+
+    parser = EngineArgs.add_cli_args(argparse.ArgumentParser())
+    args = parser.parse_args(
+        ["--model", model_dir, "--speculative-ngram-k", "5",
+         "--speculative-ngram-max", "4", "--skip-tokenizer-init"]
+    )
+    cfg = EngineArgs.from_cli_args(args).create_engine_config()
+    assert cfg.scheduler_config.spec_ngram_k == 5
+    assert cfg.scheduler_config.spec_ngram_max == 4
+    assert cfg.scheduler_config.spec_ngram_min == 1
+    # Env fallback when the CLI flag is absent.
+    monkeypatch.setenv("VDT_SPEC_NGRAM_K", "2")
+    cfg = EngineArgs(
+        model=model_dir, skip_tokenizer_init=True
+    ).create_engine_config()
+    assert cfg.scheduler_config.spec_ngram_k == 2
+
+
+def test_scheduler_config_validation():
+    with pytest.raises(ValueError, match="spec_ngram_k"):
+        SchedulerConfig(spec_ngram_k=-1)
+    with pytest.raises(ValueError, match="spec_ngram_min"):
+        SchedulerConfig(spec_ngram_k=2, spec_ngram_min=3, spec_ngram_max=2)
+    with pytest.raises(ValueError, match="verify window"):
+        SchedulerConfig(
+            spec_ngram_k=4096,
+            max_num_batched_tokens=2048,
+            max_num_seqs=8,
+        )
+    # Off (0) skips the min/max check entirely.
+    SchedulerConfig(spec_ngram_k=0, spec_ngram_min=9, spec_ngram_max=1)
+
+
+# ---------------------------------------------------------------------
+# trace_summary surfaces acceptance
+# ---------------------------------------------------------------------
+def test_trace_summary_spec_section():
+    import importlib
+
+    ts = importlib.import_module("tools.trace_summary")
+    traces = [
+        {
+            "trace_id": "t1",
+            "spans": [
+                {
+                    "name": "engine.spec_decode",
+                    "attributes": {"drafted": 6, "accepted": 4},
+                },
+                {
+                    "name": "engine.spec_decode",
+                    "attributes": {"drafted": 2, "accepted": 0},
+                },
+                {"name": "engine.decode", "start": 0, "duration": 0.5},
+            ],
+        }
+    ]
+    spec = ts.spec_summary(traces)
+    assert spec == {
+        "verify_steps": 2,
+        "drafted": 8,
+        "accepted": 4,
+        "acceptance_rate": 0.5,
+    }
+    assert "acceptance" in ts.format_spec(spec)
+    assert ts.spec_summary([{"spans": []}]) is None

@@ -159,6 +159,45 @@ def overlap_summary(traces: list[dict]) -> dict | None:
     }
 
 
+def spec_summary(traces: list[dict]) -> dict | None:
+    """Speculative-decoding acceptance view (ISSUE 11): aggregate the
+    ``engine.spec_decode`` instant events the engine emits per verify
+    step (attributes: drafted, accepted).  Returns ``{"verify_steps",
+    "drafted", "accepted", "acceptance_rate"}`` or None when the dump
+    has no spec events (spec decode off, or tracing predates it)."""
+    steps = drafted = accepted = 0
+    for trace in traces:
+        for span in trace.get("spans", []):
+            if span.get("name") != "engine.spec_decode":
+                continue
+            attrs = span.get("attributes") or {}
+            if "drafted" not in attrs:
+                continue
+            steps += 1
+            drafted += int(attrs.get("drafted", 0))
+            accepted += int(attrs.get("accepted", 0))
+    if not steps:
+        return None
+    return {
+        "verify_steps": steps,
+        "drafted": drafted,
+        "accepted": accepted,
+        "acceptance_rate": (accepted / drafted) if drafted else 0.0,
+    }
+
+
+def format_spec(spec: dict) -> str:
+    return "\n".join(
+        [
+            "speculative decoding (greedy n-gram verify)",
+            f"  verify steps   : {spec['verify_steps']}",
+            f"  drafted tokens : {spec['drafted']}",
+            f"  accepted tokens: {spec['accepted']}",
+            f"  acceptance rate: {spec['acceptance_rate']:.3f}",
+        ]
+    )
+
+
 def format_overlap(overlap: dict) -> str:
     lines = [
         "dispatch overlap (gap = dispatch N+1 start - gather N end; "
@@ -210,6 +249,10 @@ def main(argv: list[str] | None = None) -> int:
     if overlap is not None:
         print()
         print(format_overlap(overlap))
+    spec = spec_summary(traces)
+    if spec is not None:
+        print()
+        print(format_spec(spec))
     return 0
 
 

@@ -252,6 +252,16 @@ class SchedulerConfig:
     # sheds it with finish_reason="overloaded" instead of recompute
     # thrash.  0 = off.
     preempt_shed_threshold: int = 0
+    # ---- speculative decoding (ISSUE 11; default OFF) ----
+    # Max tokens the n-gram prompt-lookup proposer drafts per request
+    # per step (engine/spec_decode.py); the model runner verifies all
+    # drafts in one fused pass and greedy accept/reject keeps the
+    # matching prefix + one bonus token.  Greedy outputs stay
+    # bit-identical to the non-speculative path.  0 = off.
+    spec_ngram_k: int = 0
+    # Tail n-gram match lengths the proposer tries (longest first).
+    spec_ngram_max: int = 3
+    spec_ngram_min: int = 1
 
     def fused_decode_steps(self) -> int:
         """The uniform fused-scan length K the scheduler emits: the
@@ -279,6 +289,26 @@ class SchedulerConfig:
             raise ValueError(
                 "kv_admission_watermark must be in [0, 1), got "
                 f"{self.kv_admission_watermark}"
+            )
+        if self.spec_ngram_k < 0:
+            raise ValueError(
+                f"spec_ngram_k must be >= 0 (0 disables), got "
+                f"{self.spec_ngram_k}"
+            )
+        if self.spec_ngram_k and not (
+            1 <= self.spec_ngram_min <= self.spec_ngram_max
+        ):
+            raise ValueError(
+                "need 1 <= spec_ngram_min <= spec_ngram_max, got "
+                f"min={self.spec_ngram_min} max={self.spec_ngram_max}"
+            )
+        if self.spec_ngram_k and (
+            self.spec_ngram_k + 1 > self.max_num_batched_tokens
+        ):
+            raise ValueError(
+                f"spec_ngram_k={self.spec_ngram_k} needs a verify window "
+                f"of {self.spec_ngram_k + 1} tokens but the step budget "
+                f"is {self.max_num_batched_tokens}"
             )
         for name in (
             "max_waiting_requests",
@@ -514,6 +544,11 @@ class EngineArgs:
     default_deadline_ms: int | None = None
     preempt_shed_threshold: int | None = None
 
+    # Speculative decoding (None -> resolved late from VDT_SPEC_NGRAM_*).
+    speculative_ngram_k: int | None = None
+    speculative_ngram_max: int | None = None
+    speculative_ngram_min: int | None = None
+
     # JSON dict (or dict) configuring a KV connector (disaggregated
     # prefill hook, SURVEY.md §3.4); None = off.
     kv_transfer_config: Any = None
@@ -650,6 +685,29 @@ class EngineArgs:
             'finish_reason="overloaded" instead of recompute thrash '
             "(default: $VDT_PREEMPT_SHED_THRESHOLD or 0 = off)",
         )
+        parser.add_argument(
+            "--speculative-ngram-k",
+            type=int,
+            default=None,
+            help="speculative decoding: max tokens the n-gram "
+            "prompt-lookup proposer drafts per request per step, "
+            "verified in one fused pass; greedy outputs stay "
+            "bit-identical (default: $VDT_SPEC_NGRAM_K or 0 = off)",
+        )
+        parser.add_argument(
+            "--speculative-ngram-max",
+            type=int,
+            default=None,
+            help="longest tail n-gram the proposer matches (default: "
+            "$VDT_SPEC_NGRAM_MAX or 3)",
+        )
+        parser.add_argument(
+            "--speculative-ngram-min",
+            type=int,
+            default=None,
+            help="shortest tail n-gram the proposer matches (default: "
+            "$VDT_SPEC_NGRAM_MIN or 1)",
+        )
         parser.add_argument("--device", type=str, default="auto")
         parser.add_argument("--profile-dir", type=str, default=None)
         parser.add_argument("--disable-log-stats", action="store_true")
@@ -740,6 +798,15 @@ class EngineArgs:
             ),
             preempt_shed_threshold=_env_default(
                 self.preempt_shed_threshold, "VDT_PREEMPT_SHED_THRESHOLD"
+            ),
+            spec_ngram_k=_env_default(
+                self.speculative_ngram_k, "VDT_SPEC_NGRAM_K"
+            ),
+            spec_ngram_max=_env_default(
+                self.speculative_ngram_max, "VDT_SPEC_NGRAM_MAX"
+            ),
+            spec_ngram_min=_env_default(
+                self.speculative_ngram_min, "VDT_SPEC_NGRAM_MIN"
             ),
         )
         kv_transfer = self.kv_transfer_config

@@ -222,6 +222,28 @@ class PrefixCachingAllocator(PageAllocator):
         req.page_ids = []
 
     # ---- prefix-cache surface (scheduler-facing) ----
+    @staticmethod
+    def registrable_tokens(req: Request) -> int:
+        """Tokens whose KV rows are VALID and whose token ids exist on
+        the host — the registration horizon.  This is where discarded
+        KV rows are fenced off the cache:
+
+        - fused-decode early stop: ``num_computed_tokens`` advanced by
+          the full scan window but the host token list was truncated at
+          the stop — rows past ``num_tokens`` are dead;
+        - speculative decoding (ISSUE 11): the verify pass WRITES rows
+          for every drafted position but the scheduler advances
+          ``num_computed_tokens`` only by the accepted prefix, so
+          rejected-draft rows sit past ``num_computed_tokens`` and are
+          overwritten in place by the next window — never registered,
+          never attachable by another request.
+
+        Both clamps matter: registering a page containing a dead or
+        rejected row would serve another request garbage KV under a
+        hash computed from tokens that were never (validly) written.
+        """
+        return min(req.num_computed_tokens, req.num_tokens)
+
     def _chain(self, req: Request, upto_pages: int) -> list[bytes]:
         """The request's page hash chain, memoized and extended on
         demand (each page hashed at most once per request lifetime)."""
@@ -306,10 +328,7 @@ class PrefixCachingAllocator(PageAllocator):
         rid = req.request_id
         n_reg = self._reg.get(rid, 0)
         ps = self.page_size
-        # num_computed_tokens can overrun the host token list when an
-        # early stop discards the tail of a fused-decode dispatch; only
-        # pages whose tokens all exist are hashable.
-        full = min(req.num_computed_tokens, req.num_tokens) // ps
+        full = self.registrable_tokens(req) // ps
         if full <= n_reg:
             return
         pages = self._allocated.get(rid, [])

@@ -18,6 +18,7 @@ from vllm_distributed_tpu.engine.request import (
     RequestStatus,
 )
 from vllm_distributed_tpu.engine.scheduler import Scheduler
+from vllm_distributed_tpu.engine.spec_decode import spec_eligible
 from vllm_distributed_tpu.executor.abstract import Executor
 from vllm_distributed_tpu.logger import init_logger
 from vllm_distributed_tpu.outputs import CompletionOutput, RequestOutput
@@ -86,6 +87,7 @@ class LLMEngine:
         self.executor.metrics = self.metrics
         self._preemptions_seen = 0
         self._prefix_cache_seen = (0, 0)  # (queries, hits) already recorded
+        self._spec_seen = (0, 0)  # (drafted, accepted) already recorded
 
         self.tokenizer = None
         if not config.model_config.skip_tokenizer_init:
@@ -205,6 +207,23 @@ class LLMEngine:
         enough free pages that scheduling cannot preempt anything."""
         s = self.scheduler
         if s.config.num_decode_steps <= 1 or s.waiting or not s.running:
+            return False
+        if (
+            s.spec is not None
+            and s.spec_wants_sync()
+            and all(spec_eligible(r.sampling_params) for r in s.running)
+        ):
+            # Speculative decoding runs synchronous verify passes: the
+            # proposer and the verify input both need the host-current
+            # last token, so while a batch that COULD draft (all
+            # greedy, no penalties/logprobs) keeps drafting, every
+            # dispatch resolves before the next schedule — the verify
+            # pass itself is the latency hider, one HBM pass per
+            # accepted window instead of per token.  Spec-impossible
+            # batches (any sampled request) and draftless stretches
+            # (spec_wants_sync hysteresis) keep the async dispatch
+            # pipeline; the periodic probe drain re-engages spec when
+            # the text turns repetitive.
             return False
         for r in s.running:
             sp = r.sampling_params
@@ -445,6 +464,29 @@ class LLMEngine:
         )
         self._prefix_cache_seen = pc
         self.metrics.record_kv_cache_usage(self.scheduler.kv_cache_usage)
+        if scheduler_output.draft_token_ids:
+            sd = (
+                self.scheduler.spec_drafted_tokens,
+                self.scheduler.spec_accepted_tokens,
+            )
+            drafted = sd[0] - self._spec_seen[0]
+            accepted = sd[1] - self._spec_seen[1]
+            self.metrics.record_spec_decode(drafted, accepted)
+            self._spec_seen = sd
+            for req_id in scheduler_output.draft_token_ids:
+                emitted = runner_output.sampled_token_ids.get(req_id)
+                if emitted:
+                    self.metrics.record_spec_acceptance_length(
+                        len(emitted)
+                    )
+            if self.tracer.enabled:
+                self.tracer.event(
+                    scheduler_output.trace_ctx,
+                    "engine.spec_decode",
+                    step_id=scheduler_output.step_id,
+                    drafted=drafted,
+                    accepted=accepted,
+                )
 
         outputs: list[RequestOutput] = []
         for req_id in scheduler_output.num_scheduled_tokens:

@@ -85,6 +85,9 @@ class Request:
     # Times this request was preempted (the preempt-to-shed policy's
     # thrash signal).
     num_preemptions: int = 0
+    # Incrementally-maintained prompt+output concat (token_history);
+    # None until first use.
+    _hist: list[int] | None = field(default=None, repr=False)
 
     def __post_init__(self) -> None:
         self.metrics.arrival_time = time.time()
@@ -107,6 +110,27 @@ class Request:
     @property
     def all_token_ids(self) -> list[int]:
         return self.prompt_token_ids + self.output_token_ids
+
+    def token_history(self) -> list[int]:
+        """Prompt+output as ONE list, maintained incrementally.
+
+        The spec-decode proposer scans this every all-decode schedule;
+        rebuilding the ``all_token_ids`` concat per request per step
+        would put an O(context) copy on the scheduler hot path.  The
+        cache extends by the appended delta (outputs only append
+        between calls) and rebuilds outright when the output shrank
+        (stop-string truncation).  Callers must treat the result as
+        read-only."""
+        want = self.num_prompt_tokens + self.num_output_tokens
+        h = self._hist
+        if h is None or len(h) > want:
+            h = self.prompt_token_ids + self.output_token_ids
+            self._hist = h
+        elif len(h) < want:
+            h.extend(
+                self.output_token_ids[len(h) - self.num_prompt_tokens :]
+            )
+        return h
 
     @property
     def prefill_target(self) -> int:

@@ -31,11 +31,20 @@ from vllm_distributed_tpu.engine.block_manager import (
     PrefixCachingAllocator,
 )
 from vllm_distributed_tpu.engine.request import Request, RequestStatus
+from vllm_distributed_tpu.engine.spec_decode import spec_eligible
 from vllm_distributed_tpu.logger import init_logger
 from vllm_distributed_tpu.sampling_params import SamplingParams
 from vllm_distributed_tpu.tracing import get_tracer
 
 logger = init_logger(__name__)
+
+# Spec-decode pipelining hysteresis (ISSUE 11): after this many
+# consecutive draftless spec-eligible schedules the engine resumes
+# async dispatch pipelining (spec dormant)...
+_SPEC_DRY_LIMIT = 4
+# ...and drains it for one probing schedule every this many pipelined
+# schedules, so a workload that turns repetitive re-engages spec.
+_SPEC_PROBE_INTERVAL = 16
 
 
 @dataclass
@@ -76,6 +85,14 @@ class SchedulerOutput:
     # >1 = every scheduled request is a decode and the worker runs this
     # many fused decode micro-steps on device (one sampled token each).
     decode_steps: int = 1
+    # Speculative decoding (ISSUE 11): req_id -> drafted tokens to
+    # verify this step.  Non-empty marks a spec verify step: every
+    # scheduled request is a decode, its num_scheduled_tokens is
+    # 1 + len(drafts) (the step's input token + the drafts), the worker
+    # verifies all drafts in ONE fused pass, and the ACTUAL per-request
+    # advance (1 + accepted drafts) is reconciled in update_from_output
+    # from the emitted token count.  decode_steps is always 1 here.
+    draft_token_ids: dict[str, list[int]] = field(default_factory=dict)
     # Trace context of the first scheduled traced request, if any: the
     # parent for this step's schedule/dispatch/gather spans (a step
     # serves a batch, so one trace adopts the step; the others link via
@@ -151,6 +168,27 @@ class Scheduler:
         # when the scheduler empties) so deadline enforcement costs one
         # attribute read per step when unused.
         self._has_deadlines = False
+        # Speculative decoding (ISSUE 11): the n-gram prompt-lookup
+        # proposer, built only when --speculative-ngram-k > 0 so the
+        # default path pays one attribute read per step.
+        self.spec = None
+        if scheduler_config.spec_ngram_k > 0:
+            from vllm_distributed_tpu.engine.spec_decode import (
+                NgramProposer,
+            )
+
+            self.spec = NgramProposer(
+                scheduler_config.spec_ngram_k,
+                min_n=scheduler_config.spec_ngram_min,
+                max_n=scheduler_config.spec_ngram_max,
+            )
+        # Cumulative spec-decode token counters (metrics): tokens
+        # drafted into verify passes vs drafts accepted by them.
+        self.spec_drafted_tokens = 0
+        self.spec_accepted_tokens = 0
+        # Pipelining hysteresis state (see spec_wants_sync).
+        self._spec_dry_streak = 0
+        self._spec_pipeline_steps = 0
 
     # ---- waiting-queue mutation (ALL of it goes through these three
     # helpers so num_waiting_tokens can never drift from the deque) ----
@@ -320,15 +358,29 @@ class Scheduler:
         # through 8/4/2/1 and compiled a fresh multi-second program
         # mid-serve (measured 14-23 s each on v5e).  Logprobs force K=1
         # (per-step [S, V] logprob fetches don't amortize).
-        k = 1
-        if (
-            self.config.num_decode_steps > 1
-            and self.running
+        #
+        # Speculative decoding (ISSUE 11) takes precedence on the same
+        # all-decode precondition: when the n-gram proposer drafts for
+        # at least one request, the step becomes a single-dispatch
+        # verify pass (decode_steps=1, per-request num_new = 1+drafts)
+        # instead of a K-step scan — one HBM pass for up to K+1 tokens
+        # rather than one per token.  Steps where nothing drafts fall
+        # back to the fused scan, so non-repetitive stretches keep the
+        # fused-decode throughput.
+        decode_only = bool(
+            self.running
             and not self.waiting
             and all(not r.is_prefill for r in self.running)
             and all(
                 r.sampling_params.logprobs is None for r in self.running
             )
+        )
+        spec_drafts = self._propose_drafts() if decode_only else {}
+        k = 1
+        if (
+            not spec_drafts
+            and decode_only
+            and self.config.num_decode_steps > 1
         ):
             k = self.config.fused_decode_steps()
         out.decode_steps = k
@@ -342,6 +394,7 @@ class Scheduler:
                 continue
             if token_budget <= 0:
                 break
+            drafts = None
             if req.is_prefill:
                 remaining = req.prefill_target - req.num_computed_tokens
                 chunk = min(remaining, token_budget)
@@ -358,9 +411,19 @@ class Scheduler:
                 )
                 if room <= 0:
                     continue
-                # Under-K tails are masked on device, not given their
-                # own scan length (see the K comment above).
-                num_new = min(k, room)
+                drafts = spec_drafts.get(req.request_id)
+                if drafts is not None and token_budget <= len(drafts):
+                    # The shared budget cuts this verify window short;
+                    # trim drafts rather than overrun the step budget.
+                    drafts = drafts[: token_budget - 1] or None
+                if drafts is not None:
+                    # Spec verify window: the input token + the drafts
+                    # (already room-capped at proposal time).
+                    num_new = 1 + len(drafts)
+                else:
+                    # Under-K tails are masked on device, not given
+                    # their own scan length (see the K comment above).
+                    num_new = min(k, room)
             got = self._allocate_or_preempt(
                 req,
                 req.num_inflight_tokens + num_new,
@@ -386,6 +449,9 @@ class Scheduler:
                     num_new_tokens=num_new,
                 )
             )
+            if drafts is not None:
+                out.draft_token_ids[req.request_id] = drafts
+                self.spec_drafted_tokens += len(drafts)
             if not req.is_prefill:
                 req.num_inflight_tokens += num_new
             scheduled_running.append(req)
@@ -485,6 +551,60 @@ class Scheduler:
             out.finished_req_ids = []
             out.preempted_req_ids = []
         return out
+
+    def _propose_drafts(self) -> dict[str, list[int]]:
+        """N-gram prompt-lookup proposals for an all-decode step
+        (ISSUE 11).  Returns {} unless spec decode is enabled, every
+        running request is spec-eligible (greedy, no penalties — the
+        gate is batch-wide so one compiled verify program serves the
+        step), the pipeline is drained (the proposer and the verify
+        input need the host-current last token), and at least one
+        request found a draftable tail n-gram."""
+        if self.spec is None:
+            return {}
+        if any(
+            not spec_eligible(r.sampling_params) for r in self.running
+        ):
+            return {}
+        if any(r.num_inflight_tokens > 0 for r in self.running):
+            # Pipelined continuation (spec dormant): host tokens are
+            # stale, so no proposals — count toward the probe cadence.
+            self._spec_pipeline_steps += 1
+            return {}
+        self._spec_pipeline_steps = 0
+        drafts: dict[str, list[int]] = {}
+        for r in self.running:
+            room = (
+                min(r.max_total_tokens, self.config.max_model_len)
+                - r.num_tokens
+            )
+            if room <= 1:
+                continue  # no space for a draft beyond the bonus token
+            d = self.spec.propose(r.token_history(), room - 1)
+            if d:
+                drafts[r.request_id] = d
+        if drafts:
+            self._spec_dry_streak = 0
+        else:
+            self._spec_dry_streak += 1
+        return drafts
+
+    def spec_wants_sync(self) -> bool:
+        """Pipelining hysteresis (ISSUE 11): True while the engine
+        should drain dispatches before each schedule so the proposer
+        sees host-current tokens.  While prompt-lookup keeps drafting,
+        the verify pass is the latency hider and every step runs
+        synchronously; after ``_SPEC_DRY_LIMIT`` consecutive draftless
+        eligible schedules the engine resumes the async dispatch
+        pipeline (spec dormant — non-repetitive greedy traffic keeps
+        the PR 6 overlap instead of silently regressing below the
+        spec-off baseline), draining once every
+        ``_SPEC_PROBE_INTERVAL`` pipelined schedules to re-probe for
+        drafts.  Pure read: call sites may invoke it multiple times
+        per step."""
+        if self._spec_dry_streak < _SPEC_DRY_LIMIT:
+            return True
+        return self._spec_pipeline_steps >= _SPEC_PROBE_INTERVAL
 
     def _allocate_or_preempt(
         self,
@@ -591,9 +711,20 @@ class Scheduler:
             req = self.requests.get(req_id)
             if req is None or req.status != RequestStatus.RUNNING:
                 continue  # aborted mid-step
-            req.num_computed_tokens += num
-            req.num_inflight_tokens = max(req.num_inflight_tokens - num, 0)
             new_tokens = sampled_token_ids.get(req_id, [])
+            if req_id in scheduler_output.draft_token_ids:
+                # Spec verify pass (ISSUE 11): the window was scheduled
+                # at its full width (input + all drafts) but KV is only
+                # valid through the accepted prefix — advance by the
+                # EMITTED count (1 + accepted drafts); the rejected-draft
+                # rows are garbage the next window overwrites in place
+                # (block_manager.register_computed never reaches them).
+                num_adv = len(new_tokens)
+                self.spec_accepted_tokens += max(len(new_tokens) - 1, 0)
+            else:
+                num_adv = num
+            req.num_computed_tokens += num_adv
+            req.num_inflight_tokens = max(req.num_inflight_tokens - num, 0)
             for tok in new_tokens:
                 req.append_output_token(tok)
                 status = req.check_stop(self.config.max_model_len)

@@ -67,6 +67,16 @@ class StepFrame:
     # docstring), populated only if the encoder's prediction disagrees
     # with the scheduler.
     computed_overrides: dict[int, int] = field(default_factory=dict)
+    # ---- speculative decoding (ISSUE 11) ----
+    # index -> draft tokens to verify this step (marks the cached entry
+    # as a spec verify window: num_new = 1 + len(drafts), the ACTUAL
+    # advance is a device result this frame cannot know)...
+    drafts: dict[int, list[int]] = field(default_factory=dict)
+    # ...so the NEXT frame that touches the request ships the realized
+    # advance (index -> 1 + accepted drafts), applied by the mirror
+    # before its cached entries — both sides advance by the same value
+    # without a prediction, keeping lockstep without override warnings.
+    spec_advance: dict[int, int] = field(default_factory=dict)
     trace_ctx: tuple | None = None
     # Escape hatch: a SchedulerOutput the codec cannot synthesize from
     # mirror state (num_scheduled_tokens entries with no matching
@@ -76,11 +86,15 @@ class StepFrame:
 
 
 class _Entry:
-    __slots__ = ("req_id", "computed")
+    __slots__ = ("req_id", "computed", "spec_pending")
 
     def __init__(self, req_id: str, computed: int) -> None:
         self.req_id = req_id
         self.computed = computed
+        # Width of the last spec verify window scheduled for this
+        # request (0 = none pending): the encoder leaves `computed` at
+        # the window base until the realized advance is known.
+        self.spec_pending = 0
 
 
 class StepDeltaEncoder:
@@ -148,6 +162,17 @@ class StepDeltaEncoder:
                 raise ValueError(
                     f"cached delta for unmirrored request {c.req_id}"
                 )
+            if entry.spec_pending:
+                # The realized advance of the last spec window (1 +
+                # accepted drafts) is now visible in the scheduler's
+                # computed value; ship it so the mirror advances by the
+                # same amount.  Out-of-range values fall through to the
+                # override path below.
+                adv = c.num_computed_tokens - entry.computed
+                if 1 <= adv <= entry.spec_pending:
+                    frame.spec_advance[idx] = adv
+                    entry.computed = c.num_computed_tokens
+                entry.spec_pending = 0
             if entry.computed != c.num_computed_tokens:
                 # Prediction miss: ship the absolute value this step (a
                 # bigger frame, never a divergent mirror) and resync.
@@ -162,7 +187,15 @@ class StepDeltaEncoder:
                 frame.computed_overrides[idx] = c.num_computed_tokens
                 entry.computed = c.num_computed_tokens
             frame.cached.append((idx, c.num_new_tokens, c.new_page_ids))
-            entry.computed += c.num_new_tokens
+            d = so.draft_token_ids.get(c.req_id)
+            if d is not None:
+                # Spec verify window: the advance is a device result;
+                # hold `computed` at the base until the next frame ships
+                # spec_advance (see above).
+                frame.drafts[idx] = list(d)
+                entry.spec_pending = c.num_new_tokens
+            else:
+                entry.computed += c.num_new_tokens
         for nr in so.new_requests:
             if nr.req_id in self._index:
                 raise ValueError(f"re-admission of mirrored {nr.req_id}")
@@ -206,6 +239,11 @@ class StepStateMirror:
         for idx in frame.preempted:
             entry = self._by_index.pop(idx)
             so.preempted_req_ids.append(entry.req_id)
+        # Realized spec-window advances land before this frame's cached
+        # entries read `computed` (encoder symmetry: it reconciled the
+        # same requests before encoding their new entries).
+        for idx, adv in frame.spec_advance.items():
+            self._by_index[idx].computed += adv
         for idx, num_new, new_page_ids in frame.cached:
             entry = self._by_index[idx]
             override = frame.computed_overrides.get(idx)
@@ -219,7 +257,14 @@ class StepStateMirror:
                     num_new_tokens=num_new,
                 )
             )
-            entry.computed += num_new
+            d = frame.drafts.get(idx)
+            if d is not None:
+                # Spec verify window: the worker's runner computes the
+                # realized advance itself; `computed` stays at the base
+                # until the next frame's spec_advance.
+                so.draft_token_ids[entry.req_id] = list(d)
+            else:
+                entry.computed += num_new
             so.num_scheduled_tokens[entry.req_id] = num_new
             so.total_num_scheduled_tokens += num_new
         for nr in frame.new:

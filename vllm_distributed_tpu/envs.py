@@ -142,6 +142,22 @@ environment_variables: dict[str, Callable[[], Any]] = {
     "VDT_DRAIN_JOURNAL_PATH": lambda: os.environ.get(
         "VDT_DRAIN_JOURNAL_PATH", ""
     ),
+    # --- speculative decoding (ISSUE 11) ---
+    # Max tokens the n-gram prompt-lookup proposer drafts per request
+    # per step (--speculative-ngram-k); the model runner verifies all
+    # drafts in one fused device pass and greedy accept/reject keeps
+    # the matching prefix + one bonus token.  0 = off (the default);
+    # greedy outputs are bit-identical either way.
+    "VDT_SPEC_NGRAM_K": lambda: int(
+        os.environ.get("VDT_SPEC_NGRAM_K", "0")
+    ),
+    # Tail n-gram match lengths the proposer tries, longest first.
+    "VDT_SPEC_NGRAM_MAX": lambda: int(
+        os.environ.get("VDT_SPEC_NGRAM_MAX", "3")
+    ),
+    "VDT_SPEC_NGRAM_MIN": lambda: int(
+        os.environ.get("VDT_SPEC_NGRAM_MIN", "1")
+    ),
     # --- multi-replica routing (ISSUE 10) ---
     # Stable identity of this serving replica, surfaced in /health, the
     # X-VDT-Replica-Id response header, and the vllm:replica_info gauge

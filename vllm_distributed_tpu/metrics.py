@@ -45,6 +45,9 @@ _STAGE_BUCKETS = (
     0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
     20.0, 40.0, 80.0, 160.0,
 )
+# Emitted tokens per spec-decode verify window: 1 (full reject) up to
+# K+1 (full accept); integer buckets up to the largest sane K.
+_SPEC_ACCEPT_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 33)
 # Step phases (schedule on host CPU, dispatch fan-out, gather wait):
 # schedule/dispatch are sub-millisecond, gather bounds device time.
 _STEP_BUCKETS = (
@@ -76,6 +79,9 @@ DOCUMENTED_METRICS = (
     "vllm:num_preemptions_total",
     "vllm:prefix_cache_queries_total",
     "vllm:prefix_cache_hits_total",
+    "vllm:spec_decode_draft_tokens_total",
+    "vllm:spec_decode_accepted_tokens_total",
+    "vllm:spec_decode_acceptance_length",
     "vllm:gpu_cache_usage_perc",
     "vllm:time_to_first_token_seconds",
     "vllm:time_per_output_token_seconds",
@@ -174,6 +180,23 @@ class EngineMetrics:
             "vllm:prefix_cache_hits",
             "Tokens served from cached KV pages instead of prefill "
             "(cross-request prefix reuse and preemption-resume recovery)",
+        )
+        # ---- speculative decoding (ISSUE 11) ----
+        self.spec_draft_tokens = counter(
+            "vllm:spec_decode_draft_tokens",
+            "Tokens drafted by the n-gram prompt-lookup proposer into "
+            "fused verify passes",
+        )
+        self.spec_accepted_tokens = counter(
+            "vllm:spec_decode_accepted_tokens",
+            "Drafted tokens accepted by greedy verification (bonus "
+            "tokens not counted; acceptance rate = accepted / draft)",
+        )
+        self.spec_acceptance_length = histogram(
+            "vllm:spec_decode_acceptance_length",
+            "Tokens emitted per verified request window (1 + accepted "
+            "drafts; 1 = full reject, K+1 = full accept)",
+            _SPEC_ACCEPT_BUCKETS,
         )
         self.kv_cache_usage = gauge(
             "vllm:gpu_cache_usage_perc",  # vLLM's name, kept for dashboards
@@ -355,6 +378,20 @@ class EngineMetrics:
     def record_kv_cache_usage(self, frac: float) -> None:
         if self.enabled:
             self.kv_cache_usage.set(frac)
+
+    def record_spec_decode(self, drafted: int, accepted: int) -> None:
+        """Token deltas from one speculative verify step."""
+        if not self.enabled:
+            return
+        if drafted:
+            self.spec_draft_tokens.inc(drafted)
+        if accepted:
+            self.spec_accepted_tokens.inc(accepted)
+
+    def record_spec_acceptance_length(self, num_emitted: int) -> None:
+        """Tokens emitted by one request's verify window (1 + accepted)."""
+        if self.enabled:
+            self.spec_acceptance_length.observe(num_emitted)
 
     def record_new_tokens(self, req_metrics, n: int, now: float | None = None) -> None:
         """n new tokens for one request: TTFT on the first, ITL after.

@@ -128,3 +128,31 @@ def sample(
 
     tokens = jnp.where(meta.temperature > 0, sampled, greedy)
     return tokens.astype(jnp.int32), logprobs
+
+
+@jax.jit
+def spec_greedy_accept(
+    logits: jax.Array,  # [S, K+1, V] f32 — verify-pass logits
+    draft_tokens: jax.Array,  # [S, K] i32, -1 padded
+    num_drafts: jax.Array,  # [S] i32 — real drafts per row
+) -> tuple[jax.Array, jax.Array]:
+    """Greedy accept/reject for draftless speculative decoding.
+
+    Row layout: ``logits[s, 0]`` is the distribution after the step's
+    input token (the non-speculative next-token logits); ``logits[s,
+    j]`` for ``j >= 1`` is the distribution after draft ``j-1``.  A
+    draft is accepted while it equals the greedy argmax chain, so the
+    emitted tokens — ``tokens[s, :num_emitted[s]]`` — are exactly the
+    tokens sequential greedy decode would have produced: the longest
+    matching draft prefix plus one bonus token from the first
+    disagreeing (or final) distribution.  ``num_emitted`` is therefore
+    in ``[1, num_drafts + 1]``; shapes stay static, the variable part
+    is values only.
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, K+1]
+    k = draft_tokens.shape[1]
+    pos = jnp.arange(k, dtype=jnp.int32)[None, :]
+    matches = (greedy[:, :k] == draft_tokens) & (pos < num_drafts[:, None])
+    # Leading-run length: cumprod zeroes everything after the first miss.
+    accepted = jnp.cumprod(matches.astype(jnp.int32), axis=1).sum(axis=1)
+    return greedy, (accepted + 1).astype(jnp.int32)

@@ -39,7 +39,11 @@ from vllm_distributed_tpu.ops.attention import (
     AttentionMetadata,
     paged_attention_reference,
 )
-from vllm_distributed_tpu.ops.sampling import SamplingMetadata, sample
+from vllm_distributed_tpu.ops.sampling import (
+    SamplingMetadata,
+    sample,
+    spec_greedy_accept,
+)
 from vllm_distributed_tpu.outputs import ModelRunnerOutput
 from vllm_distributed_tpu.sampling_params import SamplingParams
 from vllm_distributed_tpu.utils import cdiv, next_power_of_2
@@ -174,6 +178,16 @@ class ModelRunner:
                 raise ValueError(
                     f"dp axis size must be a power of 2, got {self._dp} "
                     "(power-of-two shape buckets must stay divisible)"
+                )
+            if (
+                self.config.scheduler_config.spec_ngram_k > 0
+                and self._dp > 1
+            ):
+                raise ValueError(
+                    "speculative decoding does not support dp>1 (the "
+                    "verify pass ships one packed replicated buffer; a "
+                    "dp-sharded variant would need per-shard verify "
+                    "windows) — use dp=1 or --speculative-ngram-k 0"
                 )
             tp = self.mesh.shape.get("tp", 1)
             if tp > 1 and self.model.num_kv_heads % tp:
@@ -503,11 +517,15 @@ class ModelRunner:
         )
 
         sc = self.config.scheduler_config
+        # Speculative verify programs are part of decode warmup too —
+        # the first mid-serve verify compile is exactly the stall class
+        # this warmup exists to remove.
+        n_spec = self._warmup_spec() if sc.spec_ngram_k > 0 else 0
         # The exact K the scheduler will emit — warming any other scan
         # length is wasted.
         k = sc.fused_decode_steps()
         if k <= 1 or self.kv_caches is None:
-            return 0
+            return n_spec
         t0 = _time.monotonic()
         buckets = [self._seq_bucket()]
         pages_pad = self._pages_bucket(cdiv(2 + 2 * k, self.page_size))
@@ -562,6 +580,91 @@ class ModelRunner:
             self._decode_carry = None
         logger.info(
             "decode warmup: %d dispatches over %s seq buckets in %.1fs",
+            n,
+            buckets,
+            _time.monotonic() - t0,
+        )
+        return n + n_spec
+
+    def _warmup_spec(self) -> int:
+        """Pre-compile the speculative verify program for every token
+        bucket a spec step can produce (ISSUE 11).  With the pinned
+        sequence bucket and verify-window width the only dynamic shape
+        is the power-of-2 token bucket, capped at s_bucket * (K+1) —
+        log-many programs, each warmed by one synthetic dispatch whose
+        KV writes land in reserved page 0 (garbage by contract)."""
+        if self.kv_caches is None:
+            return 0
+        import time as _time
+
+        from vllm_distributed_tpu.engine.scheduler import (
+            CachedRequestData,
+            SchedulerOutput,
+        )
+
+        t0 = _time.monotonic()
+        kp1 = self._spec_kp1()
+        s_pad = self._seq_bucket()
+        pages_pad = self._pages_bucket(cdiv(2 + kp1, self.page_size))
+        buckets = []
+        b = _MIN_TOKEN_BUCKET
+        cap = next_power_of_2(s_pad * kp1)
+        while b <= cap:
+            buckets.append(b)
+            b *= 2
+        n = 0
+        for t_bucket in buckets:
+            # Window sizes summing exactly to the bucket: full K+1
+            # windows first, the remainder spread so every row keeps
+            # at least its input token.
+            n_live = min(max(cdiv(t_bucket, kp1), 1), s_pad)
+            sizes = []
+            remaining = t_bucket
+            for i in range(n_live):
+                take = min(kp1, remaining - (n_live - i - 1))
+                sizes.append(take)
+                remaining -= take
+            ids = [f"__warms-{i}" for i in range(n_live)]
+            for rid in ids:
+                self.requests[rid] = CachedReqState(
+                    req_id=rid,
+                    token_ids=[1, 1],
+                    sampling_params=SamplingParams(
+                        temperature=0.0, max_tokens=kp1 + 2
+                    ),
+                    page_ids=[0] * pages_pad,
+                    num_computed=1,
+                    prefill_target=1,
+                    num_prompt=1,
+                )
+            so = SchedulerOutput(
+                step_id=0,
+                cached_requests=[
+                    CachedRequestData(
+                        req_id=rid,
+                        new_page_ids=[],
+                        num_computed_tokens=1,
+                        num_new_tokens=sizes[i],
+                    )
+                    for i, rid in enumerate(ids)
+                ],
+                num_scheduled_tokens={
+                    rid: sizes[i] for i, rid in enumerate(ids)
+                },
+                total_num_scheduled_tokens=t_bucket,
+                decode_steps=1,
+                draft_token_ids={
+                    rid: [1] * (sizes[i] - 1)
+                    for i, rid in enumerate(ids)
+                    if sizes[i] > 1
+                },
+            )
+            self._execute_spec_step(so)
+            for rid in ids:
+                self.requests.pop(rid, None)
+            n += 1
+        logger.info(
+            "spec-decode warmup: %d token buckets %s in %.1fs",
             n,
             buckets,
             _time.monotonic() - t0,
@@ -773,6 +876,8 @@ class ModelRunner:
         self._apply_scheduler_deltas(so)
         if so.is_empty:
             return ModelRunnerOutput()
+        if so.draft_token_ids:
+            return self._execute_spec_step(so)
         if so.decode_steps > 1:
             return self._execute_decode_steps(so)
         self._decode_carry = None
@@ -1111,6 +1216,175 @@ class ModelRunner:
             params, kv_caches, tokens, meta, smeta,
             max_q_pad, do_penalties, do_top_k_p, return_logprobs,
         )
+
+    # ---- speculative verify pass (SchedulerOutput.draft_token_ids) ----
+    def _spec_kp1(self) -> int:
+        """Static verify-window width: the configured max drafts + 1
+        bonus column, padded to a power of two, so every spec step of a
+        config shares ONE compiled gather/accept shape regardless of
+        how many drafts each request actually found."""
+        return max(
+            next_power_of_2(
+                self.config.scheduler_config.spec_ngram_k + 1
+            ),
+            2,
+        )
+
+    def _execute_spec_step(self, so: SchedulerOutput) -> ModelRunnerOutput:
+        """Verify every request's drafted tokens in ONE fused dispatch
+        (ISSUE 11): feed ``[input token, d_1..d_d]`` per sequence
+        through the single-pass forward (teacher-forced; causal within
+        the window exactly like a prefill chunk), gather logits at
+        EVERY window position, and let the greedy accept kernel keep
+        the longest draft prefix matching the argmax chain plus one
+        bonus token.  One weight+KV HBM pass buys up to K+1 tokens
+        instead of one.  KV rows written for rejected drafts sit past
+        the reconciled cursor and are overwritten in place by the next
+        window — never registered by the prefix cache, never read by
+        later attention (seq_lens follows the accepted cursor)."""
+        self._decode_carry = None
+        order = [c.req_id for c in so.cached_requests]
+        states = [self.requests[r] for r in order]
+        num_new = [so.num_scheduled_tokens[r] for r in order]
+        drafts = so.draft_token_ids
+
+        t_real = sum(num_new)
+        s_real = len(order)
+        # Sequence bucket PINNED like the fused-decode path (batch
+        # growth/shrink never recompiles) and max_q pinned to the
+        # verify-window width (per-request draft counts never
+        # recompile): the only dynamic shape left is the power-of-2
+        # token bucket — log-many programs, all pre-compiled by
+        # warmup_decode when spec is on.
+        t_pad = max(next_power_of_2(t_real), _MIN_TOKEN_BUCKET)
+        s_pad = self._seq_bucket()
+        kp1 = self._spec_kp1()
+        max_pages = max(max(len(st.page_ids) for st in states), 1)
+        pages_pad = self._pages_bucket(max_pages)
+
+        tokens = np.zeros(t_pad, np.int32)
+        positions = np.zeros(t_pad, np.int32)
+        seq_ids = np.full(t_pad, s_pad, np.int32)
+        slots = np.zeros(t_pad, np.int32)
+        block_tables = np.zeros((s_pad, pages_pad), np.int32)
+        seq_lens = np.zeros(s_pad, np.int32)
+        chunk_starts = np.zeros(s_pad, np.int32)
+        # Logits rows gathered per (sequence, window column); columns
+        # past a short window re-gather its last row — the accept
+        # kernel masks them via n_drafts.
+        verify_idx = np.zeros((s_pad, kp1), np.int32)
+        draft_mat = np.full((s_pad, kp1 - 1), -1, np.int32)
+        n_drafts = np.zeros(s_pad, np.int32)
+
+        cursor = 0
+        for s, (state, n) in enumerate(zip(states, num_new)):
+            lo = state.num_computed
+            assert lo == len(state.token_ids) - 1, (
+                "spec verify dispatched without the host-current last "
+                "token (pipeline must be drained)"
+            )
+            d = drafts.get(state.req_id, [])
+            window = [state.token_ids[lo], *d]
+            assert len(window) == n, (state.req_id, len(window), n)
+            tokens[cursor : cursor + n] = window
+            pos = np.arange(lo, lo + n, dtype=np.int32)
+            positions[cursor : cursor + n] = pos
+            seq_ids[cursor : cursor + n] = s
+            page_arr = np.asarray(state.page_ids, np.int32)
+            slots[cursor : cursor + n] = (
+                page_arr[pos // self.page_size] * self.page_size
+                + pos % self.page_size
+            )
+            block_tables[s, : len(state.page_ids)] = page_arr
+            seq_lens[s] = lo + n
+            chunk_starts[s] = lo
+            verify_idx[s, :] = cursor + np.minimum(np.arange(kp1), n - 1)
+            draft_mat[s, : len(d)] = d
+            n_drafts[s] = len(d)
+            cursor += n
+
+        max_q_pad = kp1
+        packed, pack_spec = pack_host_arrays(
+            [
+                tokens, seq_ids, positions, slots, block_tables,
+                seq_lens, chunk_starts, verify_idx, draft_mat, n_drafts,
+            ]
+        )
+        if self.mesh is not None:
+            packed = jax.device_put(packed, NamedSharding(self.mesh, P()))
+        statics = dict(spec=pack_spec, max_q_pad=max_q_pad)
+        if self._aot.enabled:
+            toks, n_emit, self.kv_caches = self._aot.call(
+                f"spec_step:{sorted(statics.items())}",
+                partial(
+                    type(self)._jit_spec_step.__wrapped__, self, **statics
+                ),
+                (self.params, self.kv_caches, packed),
+                donate_args=(1,),
+            )
+        else:
+            toks, n_emit, self.kv_caches = self._jit_spec_step(
+                self.params, self.kv_caches, packed, **statics
+            )
+        toks, n_emit = jax.device_get((toks, n_emit))
+        toks = np.asarray(toks)
+        n_emit = np.asarray(n_emit)
+
+        out = ModelRunnerOutput()
+        for s, (state, n) in enumerate(zip(states, num_new)):
+            m = min(int(n_emit[s]), n)
+            seq_toks = [int(t) for t in toks[s, :m]]
+            # The deltas set num_computed to the window base; advance by
+            # the EMITTED count (input + accepted drafts), mirroring the
+            # scheduler's update_from_output reconciliation.
+            state.num_computed += m
+            state.token_ids.extend(seq_toks)
+            out.sampled_token_ids[state.req_id] = seq_toks
+        return out
+
+    @partial(
+        jax.jit,
+        static_argnames=("self", "spec", "max_q_pad"),
+        donate_argnums=(2,),
+    )
+    def _jit_spec_step(
+        self,
+        params,
+        kv_caches,
+        packed,
+        *,
+        spec: tuple,
+        max_q_pad: int,
+    ):
+        (
+            tokens, seq_ids, positions, slots, block_tables, seq_lens,
+            chunk_starts, verify_idx, draft_mat, n_drafts,
+        ) = unpack_device_arrays(packed, spec)
+        s_pad, kp1 = verify_idx.shape
+        meta = AttentionMetadata(
+            q_seq_ids=seq_ids,
+            q_positions=positions,
+            slot_mapping=slots,
+            block_tables=block_tables,
+            seq_lens=seq_lens,
+            logits_indices=verify_idx.reshape(-1),
+            chunk_starts=chunk_starts,
+        )
+        attn_fn = self._attn_fn
+        if getattr(attn_fn, "needs_max_q", False):
+            attn_fn = partial(attn_fn, max_q=max_q_pad)
+        logits, kv_caches = self.model.forward(
+            params,
+            tokens,
+            kv_caches,
+            meta,
+            attn_fn=attn_fn,
+            kv_write_fn=self._kv_write_fn,
+        )
+        toks, n_emit = spec_greedy_accept(
+            logits.reshape(s_pad, kp1, -1), draft_mat, n_drafts
+        )
+        return toks, n_emit, kv_caches
 
     # ---- fused multi-step decode (SchedulerOutput.decode_steps > 1) ----
     def _execute_decode_steps(self, so: SchedulerOutput) -> ModelRunnerOutput:
