@@ -1,0 +1,23 @@
+"""Positive corpus for VDT009 bounded-cardinality."""
+
+
+class Metrics:
+    def __init__(self, counter, gauge):
+        self.counter = counter
+        self.gauge = gauge
+
+    def record(self, request_id, prompt, trace_id, req):
+        # One time series per request id: the classic cardinality bomb.
+        self.counter.labels(request_id=request_id).inc()  # EXPECT
+        # Attribute chains count: req.request_id is the same source.
+        self.counter.labels(rid=req.request_id).inc()  # EXPECT
+        # Formatting it into another label does not launder it.
+        self.counter.labels(model_name=f"m-{request_id}").inc()  # EXPECT
+        # Prompt-derived labels grow with the corpus of user text.
+        self.gauge.labels(prompt=prompt[:16]).set(1)  # EXPECT
+        # Trace/span ids are 128-bit randoms: one series per request.
+        self.gauge.labels(span=trace_id).set(1)  # EXPECT
+        # Positional label values are checked like keyword ones.
+        self.counter.labels(request_id).inc()  # EXPECT
+        # Splatted label dicts name their sources too.
+        self.counter.labels(**{"request_id": request_id}).inc()  # EXPECT

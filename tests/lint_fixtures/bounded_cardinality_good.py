@@ -1,0 +1,27 @@
+"""Negative corpus for VDT009 bounded-cardinality: every label value
+here is drawn from a bounded space (enum-like reasons, sanitized class
+names, host ranks, replica identities)."""
+
+
+class Metrics:
+    def __init__(self, counter, gauge, model_name):
+        self.counter = counter
+        self.gauge = gauge
+        self._model_name = model_name
+
+    def record(self, reason, slo_class, host_rank, replica_id, kind):
+        self.counter.labels(
+            model_name=self._model_name, reason=reason
+        ).inc()
+        # slo_class is sanitized + capped by engine/slo.py — bounded.
+        self.counter.labels(slo_class=slo_class).inc()
+        self.gauge.labels(host_rank=str(host_rank)).set(1)
+        self.gauge.labels(replica_id=replica_id).set(1)
+        self.counter.labels(kind=kind).inc()
+        label = {"model_name": self._model_name}
+        self.counter.labels(**label).inc()
+
+    def not_a_metric(self, request_id):
+        # .labels() is the only surface the rule watches; other calls
+        # may mention request ids freely (logs, journals, traces).
+        return {"request_id": request_id}

@@ -48,7 +48,8 @@ import time
 
 from vllm_distributed_tpu.executor.abstract import Executor
 from vllm_distributed_tpu.outputs import ModelRunnerOutput
-from vllm_distributed_tpu.utils import run_method
+from vllm_distributed_tpu.utils import next_power_of_2, run_method
+from vllm_distributed_tpu.worker.telemetry import DeviceTelemetry
 
 # Simulated device time per fused dispatch in the two-phase protocol
 # (per-process override: VDT_MOCK_STEP_SECONDS — the dispatch
@@ -111,6 +112,13 @@ class MockWorker:
                 "VDT_MOCK_STEP_SECONDS", str(MOCK_STEP_SECONDS)
             )
         )
+        # Simulated XLA-compile accounting (ISSUE 12): the real runner
+        # compiles one program per (kind, shape-bucket) key; the mock
+        # mirrors that with the scheduler-visible power-of-2 token
+        # bucket so tests can induce and observe a "recompile" without
+        # chips.  Same DeviceTelemetry ledger + snapshot wire format.
+        self.telemetry = DeviceTelemetry()
+        self._compiled_buckets: set[str] = set()
 
     # ---- fault injection ----
     def inject_fault(
@@ -175,10 +183,42 @@ class MockWorker:
         return max(getattr(scheduler_output, "decode_steps", 1) or 1, 1)
 
     def _simulate_device(self, scheduler_output) -> None:
+        self._simulate_compile(scheduler_output)
         if self._hbm_pass_seconds:
             time.sleep(
                 self._hbm_pass_seconds * self._hbm_passes(scheduler_output)
             )
+
+    def _simulate_compile(self, scheduler_output) -> None:
+        """Record one simulated XLA compile per new (kind, token-bucket)
+        shape key — the mock analog of ModelRunner._observed_call."""
+        if getattr(scheduler_output, "draft_token_ids", None):
+            kind = "spec"
+        elif (getattr(scheduler_output, "decode_steps", 1) or 1) > 1:
+            kind = "decode"
+        else:
+            kind = "prefill"
+        bucket = next_power_of_2(
+            max(scheduler_output.total_num_scheduled_tokens, 16)
+        )
+        key = f"{kind}:t={bucket}"
+        if key not in self._compiled_buckets:
+            self._compiled_buckets.add(key)
+            self.telemetry.record_compile(kind, 0.001, key)
+        self.telemetry.record_step(
+            max(self._step_seconds, 1e-6),
+            scheduler_output.total_num_scheduled_tokens * 1024,
+            819e9,
+        )
+
+    def get_device_telemetry(self) -> dict | None:
+        if not self.is_driver_worker:
+            return None
+        snap = self.telemetry.snapshot(probe_memory=False)
+        # Deterministic stand-in HBM numbers so the gauges move in tests.
+        snap["hbm_live_bytes"] = 1 << 30
+        snap["hbm_limit_bytes"] = 16 << 30
+        return snap
 
     def _sample(self, scheduler_output) -> dict[str, list[int]]:
         """One sampled token per scheduled request: constant 42, or the

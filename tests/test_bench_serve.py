@@ -53,6 +53,34 @@ def live_server(tmp_path_factory):
     loop.close()
 
 
+def test_bench_serve_per_class_slo_mix(live_server):
+    """ISSUE 12 satellite: --slo-class drives a per-class request mix
+    and the report carries per-class client percentiles plus the
+    server's own goodput judgment from the new counters."""
+    loop, url = live_server
+    args = argparse.Namespace(
+        url=url,
+        model="tiny",
+        num_prompts=6,
+        concurrency=3,
+        input_len=8,
+        output_len=8,
+        slo_classes=["interactive:2", "batch"],
+    )
+    result = loop.run_until_complete(_bench_serve_async(args))
+    per_class = result["per_class"]
+    assert set(per_class) == {"interactive", "batch"}
+    # 2:1 mix over 6 requests = 4 interactive, 2 batch.
+    assert per_class["interactive"]["completed"] == 4
+    assert per_class["batch"]["completed"] == 2
+    assert per_class["interactive"]["ttft_s"]["p50"] > 0
+    # Server-side goodput: no targets configured in this server, so
+    # every completed request attains trivially.
+    for cls in ("interactive", "batch"):
+        assert per_class[cls]["server_goodput_ratio"] == 1.0
+        assert per_class[cls]["server_ttft_attain_ratio"] == 1.0
+
+
 def test_bench_serve_reports_http_path_metrics(live_server):
     loop, url = live_server
     args = argparse.Namespace(

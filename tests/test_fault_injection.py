@@ -572,6 +572,68 @@ def test_reconnect_backoff_is_jittered_and_capped():
     assert len({reconnect_delay(5) for _ in range(8)}) > 1
 
 
+def test_flight_recorder_dump_on_host_failure(tmp_path, monkeypatch):
+    """ISSUE 12: an injected HostFailure makes the engine dump its
+    flight-recorder ring automatically — a bounded JSON artifact with
+    the last N step records and the failure attribution attached."""
+    import json as _json
+
+    from tests.mock_worker import MockUniProcExecutor
+    from vllm_distributed_tpu.distributed.failure import (
+        PHASE_EXECUTE,
+        HostFailure,
+    )
+    from vllm_distributed_tpu.engine.flight_recorder import FIELDS
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+
+    fr_dir = tmp_path / "fr"
+    monkeypatch.setenv("VDT_FLIGHT_RECORDER_DIR", str(fr_dir))
+    monkeypatch.setenv("VDT_FLIGHT_RECORDER_SIZE", "32")
+    model_dir = write_llama_config(str(tmp_path / "frm"))
+    engine = LLMEngine.from_engine_args(
+        EngineArgs(
+            model=model_dir,
+            skip_tokenizer_init=True,
+            load_format="dummy",
+            num_kv_pages=64,
+            max_model_len=512,
+            num_decode_steps=1,
+            distributed_executor_backend=MockUniProcExecutor,
+        )
+    )
+    try:
+        sp = SamplingParams(
+            temperature=0.0, max_tokens=3, ignore_eos=True
+        )
+        # More steps than the ring holds: the dump must stay bounded.
+        for i in range(60):
+            engine.add_request(
+                f"fr-{i}", prompt_token_ids=[1, 2, 3], sampling_params=sp
+            )
+            while engine.has_unfinished_requests():
+                engine.step()
+        engine.executor._notify_failure(
+            HostFailure(
+                host_rank=1,
+                address="10.0.0.2:30044",
+                phase=PHASE_EXECUTE,
+                message="injected for the flight-recorder contract",
+            )
+        )
+        dumps = sorted(fr_dir.glob("flightrecorder-host_failure-*.json"))
+        assert dumps, "HostFailure produced no flight-recorder artifact"
+        payload = _json.loads(dumps[-1].read_text())
+        assert payload["reason"] == "host_failure"
+        assert payload["extra"]["host_rank"] == 1
+        assert payload["extra"]["phase"] == PHASE_EXECUTE
+        assert payload["fields"] == list(FIELDS)
+        # Bounded: ring-limited records, not one per executed step.
+        assert 0 < len(payload["steps"]) <= 32
+        assert dumps[-1].stat().st_size < 1 << 20
+    finally:
+        engine.shutdown()
+
+
 def test_fault_injector_unit():
     async def go():
         inj = FaultInjector()
@@ -892,6 +954,9 @@ def test_chaos_soak_smoke(tmp_path):
     assert report["replay_failures"] == 0
     assert report["restarts_total"] >= 2
     assert report["recovery_seconds"]["max"] > 0
+    # ISSUE 12: every kill→recover cycle leaves flight-recorder
+    # artifacts behind (host_failure and/or recovery dumps).
+    assert report["flightrecorder_dumps"] >= 1
 
 
 @pytest.mark.soak

@@ -255,6 +255,45 @@ def test_merge_expositions_labels_and_dedupes():
     assert 'vllm:x{replica="r1"} 2.0' in merged
 
 
+def test_merge_expositions_new_slo_families_once_per_replica():
+    """ISSUE 12 satellite: each new mergeable-histogram family (the
+    per-class vllm:slo_* and device-telemetry families) must appear
+    EXACTLY once in the merged exposition — one HELP/TYPE — with every
+    replica's samples re-labeled under it."""
+    families = (
+        ("vllm:slo_ttft_ms", "histogram"),
+        ("vllm:slo_itl_ms", "histogram"),
+        ("vllm:xla_compile_seconds", "histogram"),
+        ("vllm:slo_requests_total", "counter"),
+        ("vllm:goodput_requests_total", "counter"),
+        ("vllm:hbm_live_bytes", "gauge"),
+    )
+
+    def exposition(value: float) -> str:
+        lines = []
+        for name, kind in families:
+            lines.append(f"# HELP {name} doc")
+            lines.append(f"# TYPE {name} {kind}")
+            if kind == "histogram":
+                lines.append(
+                    f'{name}_bucket{{slo_class="chat",le="+Inf"}} {value}'
+                )
+                lines.append(f'{name}_count{{slo_class="chat"}} {value}')
+                lines.append(f'{name}_sum{{slo_class="chat"}} {value}')
+            else:
+                lines.append(f'{name}{{slo_class="chat"}} {value}')
+        return "\n".join(lines) + "\n"
+
+    merged = merge_expositions(
+        [("r0", exposition(1.0)), ("r1", exposition(2.0))]
+    )
+    for name, kind in families:
+        assert merged.count(f"# TYPE {name} {kind}") == 1, name
+        sample = f"{name}_count" if kind == "histogram" else name
+        assert f'{sample}{{slo_class="chat",replica="r0"}} 1.0' in merged
+        assert f'{sample}{{slo_class="chat",replica="r1"}} 2.0' in merged
+
+
 def test_parse_load_gauges():
     text = (
         "# TYPE vllm:num_requests_waiting gauge\n"

@@ -1,0 +1,443 @@
+"""SLO/goodput accounting (ISSUE 12): log-bucket histogram merge
+properties (associative, order-independent, bit-recomputable from raw
+timelines), class-target parsing, cardinality bounding, EngineMetrics
+integration, and the mocked 2-replica acceptance run — the router's
+/router/slo fleet histograms must be bit-equal to recomputing directly
+from both replicas' raw timelines."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from tests.mock_worker import MockUniProcExecutor
+from vllm_distributed_tpu.config import EngineArgs
+from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+from vllm_distributed_tpu.engine.slo import (
+    DEFAULT_CLASS,
+    OVERFLOW_CLASS,
+    LogBucketHistogram,
+    SloAccounting,
+    bucket_index,
+    bucket_value_ms,
+    merge_class_views,
+    parse_class_targets,
+    sanitize_class,
+)
+from vllm_distributed_tpu.entrypoints.openai.api_server import (
+    build_app,
+    init_app_state,
+    serve_http,
+)
+from vllm_distributed_tpu.metrics import EngineMetrics
+from vllm_distributed_tpu.outputs import RequestMetrics
+from vllm_distributed_tpu.router.app import RouterState, build_router_app
+from vllm_distributed_tpu.testing import write_llama_config
+from vllm_distributed_tpu.utils import get_open_port
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------
+# log-bucket histogram units + merge properties
+# ---------------------------------------------------------------------
+def test_bucket_index_monotonic_and_invertible():
+    values = [0.001, 0.01, 0.5, 1.0, 7.3, 100.0, 5000.0, 9e6]
+    indices = [bucket_index(v) for v in values]
+    assert indices == sorted(indices)
+    for v, i in zip(values, indices):
+        # The representative value sits within one octave of the input
+        # (8 sub-buckets/octave ⇒ ~9% resolution; the mid-point rep
+        # value is within ~±6%).
+        assert 0.8 * v <= bucket_value_ms(i) <= 1.25 * v
+    assert bucket_index(0.0) == 0
+    assert bucket_index(-1.0) == 0
+    assert bucket_index(float("nan")) == 0
+
+
+def test_histogram_percentiles():
+    h = LogBucketHistogram()
+    for ms in (10.0,) * 90 + (1000.0,) * 10:
+        h.observe_ms(ms)
+    p50 = h.percentile_ms(0.5)
+    p99 = h.percentile_ms(0.99)
+    assert 8.0 < p50 < 12.0
+    assert 800.0 < p99 < 1200.0
+    assert LogBucketHistogram().percentile_ms(0.5) is None
+
+
+def test_merge_is_associative_and_order_independent():
+    rng = random.Random(12345)
+    hists = []
+    for _ in range(5):
+        h = LogBucketHistogram()
+        for _ in range(rng.randrange(1, 200)):
+            h.observe_ms(rng.uniform(0.01, 60_000))
+        hists.append(h)
+    a, b, c, d, e = hists
+    left = a.merge(b).merge(c).merge(d).merge(e)
+    right = a.merge(b.merge(c.merge(d.merge(e))))
+    shuffled = hists[:]
+    rng.shuffle(shuffled)
+    other = LogBucketHistogram()
+    for h in shuffled:
+        other = other.merge(h)
+    assert left == right == other
+    assert left.total == sum(h.total for h in hists)
+    # Inputs untouched (merge returns a new histogram).
+    assert a.total == hists[0].total
+
+
+def test_split_and_merge_recomputes_exactly():
+    """Observations split arbitrarily across k 'replicas' and merged in
+    any order are bit-equal to one histogram over the union — the
+    property the fleet merge contract rests on."""
+    rng = random.Random(999)
+    for _ in range(10):
+        observations = [rng.uniform(0.05, 120_000) for _ in range(500)]
+        whole = LogBucketHistogram()
+        for v in observations:
+            whole.observe_ms(v)
+        k = rng.randrange(2, 6)
+        parts = [LogBucketHistogram() for _ in range(k)]
+        for v in observations:
+            parts[rng.randrange(k)].observe_ms(v)
+        rng.shuffle(parts)
+        merged = LogBucketHistogram()
+        for p in parts:
+            merged = merged.merge(p)
+        assert merged == whole
+        # Wire round-trip preserves bit-equality too.
+        assert LogBucketHistogram.from_dict(merged.to_dict()) == whole
+
+
+# ---------------------------------------------------------------------
+# targets, class hygiene, accounting units
+# ---------------------------------------------------------------------
+def test_parse_class_targets():
+    assert parse_class_targets("") == {}
+    assert parse_class_targets("500") == {"default": 500.0}
+    assert parse_class_targets("default:500,chat:200.5,batch:5000") == {
+        "default": 500.0,
+        "chat": 200.5,
+        "batch": 5000.0,
+    }
+    # Unparseable/disabled entries are dropped, not fatal.
+    assert parse_class_targets("chat:nope,batch:0,ok:10") == {"ok": 10.0}
+
+
+def test_sanitize_class_bounds_hostile_names():
+    assert sanitize_class(None) == DEFAULT_CLASS
+    assert sanitize_class("") == DEFAULT_CLASS
+    assert sanitize_class("chat-v2.1_x") == "chat-v2.1_x"
+    assert sanitize_class('inj"}bad{label="x') == "injbadlabelx"
+    assert len(sanitize_class("x" * 500)) <= 48
+    assert sanitize_class("{}\"'\n") == DEFAULT_CLASS
+
+
+def test_class_cardinality_is_capped():
+    acc = SloAccounting(
+        ttft_targets={}, itl_targets={}, max_classes=4
+    )
+    resolved = {acc.resolve(f"class{i}") for i in range(20)}
+    assert len(resolved) <= 5  # 4 distinct + the overflow class
+    assert OVERFLOW_CLASS in resolved
+
+
+def test_attainment_and_goodput():
+    acc = SloAccounting(
+        ttft_targets={"chat": 100.0}, itl_targets={"chat": 10.0}
+    )
+    cls = acc.resolve("chat")
+    # Within both targets, completed -> goodput.
+    assert acc.record_finished(cls, 0.05, 0.005, {}, "stop") == (
+        True, True, True,
+    )
+    # TTFT blown.
+    assert acc.record_finished(cls, 0.5, 0.005, {}, "stop") == (
+        False, True, False,
+    )
+    # ITL blown.
+    assert acc.record_finished(cls, 0.05, 0.5, {}, "length") == (
+        True, False, False,
+    )
+    # Within targets but shed: attained, NOT goodput.
+    assert acc.record_finished(cls, 0.05, 0.005, {}, "timeout") == (
+        True, True, False,
+    )
+    # Single-token request: no ITL intervals -> vacuously attained.
+    assert acc.record_finished(cls, 0.05, None, None, "stop") == (
+        True, True, True,
+    )
+    # Untargeted class: trivially attained.
+    other = acc.resolve("bulk")
+    assert acc.record_finished(other, 99.0, 99.0, {}, "stop") == (
+        True, True, True,
+    )
+    snap = acc.snapshot()
+    chat = snap["classes"]["chat"]
+    assert chat["requests"] == 5
+    assert chat["goodput"] == 2
+    assert chat["ttft_attained"] == 4
+    assert chat["itl_attained"] == 4
+    assert len(snap["timelines"]) == 6
+
+
+def test_engine_metrics_slo_families(monkeypatch):
+    monkeypatch.setenv("VDT_SLO_TTFT_MS", "chat:200")
+    monkeypatch.setenv("VDT_SLO_ITL_MS", "chat:50")
+    m = EngineMetrics("m", enabled=True)
+    rm = RequestMetrics(arrival_time=100.0, arrival_time_mono=100.0)
+    rm.slo_class = "chat"
+    rm.first_token_time_mono = 100.1  # TTFT 100ms <= 200ms
+    m.record_new_tokens(rm, 1, now=100.1)
+    m.record_new_tokens(rm, 4, now=100.2)  # ITL 25ms <= 50ms
+    rm.finished_time_mono = 100.5
+    m.record_finished(rm, "stop")
+    text = m.render().decode()
+    assert 'vllm:slo_requests_total{model_name="m",slo_class="chat"} 1.0' in text
+    assert 'vllm:goodput_requests_total{model_name="m",slo_class="chat"} 1.0' in text
+    assert 'vllm:slo_ttft_attained_total{model_name="m",slo_class="chat"} 1.0' in text
+    assert 'vllm:slo_itl_attained_total{model_name="m",slo_class="chat"} 1.0' in text
+    assert 'vllm:slo_ttft_ms_count{model_name="m",slo_class="chat"} 1.0' in text
+    assert 'vllm:slo_itl_ms_count{model_name="m",slo_class="chat"} 4.0' in text
+    snap = m.slo_snapshot()
+    chat = snap["classes"]["chat"]
+    assert chat["ttft_hist"]["total"] == 1
+    assert chat["itl_hist"]["total"] == 4
+    assert chat["ttft_target_ms"] == 200.0
+    # The request's own timeline carries its ITL bucket tally, and
+    # recomputing the class histogram from it is bit-exact.
+    tl = snap["timelines"][0]
+    assert tl["slo_class"] == "chat" and tl["goodput"] is True
+    recomputed = LogBucketHistogram(
+        {int(k): v for k, v in tl["itl_buckets"].items()}
+    )
+    assert recomputed == LogBucketHistogram.from_dict(chat["itl_hist"])
+
+
+def test_merge_class_views_sums_counters():
+    va = {
+        "classes": {
+            "chat": {
+                "requests": 3, "goodput": 2, "ttft_attained": 3,
+                "itl_attained": 2, "ttft_target_ms": 100.0,
+                "ttft_hist": {"counts": {"10": 3}, "total": 3},
+                "itl_hist": {"counts": {"5": 6}, "total": 6},
+            }
+        }
+    }
+    vb = {
+        "classes": {
+            "chat": {
+                "requests": 1, "goodput": 1, "ttft_attained": 1,
+                "itl_attained": 1,
+                "ttft_hist": {"counts": {"10": 1, "12": 0}, "total": 1},
+                "itl_hist": {"counts": {"7": 2}, "total": 2},
+            },
+            "batch": {
+                "requests": 2, "goodput": 2, "ttft_attained": 2,
+                "itl_attained": 2,
+                "ttft_hist": {"counts": {}, "total": 0},
+                "itl_hist": {"counts": {}, "total": 0},
+            },
+        }
+    }
+    merged = merge_class_views([va, vb])
+    assert merged["chat"]["requests"] == 4
+    assert merged["chat"]["goodput"] == 3
+    assert merged["chat"]["goodput_ratio"] == 0.75
+    assert merged["chat"]["ttft_hist"]["counts"] == {"10": 4}
+    assert merged["chat"]["itl_hist"]["counts"] == {"5": 6, "7": 2}
+    assert merged["chat"]["ttft_target_ms"] == 100.0
+    assert merged["batch"]["requests"] == 2
+    # Order independence of the fold.
+    assert merge_class_views([vb, va])["chat"] == merged["chat"]
+
+
+# ---------------------------------------------------------------------
+# slo_report rendering
+# ---------------------------------------------------------------------
+def test_slo_report_renders_both_shapes(tmp_path, capsys):
+    from tools.slo_report import class_rows, main
+
+    replica_view = {
+        "classes": {
+            "chat": {
+                "requests": 4, "goodput": 3, "ttft_attained": 4,
+                "itl_attained": 3, "ttft_target_ms": 200.0,
+                "itl_target_ms": 50.0,
+                "ttft_hist": {"counts": {"100": 4}, "total": 4},
+                "itl_hist": {"counts": {"80": 12}, "total": 12},
+            }
+        }
+    }
+    rows = class_rows(replica_view)
+    assert rows[0]["class"] == "chat"
+    assert rows[0]["goodput_ratio"] == 0.75
+    assert rows[0]["ttft_p99_ms"] is not None
+    dump = tmp_path / "slo.json"
+    dump.write_text(json.dumps(replica_view))
+    assert main([str(dump)]) == 0
+    out = capsys.readouterr().out
+    assert "chat" in out and "75.0%" in out
+
+
+# ---------------------------------------------------------------------
+# mocked 2-replica acceptance: router fleet merge is bit-equal to
+# recomputing from both replicas' raw timelines
+# ---------------------------------------------------------------------
+def _mk_engine(model_dir: str) -> AsyncLLM:
+    return AsyncLLM.from_engine_args(
+        EngineArgs(
+            model=model_dir,
+            skip_tokenizer_init=True,
+            load_format="dummy",
+            num_kv_pages=64,
+            max_model_len=128,
+            num_decode_steps=1,
+            distributed_executor_backend=MockUniProcExecutor,
+        )
+    )
+
+
+@pytest.mark.router
+def test_router_fleet_slo_bit_equal(tmp_path, monkeypatch):
+    monkeypatch.setenv("VDT_MOCK_TOKEN_SEQ", "1")
+    monkeypatch.setenv("VDT_SLO_TTFT_MS", "default:10000,chat:10000")
+    monkeypatch.setenv("VDT_SLO_ITL_MS", "default:10000,chat:10000")
+    model_dir = write_llama_config(str(tmp_path / "m"))
+
+    async def go():
+        engines, runners, urls = [], [], []
+        client = None
+        try:
+            for i in range(2):
+                engine = _mk_engine(model_dir)
+                state = init_app_state(
+                    engine,
+                    served_model_name="slo",
+                    replica_id=f"replica-{i}",
+                )
+                port = get_open_port()
+                runner = await serve_http(
+                    build_app(state), host="127.0.0.1", port=port
+                )
+                engines.append(engine)
+                runners.append(runner)
+                urls.append(f"http://127.0.0.1:{port}")
+            state = RouterState(
+                urls,
+                policy="round_robin",
+                health_interval=0.5,
+                connect_timeout=2.0,
+                read_timeout=20.0,
+            )
+            server = TestServer(build_router_app(state))
+            client = TestClient(server)
+            await client.start_server()
+
+            for i in range(8):
+                body = {
+                    "prompt": [1, 2, 3, i + 1],
+                    "max_tokens": 5,
+                    "temperature": 0.0,
+                    "ignore_eos": True,
+                    "slo_class": "chat" if i % 2 else "default",
+                }
+                r = await client.post("/v1/completions", json=body)
+                assert r.status == 200, await r.text()
+                await r.read()
+
+            # Both replicas served (round robin) — the merge is real.
+            per_replica = []
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                for u in urls:
+                    async with s.get(f"{u}/slo") as r:
+                        assert r.status == 200
+                        per_replica.append(await r.json())
+            assert all(
+                v["classes"].get("chat", {}).get("requests", 0) > 0
+                or v["classes"].get("default", {}).get("requests", 0) > 0
+                for v in per_replica
+            )
+
+            fleet = await (await client.get("/router/slo")).json()
+            assert sorted(fleet["replicas_merged"]) == [
+                "replica-0", "replica-1",
+            ]
+
+            # Recompute the fleet histograms DIRECTLY from the raw
+            # per-request timelines of both replicas; the router's
+            # merged histograms must be bit-equal.
+            recomputed: dict[str, dict[str, LogBucketHistogram]] = {}
+            counts: dict[str, dict[str, int]] = {}
+            for view in per_replica:
+                for tl in view["timelines"]:
+                    cls = tl["slo_class"]
+                    h = recomputed.setdefault(
+                        cls,
+                        {
+                            "ttft": LogBucketHistogram(),
+                            "itl": LogBucketHistogram(),
+                        },
+                    )
+                    c = counts.setdefault(
+                        cls, {"requests": 0, "goodput": 0}
+                    )
+                    c["requests"] += 1
+                    c["goodput"] += bool(tl["goodput"])
+                    if tl["ttft_ms"] is not None:
+                        h["ttft"].observe_ms(tl["ttft_ms"])
+                    for idx, n in (tl["itl_buckets"] or {}).items():
+                        h["itl"].observe_bucket(int(idx), n)
+            assert set(fleet["classes"]) == {"chat", "default"}
+            for cls, d in fleet["classes"].items():
+                assert (
+                    LogBucketHistogram.from_dict(d["ttft_hist"])
+                    == recomputed[cls]["ttft"]
+                ), cls
+                assert (
+                    LogBucketHistogram.from_dict(d["itl_hist"])
+                    == recomputed[cls]["itl"]
+                ), cls
+                assert d["requests"] == counts[cls]["requests"]
+                assert d["goodput"] == counts[cls]["goodput"]
+                # Generous targets: everything completed is goodput.
+                assert d["goodput_ratio"] == 1.0
+
+            # The router /metrics view: every new per-class histogram
+            # family appears EXACTLY once (one TYPE line) with both
+            # replica labels under it, and the fleet gauges render.
+            text = await (await client.get("/metrics")).text()
+            for family in ("vllm:slo_ttft_ms", "vllm:slo_itl_ms"):
+                assert text.count(f"# TYPE {family} histogram") == 1
+                for rid in ("replica-0", "replica-1"):
+                    assert f'{family}_bucket{{' in text
+                    assert f'replica="{rid}"' in text
+            assert "vdt_router:fleet_goodput_ratio" in text
+            assert "vdt_router:fleet_ttft_p99_ms" in text
+        finally:
+            if client is not None:
+                await client.close()
+            for runner in runners:
+                try:
+                    await runner.cleanup()
+                except Exception:  # noqa: BLE001 — teardown
+                    pass
+            for engine in engines:
+                engine.shutdown()
+
+    _run(go())

@@ -40,6 +40,7 @@ RULES = {
     "silent-except": "VDT006",
     "orphan-span": "VDT007",
     "unbounded-queue": "VDT008",
+    "bounded-cardinality": "VDT009",
 }
 
 

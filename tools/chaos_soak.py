@@ -175,6 +175,12 @@ def run_soak(
         "VDT_CRASH_LOOP_WINDOW_SECONDS": "3600",
         "VDT_MOCK_TOKEN_SEQ": "1",
         "VDT_MOCK_EXECUTE_SLEEP_SECONDS": "0.05",
+        # Flight-recorder artifacts (ISSUE 12) land in a fresh dir so
+        # the report can count the dumps this soak's kill cycles
+        # produced (one per HostFailure + one per recovery cycle).
+        "VDT_FLIGHT_RECORDER_DIR": tempfile.mkdtemp(
+            prefix="vdt_soak_fr_"
+        ),
     }
     if overload_rps > 0:
         env["VDT_MAX_WAITING_REQUESTS"] = str(overload_cap)
@@ -357,6 +363,15 @@ def run_soak(
             },
             "restarts_total": engine.supervisor.restarts_total,
             "agent_respawns": agents.respawns,
+            # ISSUE 12 contract: every kill cycle leaves a post-mortem
+            # artifact behind (host_failure and/or recovery dumps).
+            "flightrecorder_dumps": len(
+                [
+                    f
+                    for f in os.listdir(env["VDT_FLIGHT_RECORDER_DIR"])
+                    if f.startswith("flightrecorder-")
+                ]
+            ),
         }
         if overload_rps > 0:
             rss_after = _rss_mb()

@@ -1,0 +1,146 @@
+"""Per-class SLO attainment / goodput table from an /slo scrape.
+
+The terminal companion to the ISSUE 12 accounting layer: point it at a
+replica's ``/slo``, a router's ``/router/slo``, or a saved JSON dump of
+either, and it prints the per-class attainment table the autoscaler
+(ROADMAP item 5) will eventually consume programmatically.
+
+    python tools/slo_report.py http://localhost:8000/slo
+    python tools/slo_report.py http://localhost:8080/router/slo
+    python tools/slo_report.py slo_dump.json
+
+Pure stdlib.  Both input shapes carry a ``classes`` map; the replica
+form holds raw counters + histograms (percentiles are computed here via
+engine/slo.py), the router form arrives pre-merged with percentiles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+from vllm_distributed_tpu.engine.slo import LogBucketHistogram
+
+
+def load_view(source: str) -> dict:
+    """Read a view from a file path, '-' (stdin), or an http(s) URL."""
+    if source.startswith(("http://", "https://")):
+        with urllib.request.urlopen(source, timeout=30) as resp:
+            return json.load(resp)
+    if source == "-":
+        return json.load(sys.stdin)
+    with open(source) as f:
+        return json.load(f)
+
+
+def _pct(hist_dict: dict | None, q: float) -> float | None:
+    if not hist_dict:
+        return None
+    return LogBucketHistogram.from_dict(hist_dict).percentile_ms(q)
+
+
+def class_rows(view: dict) -> list[dict]:
+    """Normalize either shape into rows, one per SLO class."""
+    rows = []
+    for cls, d in sorted((view.get("classes") or {}).items()):
+        requests = int(d.get("requests", 0))
+
+        def ratio(key):
+            return int(d.get(key, 0)) / requests if requests else None
+
+        rows.append(
+            {
+                "class": cls,
+                "requests": requests,
+                "goodput": int(d.get("goodput", 0)),
+                "goodput_ratio": d.get("goodput_ratio", ratio("goodput")),
+                "ttft_attain": ratio("ttft_attained"),
+                "itl_attain": ratio("itl_attained"),
+                "ttft_target_ms": d.get("ttft_target_ms"),
+                "itl_target_ms": d.get("itl_target_ms"),
+                "ttft_p50_ms": d.get(
+                    "ttft_p50_ms", _pct(d.get("ttft_hist"), 0.5)
+                ),
+                "ttft_p99_ms": d.get(
+                    "ttft_p99_ms", _pct(d.get("ttft_hist"), 0.99)
+                ),
+                "itl_p50_ms": d.get(
+                    "itl_p50_ms", _pct(d.get("itl_hist"), 0.5)
+                ),
+                "itl_p99_ms": d.get(
+                    "itl_p99_ms", _pct(d.get("itl_hist"), 0.99)
+                ),
+            }
+        )
+    return rows
+
+
+def _fmt(value, pct=False) -> str:
+    if value is None:
+        return "-"
+    if pct:
+        return f"{value * 100:.1f}%"
+    return f"{value:.1f}"
+
+
+def render_table(rows: list[dict]) -> str:
+    headers = (
+        "class", "reqs", "goodput", "ttft_ok", "itl_ok",
+        "ttft_p50", "ttft_p99", "tgt", "itl_p50", "itl_p99", "tgt",
+    )
+    table = [headers]
+    for r in rows:
+        table.append(
+            (
+                r["class"],
+                str(r["requests"]),
+                _fmt(r["goodput_ratio"], pct=True),
+                _fmt(r["ttft_attain"], pct=True),
+                _fmt(r["itl_attain"], pct=True),
+                _fmt(r["ttft_p50_ms"]),
+                _fmt(r["ttft_p99_ms"]),
+                _fmt(r["ttft_target_ms"]),
+                _fmt(r["itl_p50_ms"]),
+                _fmt(r["itl_p99_ms"]),
+                _fmt(r["itl_target_ms"]),
+            )
+        )
+    widths = [
+        max(len(row[i]) for row in table) for i in range(len(headers))
+    ]
+    lines = []
+    for i, row in enumerate(table):
+        lines.append(
+            "  ".join(cell.ljust(w) for cell, w in zip(row, widths))
+        )
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="per-SLO-class attainment/goodput table from an "
+        "/slo or /router/slo scrape (latency columns in ms)"
+    )
+    parser.add_argument(
+        "source", help="URL, JSON file path, or '-' for stdin"
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit rows as JSON"
+    )
+    args = parser.parse_args(argv)
+    rows = class_rows(load_view(args.source))
+    if args.json:
+        print(json.dumps(rows, indent=2))
+    elif not rows:
+        print("no SLO classes observed yet")
+    else:
+        print(render_table(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

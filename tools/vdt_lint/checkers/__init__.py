@@ -10,10 +10,12 @@
 | VDT006 | silent-except    | no ``except Exception: pass``                    |
 | VDT007 | orphan-span      | spans open via ``with`` / try-finally ``.end()`` |
 | VDT008 | unbounded-queue  | queues/deques on the request path carry a bound  |
+| VDT009 | bounded-cardinality | metric labels never derive from unbounded sources |
 """
 
 from tools.vdt_lint.checkers import (  # noqa: F401
     async_blocking,
+    bounded_cardinality,
     env_registry,
     lock_across_await,
     orphan_span,

@@ -1,0 +1,104 @@
+"""VDT009 bounded-cardinality: metric label values never derive from
+unbounded sources (request ids, prompts, trace ids, token ids).
+
+The ISSUE 12 metrics layer added client-influenced labels (slo_class),
+which is safe only because engine/slo.py sanitizes and CAPS the label
+space.  The failure mode this rule guards against is the classic
+Prometheus cardinality bomb: a ``.labels(request_id=...)`` call mints a
+new time series per request, growing the registry (and every scrape)
+without bound until the process — or the monitoring stack — falls over.
+
+The rule scans ``.labels(...)`` call sites in the metrics modules: any
+argument expression that mentions an identifier, attribute, or string
+key drawn from a known-unbounded source family (``request_id``/
+``req_id``, ``prompt``, ``trace_id``/``span_id``, ``token_id(s)``) is
+flagged.  Bounded-by-construction values (sanitized class names,
+enum-like reasons, host ranks, replica ids) pass untouched.  A value
+that is genuinely bounded despite its name carries a waiver naming what
+bounds it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.vdt_lint.core import Checker, FileContext, Finding, register
+
+# Identifier fragments that mark a value as derived from an unbounded,
+# per-request source.  Matched as substrings of lowercased identifier /
+# attribute / string-literal tokens inside the label-value expression.
+_UNBOUNDED_FRAGMENTS = (
+    "request_id",
+    "req_id",
+    "prompt",
+    "trace_id",
+    "span_id",
+    "token_id",
+)
+
+
+def _expr_tokens(node: ast.AST) -> Iterable[tuple[str, ast.AST]]:
+    """Yield (lowercased token, node) for every identifier, attribute
+    tail, and string literal inside a label-value expression."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id.lower(), sub
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr.lower(), sub
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            yield sub.value.lower(), sub
+
+
+def _unbounded_token(node: ast.AST) -> str | None:
+    for token, _ in _expr_tokens(node):
+        for fragment in _UNBOUNDED_FRAGMENTS:
+            if fragment in token:
+                return token
+    return None
+
+
+@register
+class BoundedCardinalityChecker(Checker):
+    code = "VDT009"
+    rule = "bounded-cardinality"
+    description = "metric label value derived from an unbounded source"
+    rationale = (
+        "a label minted per request id / prompt / trace id creates one "
+        "time series per request — the registry, every scrape, and the "
+        "monitoring backend grow without bound; use a bounded, "
+        "sanitized label (or no label) instead"
+    )
+    # Package-wide: every `.labels()` call site today lives in the two
+    # metrics modules (EngineMetrics / RouterMetrics), but a new module
+    # minting its own labeled series is exactly the drift this guards.
+    scope = None
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "labels"
+            ):
+                continue
+            values = list(node.args) + [
+                kw.value for kw in node.keywords if kw.arg is not None
+            ]
+            # `.labels(**label)` dict-splat: inspect the splatted
+            # expression itself (its construction names its sources).
+            values += [
+                kw.value for kw in node.keywords if kw.arg is None
+            ]
+            for value in values:
+                token = _unbounded_token(value)
+                if token is not None:
+                    yield ctx.finding(
+                        self,
+                        node,
+                        f"label value mentions unbounded source "
+                        f"{token!r} — one time series per request; use "
+                        "a bounded sanitized label or waive with what "
+                        "bounds it",
+                    )
+                    break

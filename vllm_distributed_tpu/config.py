@@ -361,6 +361,10 @@ class DeviceConfig:
 @dataclass
 class ObservabilityConfig:
     collect_metrics: bool = True
+    # Server-side jax.profiler captures: artifact directory for the
+    # worker `profile` verb AND the gated POST /debug/profile endpoint
+    # (None/empty = endpoint answers 404).  --profile-dir wins over
+    # VDT_PROFILE_DIR.
     profile_dir: str | None = None
     # Per-request tracing (tracing.py): root span per API request,
     # queue/prefill/decode spans, per-step schedule/dispatch/gather
@@ -369,6 +373,10 @@ class ObservabilityConfig:
     enable_tracing: bool = False
     # Completed traces kept in the in-memory ring (/debug/traces).
     trace_ring_size: int = 256
+    # Flight recorder (engine/flight_recorder.py): per-step records
+    # kept in the always-on bounded ring (0 disables recording and the
+    # automatic failure/drain dumps).
+    flight_recorder_size: int = 512
 
 
 @dataclass
@@ -820,13 +828,14 @@ class EngineArgs:
             device_config=DeviceConfig(device=self.device),
             observability_config=ObservabilityConfig(
                 collect_metrics=not self.disable_log_stats,
-                profile_dir=self.profile_dir,
+                profile_dir=self.profile_dir or envs.VDT_PROFILE_DIR or None,
                 enable_tracing=(
                     envs.VDT_TRACING
                     if self.enable_tracing is None
                     else self.enable_tracing
                 ),
                 trace_ring_size=envs.VDT_TRACE_RING_SIZE,
+                flight_recorder_size=envs.VDT_FLIGHT_RECORDER_SIZE,
             ),
             kv_transfer_config=kv_transfer,
         )

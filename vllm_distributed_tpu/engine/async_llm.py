@@ -111,6 +111,9 @@ class AsyncLLM:
         )
         self._admission.attach_scheduler(self.engine.scheduler)
         self._drain_journal_path = envs.VDT_DRAIN_JOURNAL_PATH or None
+        # Last scrape-triggered device-telemetry pull (monotonic); see
+        # refresh_device_telemetry.
+        self._telemetry_refreshed = float("-inf")
         # Requests journaled by a previous process's drain: re-admitted
         # (with their emitted tokens restored) when a client re-attaches
         # via generate() with the same request id.
@@ -626,6 +629,9 @@ class AsyncLLM:
             )
         self._admission.finish_drain()
         self.engine.metrics.record_drain_state(DRAIN_DRAINED)
+        # Flight-recorder artifact for the hand-off post-mortem trail
+        # (ISSUE 12): what the engine was doing up to the drain.
+        self.engine.flight_recorder.dump("drain")
         result = {
             "status": "drained",
             "waited_s": round(time.monotonic() - t0, 3),
@@ -695,6 +701,32 @@ class AsyncLLM:
         the aux collective is ordered with step dispatches mesh-wide."""
         return await self._run_aux(
             lambda ids: self.engine.embed(ids), prompt_token_ids
+        )
+
+    # Scrape-triggered telemetry pulls are coalesced to this interval:
+    # the aux RPC runs on the engine thread between steps, and the
+    # /metrics endpoint is unauthenticated — without a floor, a scrape
+    # storm (or several scrapers: Prometheus + the router's merged
+    # view) would stall token generation behind back-to-back RPCs.
+    TELEMETRY_MIN_INTERVAL_SECONDS = 2.0
+
+    async def refresh_device_telemetry(self) -> dict | None:
+        """Pull worker XLA/HBM telemetry into the metrics (ISSUE 12).
+        Rides the aux path so the collective is ordered with step
+        dispatches; /metrics calls this best-effort per scrape, rate-
+        limited so concurrent/frequent scrapers coalesce onto one pull
+        per interval (the skipped ones serve the last-pulled values)."""
+        now = time.monotonic()
+        if (
+            now - self._telemetry_refreshed
+            < self.TELEMETRY_MIN_INTERVAL_SECONDS
+        ):
+            return None
+        # Stamp BEFORE awaiting: scrapers arriving while the pull is in
+        # flight skip instead of queueing their own RPCs.
+        self._telemetry_refreshed = now
+        return await self._run_aux(
+            lambda: self.engine.refresh_device_telemetry()
         )
 
     async def score(self, prompt_token_ids: list[int]) -> list:

@@ -1,0 +1,161 @@
+"""Always-on engine flight recorder (ISSUE 12 tentpole, part 3).
+
+A bounded ring of per-step records — batch composition, queue depths,
+pipeline/spec state, KV headroom — cheap enough to leave on in
+production (one tuple append per step, no strings, no allocation beyond
+the tuple), so that when something goes wrong the **last N steps before
+the incident are already captured**.  The ring is dumped automatically
+to a JSON artifact on ``HostFailure`` (engine death), at the start of a
+supervisor recovery cycle, and after a graceful drain; on demand it is
+served by ``GET /debug/flightrecorder`` (``?dump=1`` writes the
+artifact too).
+
+Knobs: ``VDT_FLIGHT_RECORDER_SIZE`` (steps kept; 0 disables),
+``VDT_FLIGHT_RECORDER_DIR`` (artifact directory, per-host).  Artifacts
+are pruned to the newest ``_KEEP_DUMPS`` so a crash loop cannot fill
+the disk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+# One record per engine step, stored as a plain tuple in FIELD order
+# (allocation-lean: no per-step dict); snapshot() re-zips to dicts.
+FIELDS = (
+    "step_id",
+    "t_wall",
+    "t_mono",
+    "num_running",
+    "num_waiting",
+    "scheduled_tokens",
+    "decode_steps",
+    "num_new",
+    "num_cached",
+    "num_preempted",
+    "num_finished",
+    "drafted",
+    "pending_dispatches",
+    "pipeline_breaks",
+    "kv_free_pages",
+)
+
+_KEEP_DUMPS = 16
+
+
+def default_dump_dir() -> str:
+    import tempfile
+
+    return os.path.join(tempfile.gettempdir(), "vdt-flightrecorder")
+
+
+class FlightRecorder:
+    """Bounded per-step ring + JSON dump.  Engine-thread writer, any
+    thread may snapshot (tuple append/iteration are GIL-atomic)."""
+
+    def __init__(
+        self, size: int | None = None, dump_dir: str | None = None
+    ) -> None:
+        if size is None or dump_dir is None:
+            from vllm_distributed_tpu import envs
+
+            if size is None:
+                size = envs.VDT_FLIGHT_RECORDER_SIZE
+            if dump_dir is None:
+                dump_dir = (
+                    envs.VDT_FLIGHT_RECORDER_DIR or default_dump_dir()
+                )
+        self.enabled = size > 0
+        self.dump_dir = dump_dir
+        self._ring: deque[tuple] = deque(maxlen=max(size, 1))
+        self._events: deque[tuple] = deque(maxlen=64)  # (t_wall, name, detail)
+
+    def record_step(self, *values) -> None:
+        """Append one step record (positional, in FIELD order — the hot
+        path stays a tuple pack + deque append)."""
+        if self.enabled:
+            self._ring.append(values)
+
+    def record_event(self, name: str, detail: str = "") -> None:
+        """Out-of-band marker (failure, recovery, drain) interleaved
+        with the step ring by timestamp in the dump."""
+        if self.enabled:
+            self._events.append((time.time(), name, detail))
+
+    def snapshot(self) -> dict:
+        return {
+            "version": 1,
+            "fields": list(FIELDS),
+            "steps": [list(r) for r in list(self._ring)],
+            "events": [
+                {"t_wall": t, "name": n, "detail": d}
+                for t, n, d in list(self._events)
+            ],
+        }
+
+    def dump(self, reason: str, extra: dict | None = None) -> str | None:
+        """Write the ring to a JSON artifact; returns the path (or None
+        when disabled/unwritable — telemetry never takes the engine
+        down).  Old artifacts are pruned to the newest _KEEP_DUMPS."""
+        if not self.enabled:
+            return None
+        self.record_event(f"dump:{reason}")
+        payload = self.snapshot()
+        payload["reason"] = reason
+        payload["t_dump"] = time.time()
+        payload["pid"] = os.getpid()
+        if extra:
+            payload["extra"] = extra
+        name = (
+            f"flightrecorder-{reason}-{os.getpid()}-"
+            f"{int(time.time() * 1000)}.json"
+        )
+        path = os.path.join(self.dump_dir, name)
+        try:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+            self._prune()
+        except OSError as e:
+            logger.warning("flight-recorder dump to %s failed: %s", path, e)
+            return None
+        logger.warning(
+            "flight recorder dumped %d step record(s) to %s (%s)",
+            len(payload["steps"]),
+            path,
+            reason,
+        )
+        return path
+
+    def _prune(self) -> None:
+        try:
+            # Scoped to THIS process's dumps (filenames carry the pid)
+            # and ordered by mtime: co-hosted replicas sharing the
+            # default directory must never delete each other's incident
+            # artifacts, and a lexicographic order (reason/pid first)
+            # could delete the current incident's dump while keeping
+            # stale ones.
+            marker = f"-{os.getpid()}-"
+            dumps = sorted(
+                (
+                    os.path.join(self.dump_dir, f)
+                    for f in os.listdir(self.dump_dir)
+                    if f.startswith("flightrecorder-")
+                    and f.endswith(".json")
+                    and marker in f
+                ),
+                key=os.path.getmtime,
+            )
+            for stale in dumps[:-_KEEP_DUMPS]:
+                os.unlink(stale)
+        except OSError as e:  # best-effort hygiene only
+            logger.debug("flight-recorder prune failed: %s", e)

@@ -88,6 +88,22 @@ class LLMEngine:
         self._preemptions_seen = 0
         self._prefix_cache_seen = (0, 0)  # (queries, hits) already recorded
         self._spec_seen = (0, 0)  # (drafted, accepted) already recorded
+        # Flight recorder (ISSUE 12): always-on bounded ring of per-step
+        # records, dumped on HostFailure/recovery/drain and served at
+        # /debug/flightrecorder.
+        from vllm_distributed_tpu.engine.flight_recorder import (
+            FlightRecorder,
+        )
+
+        self.flight_recorder = FlightRecorder(
+            size=obs.flight_recorder_size
+        )
+        # Device-telemetry pull cursors: event-ring position (timing
+        # histogram) and cumulative per-kind compile totals already
+        # counted (exact even when the bounded event ring overflows
+        # between scrapes — the recompile-storm case).
+        self._telemetry_seq = 0
+        self._telemetry_compiles_seen: dict[str, int] = {}
 
         self.tokenizer = None
         if not config.model_config.skip_tokenizer_init:
@@ -125,6 +141,16 @@ class LLMEngine:
         )
         logger.error("executor reported failure; engine is dead%s", detail)
         self.metrics.record_engine_dead(self.failure_info)
+        # Capture the last N steps before the incident while the state
+        # is fresh — the artifact the post-mortem starts from.
+        self.flight_recorder.dump(
+            "host_failure",
+            extra=(
+                self.failure_info.to_dict()
+                if self.failure_info is not None
+                else None
+            ),
+        )
 
     @property
     def errored(self) -> bool:
@@ -299,6 +325,8 @@ class LLMEngine:
             self.metrics.record_pipeline_break()
             outputs.extend(self._drain_pending())
         scheduler_output = self._schedule()
+        if self.flight_recorder.enabled:
+            self._record_flight(scheduler_output)
         # Deadline sheds and preempt-to-sheds finish OUTSIDE
         # update_from_output; emit their final (partial) outputs now so
         # clients see finish_reason="timeout"/"overloaded" promptly.
@@ -323,6 +351,69 @@ class LLMEngine:
         runner_output = self.executor.execute_model(scheduler_output)
         outputs.extend(self._process(scheduler_output, runner_output))
         return outputs
+
+    def _record_flight(self, so) -> None:
+        """One flight-recorder record per scheduled step (positional, in
+        flight_recorder.FIELDS order — tuple pack + deque append)."""
+        s = self.scheduler
+        self.flight_recorder.record_step(
+            so.step_id,
+            time.time(),
+            time.monotonic(),
+            len(s.running),
+            len(s.waiting),
+            so.total_num_scheduled_tokens,
+            so.decode_steps,
+            len(so.new_requests),
+            len(so.cached_requests),
+            len(so.preempted_req_ids),
+            len(so.finished_req_ids),
+            sum(len(d) for d in so.draft_token_ids.values()),
+            len(self._pending),
+            self.pipeline_breaks,
+            s.allocator.num_free_pages,
+        )
+
+    def refresh_device_telemetry(self) -> dict | None:
+        """Pull one DeviceTelemetry snapshot from the reply-rank worker
+        and fold it into the Prometheus instruments: compile events past
+        the cursor are counted exactly once, gauges take the latest
+        value.  Called on /metrics scrapes (via the AsyncLLM aux path,
+        so the collective stays ordered with step dispatches) and
+        directly by engine-level tests.  Best-effort: a dead executor
+        just leaves the previous values standing."""
+        try:
+            snap = self.executor.collective_rpc(
+                "get_device_telemetry",
+                unique_reply_rank=self.executor.output_rank,
+                # Short: this runs between step dispatches on the engine
+                # thread — a slow host must cost a missed scrape, never
+                # a long decode stall.
+                timeout=5.0,
+            )
+        except Exception as e:  # noqa: BLE001 — telemetry only
+            logger.debug("device-telemetry pull failed: %s", e)
+            return None
+        if not isinstance(snap, dict):
+            return None
+        # Timing histogram from the (bounded) event ring; the COUNTER
+        # uses the cumulative totals below so it stays exact even when
+        # more compiles happened between scrapes than the ring holds.
+        for event in snap.get("compile_events", ()):
+            try:
+                seq, seconds = event[0], event[2]
+            except (IndexError, TypeError):
+                continue
+            if seq > self._telemetry_seq:
+                self._telemetry_seq = seq
+                self.metrics.record_xla_compile_seconds(float(seconds))
+        for kind, total in (snap.get("compiles") or {}).items():
+            seen = self._telemetry_compiles_seen.get(kind, 0)
+            if total > seen:
+                self.metrics.record_xla_compiles(str(kind), total - seen)
+                self._telemetry_compiles_seen[kind] = total
+        self.metrics.record_device_snapshot(snap)
+        return snap
 
     def _finish_out_of_band(self) -> list[RequestOutput]:
         """Final outputs for requests the scheduler finished outside
@@ -435,6 +526,9 @@ class LLMEngine:
             request_id=req.request_id,
             finish_reason=FINISH_REASON.get(req.status, "?"),
             num_output_tokens=req.num_output_tokens,
+            # Joins traces to the per-class SLO accounting (ISSUE 12):
+            # "which class were the slow traces in" becomes greppable.
+            slo_class=req.sampling_params.slo_class,
         )
 
     def _process(

@@ -92,6 +92,9 @@ class Request:
     def __post_init__(self) -> None:
         self.metrics.arrival_time = time.time()
         self.metrics.arrival_time_mono = self.arrival_time
+        # SLO class rides on RequestMetrics so the metrics layer can key
+        # its per-class accounting without reaching back into params.
+        self.metrics.slo_class = self.sampling_params.slo_class
         if self.sampling_params.logprobs is not None:
             self.logprobs = []
 

@@ -1,0 +1,398 @@
+"""SLO/goodput accounting (ISSUE 12 tentpole, part 1).
+
+Every bench so far judged the system on tokens/s and raw percentiles,
+but the north-star workloads are judged on **SLO attainment**: DistServe
+(Zhong et al., OSDI 2024, PAPERS.md) defines *goodput* — requests
+completed within their TTFT/ITL SLO — as the metric that actually
+matters for serving, and Llumnix (Sun et al. 2024) shows fleet
+scheduling is only as good as the per-replica load/latency signals.
+This module is the measurement substrate ROADMAP items 4 (SLO-class
+scheduling) and 5 (autoscaler) consume.
+
+Three pieces:
+
+- ``LogBucketHistogram``: an HDR-style log-bucket histogram over
+  milliseconds whose state is a sparse ``{bucket_index: count}`` map of
+  integers.  Merging is integer addition, so it is **associative and
+  order-independent by construction** — the router can fold N replicas'
+  histograms into a fleet view that is bit-equal to recomputing from
+  the union of raw observations (tests/test_slo.py pins this with a
+  property test).  Bucket geometry is fixed (8 sub-buckets per octave,
+  ~9% relative resolution from 1 µs to ~12 days), so indices mean the
+  same thing on every replica.
+- ``SloAccounting``: per-request timeline records (admit → first token
+  → per-token ITL, all monotonic-anchored via RequestMetrics'
+  ``*_mono`` stamps) folded into per-class histograms and attainment
+  tallies against configurable targets (``VDT_SLO_TTFT_MS`` /
+  ``VDT_SLO_ITL_MS``).  A bounded ring of raw per-request timelines is
+  kept for the bit-equality contract and ``tools/slo_report.py``.
+- Class-name hygiene: the SLO class is a **label** on Prometheus
+  families, so its cardinality must be bounded no matter what clients
+  send (vdt-lint VDT009 enforces the same rule statically): names are
+  sanitized to a small charset and the number of distinct classes is
+  capped, with overflow folded into ``"other"``.
+
+Target syntax (both env vars): ``"500"`` sets the ``default`` class;
+``"default:500,interactive:200,batch:5000"`` sets per-class targets in
+milliseconds.  A class without a target attains trivially (goodput
+degenerates to completed throughput), so the accounting is always on
+and costs two dict updates per request milestone.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+
+# Fixed bucket geometry: index 0 holds non-positive values; index i>0
+# covers milliseconds in [2^((i-1)/8 - 10), 2^(i/8 - 10)) — 8 buckets
+# per octave starting at ~1 µs.  _MAX_BUCKET caps the range at ~2^30 ms.
+_SUB = 8
+_OFFSET_OCTAVES = 10
+_MAX_BUCKET = 1 + (_OFFSET_OCTAVES + 30) * _SUB
+
+DEFAULT_CLASS = "default"
+OVERFLOW_CLASS = "other"
+# Distinct classes one replica tracks before folding into "other" —
+# the bound that keeps slo_class a legal Prometheus label (VDT009).
+MAX_CLASSES = 32
+_CLASS_CHARS = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_.-"
+)
+_CLASS_MAX_LEN = 48
+
+
+def bucket_index(ms: float) -> int:
+    """Bucket index for a millisecond value (fixed geometry, above)."""
+    if ms <= 0 or ms != ms:  # non-positive or NaN
+        return 0
+    idx = 1 + math.floor((math.log2(ms) + _OFFSET_OCTAVES) * _SUB)
+    return min(max(idx, 1), _MAX_BUCKET)
+
+def bucket_value_ms(idx: int) -> float:
+    """Representative (geometric-mid) millisecond value of a bucket."""
+    if idx <= 0:
+        return 0.0
+    return 2.0 ** ((idx - 0.5) / _SUB - _OFFSET_OCTAVES)
+
+
+class LogBucketHistogram:
+    """Sparse integer log-bucket histogram; merge = per-bucket addition
+    (associative, commutative, idempotent on the empty histogram)."""
+
+    __slots__ = ("counts", "total")
+
+    def __init__(self, counts: dict[int, int] | None = None) -> None:
+        self.counts: dict[int, int] = {}
+        self.total = 0
+        if counts:
+            for idx, n in counts.items():
+                idx, n = int(idx), int(n)
+                if n > 0:
+                    self.counts[idx] = self.counts.get(idx, 0) + n
+                    self.total += n
+
+    def observe_ms(self, ms: float, n: int = 1) -> int:
+        idx = bucket_index(ms)
+        self.counts[idx] = self.counts.get(idx, 0) + n
+        self.total += n
+        return idx
+
+    def observe_bucket(self, idx: int, n: int = 1) -> None:
+        idx = int(idx)
+        self.counts[idx] = self.counts.get(idx, 0) + n
+        self.total += n
+
+    def merge(self, other: "LogBucketHistogram") -> "LogBucketHistogram":
+        """Return a NEW histogram = self + other (inputs untouched)."""
+        out = LogBucketHistogram(self.counts)
+        for idx, n in other.counts.items():
+            out.counts[idx] = out.counts.get(idx, 0) + n
+            out.total += n
+        return out
+
+    def percentile_ms(self, q: float) -> float | None:
+        """Representative value at quantile ``q`` in [0, 1]."""
+        if self.total == 0:
+            return None
+        rank = max(1, math.ceil(q * self.total))
+        seen = 0
+        for idx in sorted(self.counts):
+            seen += self.counts[idx]
+            if seen >= rank:
+                return bucket_value_ms(idx)
+        return bucket_value_ms(max(self.counts))  # pragma: no cover
+
+    def to_dict(self) -> dict:
+        """Wire form: string keys (JSON object keys are strings)."""
+        return {
+            "counts": {str(i): n for i, n in sorted(self.counts.items())},
+            "total": self.total,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LogBucketHistogram":
+        return cls(
+            {int(i): int(n) for i, n in (d.get("counts") or {}).items()}
+        )
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, LogBucketHistogram):
+            return NotImplemented
+        a = {i: n for i, n in self.counts.items() if n}
+        b = {i: n for i, n in other.counts.items() if n}
+        return a == b
+
+
+def sanitize_class(name: str | None) -> str:
+    """Bound the label space: empty/None → default; hostile names are
+    filtered to the legal charset and truncated, never passed through."""
+    if not name:
+        return DEFAULT_CLASS
+    cleaned = "".join(c for c in str(name)[:_CLASS_MAX_LEN] if c in _CLASS_CHARS)
+    return cleaned or DEFAULT_CLASS
+
+
+def parse_class_targets(raw: str) -> dict[str, float]:
+    """Parse ``VDT_SLO_TTFT_MS``/``VDT_SLO_ITL_MS``: a bare number sets
+    the default class; ``class:ms`` entries (comma-separated) set
+    per-class targets.  Unparseable entries are ignored (telemetry
+    must not take the server down); 0/negative disables the target."""
+    targets: dict[str, float] = {}
+    for piece in (raw or "").split(","):
+        piece = piece.strip()
+        if not piece:
+            continue
+        cls, sep, value = piece.rpartition(":")
+        cls = sanitize_class(cls) if sep else DEFAULT_CLASS
+        try:
+            ms = float(value)
+        except ValueError:
+            continue
+        if ms > 0:
+            targets[cls] = ms
+    return targets
+
+
+@dataclass
+class _ClassState:
+    """Per-SLO-class accumulators (one replica's view)."""
+
+    ttft_hist: LogBucketHistogram = field(default_factory=LogBucketHistogram)
+    itl_hist: LogBucketHistogram = field(default_factory=LogBucketHistogram)
+    requests: int = 0
+    ttft_attained: int = 0
+    itl_attained: int = 0
+    goodput: int = 0
+
+
+# Finish reasons that can count toward goodput: the request delivered
+# its complete answer.  Sheds/timeouts/aborts are outcomes, not goodput.
+_COMPLETED_REASONS = frozenset(("stop", "length"))
+
+
+class SloAccounting:
+    """Per-class SLO attainment and goodput for ONE replica.
+
+    Mutated from the engine thread (via EngineMetrics); ``snapshot`` is
+    read from the event loop (``/slo``), so state is guarded by a small
+    lock — every record path is O(1) dict work under it.
+    """
+
+    def __init__(
+        self,
+        ttft_targets: dict[str, float] | None = None,
+        itl_targets: dict[str, float] | None = None,
+        max_classes: int = MAX_CLASSES,
+        timeline_ring: int = 1024,
+    ) -> None:
+        if ttft_targets is None or itl_targets is None:
+            from vllm_distributed_tpu import envs
+
+            if ttft_targets is None:
+                ttft_targets = parse_class_targets(envs.VDT_SLO_TTFT_MS)
+            if itl_targets is None:
+                itl_targets = parse_class_targets(envs.VDT_SLO_ITL_MS)
+        self.ttft_targets = dict(ttft_targets)
+        self.itl_targets = dict(itl_targets)
+        self.max_classes = max_classes
+        self._lock = threading.Lock()
+        self.classes: dict[str, _ClassState] = {}
+        # Raw per-request timelines (bounded): what the bit-equality
+        # contract recomputes histograms from, and what slo_report.py
+        # renders when pointed at a raw dump.
+        self.timelines: deque[dict] = deque(maxlen=max(timeline_ring, 1))
+
+    # ---- class resolution (bounded label space) ----
+    def resolve(self, name: str | None) -> str:
+        cls = sanitize_class(name)
+        with self._lock:
+            if cls in self.classes:
+                return cls
+            if len(self.classes) >= self.max_classes:
+                return OVERFLOW_CLASS
+            self.classes[cls] = _ClassState()
+            return cls
+
+    def _state(self, cls: str) -> _ClassState:
+        # Lock held.  resolve() caps growth; OVERFLOW_CLASS always fits.
+        st = self.classes.get(cls)
+        if st is None:
+            st = self.classes[cls] = _ClassState()
+        return st
+
+    # ---- observation (engine thread) ----
+    def record_ttft(self, cls: str, seconds: float) -> None:
+        with self._lock:
+            self._state(cls).ttft_hist.observe_ms(seconds * 1000.0)
+
+    def record_itl(self, cls: str, seconds: float, n: int = 1) -> int:
+        """Record ``n`` inter-token intervals of ``seconds`` each;
+        returns the bucket index so the caller can keep the request's
+        own per-bucket tally (timeline recompute contract)."""
+        with self._lock:
+            return self._state(cls).itl_hist.observe_ms(
+                seconds * 1000.0, n
+            )
+
+    def record_finished(
+        self,
+        cls: str,
+        ttft_s: float | None,
+        itl_max_s: float | None,
+        itl_buckets: dict[int, int] | None,
+        finish_reason: str | None,
+    ) -> tuple[bool, bool, bool]:
+        """One finished request: attainment against the class targets.
+        Returns (ttft_attained, itl_attained, goodput) so EngineMetrics
+        can mirror them into the Prometheus counters."""
+        ttft_target = self.ttft_targets.get(cls)
+        itl_target = self.itl_targets.get(cls)
+        # No target ⇒ trivially attained (goodput == completed): the
+        # accounting is always on, the SLO is opt-in per class.
+        ttft_ok = (
+            ttft_target is None
+            or (ttft_s is not None and ttft_s * 1000.0 <= ttft_target)
+        )
+        # A request with ≤1 token has no inter-token intervals: its ITL
+        # SLO is vacuously attained.
+        itl_ok = (
+            itl_target is None
+            or itl_max_s is None
+            or itl_max_s * 1000.0 <= itl_target
+        )
+        good = (
+            ttft_ok and itl_ok and finish_reason in _COMPLETED_REASONS
+        )
+        with self._lock:
+            st = self._state(cls)
+            st.requests += 1
+            if ttft_ok:
+                st.ttft_attained += 1
+            if itl_ok:
+                st.itl_attained += 1
+            if good:
+                st.goodput += 1
+            self.timelines.append(
+                {
+                    "slo_class": cls,
+                    "ttft_ms": (
+                        None if ttft_s is None else ttft_s * 1000.0
+                    ),
+                    "itl_max_ms": (
+                        None if itl_max_s is None else itl_max_s * 1000.0
+                    ),
+                    "itl_buckets": {
+                        str(i): n for i, n in (itl_buckets or {}).items()
+                    },
+                    "finish_reason": finish_reason,
+                    "ttft_attained": ttft_ok,
+                    "itl_attained": itl_ok,
+                    "goodput": good,
+                }
+            )
+        return ttft_ok, itl_ok, good
+
+    # ---- views (event loop) ----
+    def snapshot(self, include_timelines: bool = True) -> dict:
+        """JSON-ready replica view, served at ``/slo`` and merged by the
+        router into the fleet view (``/router/slo``)."""
+        with self._lock:
+            classes = {
+                cls: {
+                    "requests": st.requests,
+                    "ttft_attained": st.ttft_attained,
+                    "itl_attained": st.itl_attained,
+                    "goodput": st.goodput,
+                    "ttft_hist": st.ttft_hist.to_dict(),
+                    "itl_hist": st.itl_hist.to_dict(),
+                    "ttft_target_ms": self.ttft_targets.get(cls),
+                    "itl_target_ms": self.itl_targets.get(cls),
+                }
+                for cls, st in self.classes.items()
+            }
+            timelines = list(self.timelines) if include_timelines else None
+        out = {"version": 1, "classes": classes}
+        if timelines is not None:
+            out["timelines"] = timelines
+        return out
+
+
+def merge_class_views(views: list[dict]) -> dict:
+    """Fold N replica ``/slo`` class maps into one fleet view.  Pure
+    integer addition + histogram merges, so the result is bit-equal no
+    matter the merge order (the router's associativity contract).
+    Targets are taken from the first replica that declares them (the
+    fleet is expected to share one target config)."""
+    fleet: dict[str, dict] = {}
+    for view in views:
+        for cls, d in (view.get("classes") or {}).items():
+            agg = fleet.get(cls)
+            if agg is None:
+                agg = fleet[cls] = {
+                    "requests": 0,
+                    "ttft_attained": 0,
+                    "itl_attained": 0,
+                    "goodput": 0,
+                    "ttft_hist": LogBucketHistogram(),
+                    "itl_hist": LogBucketHistogram(),
+                    "ttft_target_ms": d.get("ttft_target_ms"),
+                    "itl_target_ms": d.get("itl_target_ms"),
+                }
+            for key in ("requests", "ttft_attained", "itl_attained", "goodput"):
+                agg[key] += int(d.get(key, 0))
+            agg["ttft_hist"] = agg["ttft_hist"].merge(
+                LogBucketHistogram.from_dict(d.get("ttft_hist") or {})
+            )
+            agg["itl_hist"] = agg["itl_hist"].merge(
+                LogBucketHistogram.from_dict(d.get("itl_hist") or {})
+            )
+            if agg["ttft_target_ms"] is None:
+                agg["ttft_target_ms"] = d.get("ttft_target_ms")
+            if agg["itl_target_ms"] is None:
+                agg["itl_target_ms"] = d.get("itl_target_ms")
+    out: dict[str, dict] = {}
+    for cls, agg in fleet.items():
+        requests = agg["requests"]
+        ttft_hist: LogBucketHistogram = agg["ttft_hist"]
+        itl_hist: LogBucketHistogram = agg["itl_hist"]
+        out[cls] = {
+            "requests": requests,
+            "ttft_attained": agg["ttft_attained"],
+            "itl_attained": agg["itl_attained"],
+            "goodput": agg["goodput"],
+            "goodput_ratio": (
+                agg["goodput"] / requests if requests else None
+            ),
+            "ttft_target_ms": agg["ttft_target_ms"],
+            "itl_target_ms": agg["itl_target_ms"],
+            "ttft_p50_ms": ttft_hist.percentile_ms(0.5),
+            "ttft_p99_ms": ttft_hist.percentile_ms(0.99),
+            "itl_p50_ms": itl_hist.percentile_ms(0.5),
+            "itl_p99_ms": itl_hist.percentile_ms(0.99),
+            "ttft_hist": ttft_hist.to_dict(),
+            "itl_hist": itl_hist.to_dict(),
+        }
+    return out

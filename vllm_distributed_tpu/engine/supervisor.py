@@ -324,6 +324,12 @@ class EngineSupervisor:
         self.recovering = True
         llm._phase = "recovering"
         metrics = llm.engine.metrics
+        # Flight-recorder artifact at the top of the cycle (ISSUE 12):
+        # the dying engine's last steps, before teardown discards them.
+        llm.engine.flight_recorder.dump(
+            "recovery",
+            extra=failure.to_dict() if failure is not None else None,
+        )
         t0 = time.monotonic()
         try:
             # Settle the event loop first: outputs dispatched before the

@@ -125,6 +125,20 @@ def make_parser() -> argparse.ArgumentParser:
         help="serve mode: per-request deadline sent with every request",
     )
     bench.add_argument(
+        "--slo-class",
+        dest="slo_classes",
+        action="append",
+        default=None,
+        metavar="NAME[:WEIGHT]",
+        help="serve mode: send requests under this SLO class "
+        "(repeatable; an integer weight sets the mix, e.g. "
+        "--slo-class interactive:3 --slo-class batch:1).  Client "
+        "TTFT/ITL p50/p99 are reported per class, plus per-class "
+        "goodput deltas scraped from the server's "
+        "vllm:goodput_requests_total counters — so scheduler changes "
+        "are judged on SLO attainment, not just tokens/s",
+    )
+    bench.add_argument(
         "--shared-prefix-len",
         type=int,
         default=0,
@@ -364,12 +378,30 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
     request_rate = getattr(args, "request_rate", None)
     counts = {"completed": 0, "rejected": 0, "timed_out": 0, "errors": 0}
 
-    async def scrape_metrics(session) -> dict:
+    # Per-class request mix (ISSUE 12): "name[:weight]" entries expand
+    # into a deterministic assignment pattern so the same command line
+    # always produces the same mix.
+    class_pattern: list[str] = []
+    for entry in getattr(args, "slo_classes", None) or ():
+        name, _, weight = entry.partition(":")
         try:
-            async with session.get(f"{url}/metrics") as r:
-                text = await r.text()
-        except Exception:  # noqa: BLE001 — metrics are optional
-            return {}
+            w = max(int(weight), 1) if weight else 1
+        except ValueError:
+            raise SystemExit(
+                f"--slo-class weight must be an integer: {entry!r}"
+            )
+        class_pattern.extend([name] * w)
+    per_class: dict[str, dict] = {
+        cls: {"ttfts": [], "itls": [], "completed": 0, "shed": 0}
+        for cls in class_pattern
+    }
+
+    def class_for(i: int) -> str | None:
+        if not class_pattern:
+            return None
+        return class_pattern[i % len(class_pattern)]
+
+    def parse_summed_metrics(text: str) -> dict:
         want = {
             "vllm:time_to_first_token_seconds_sum",
             "vllm:time_to_first_token_seconds_count",
@@ -396,6 +428,48 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                 out[key] = out.get(key, 0.0) + float(parts[1])
         return out
 
+    # Per-class server counters (ISSUE 12): deltas of the labeled SLO
+    # families over the run window give server-judged attainment; the
+    # merged router exposition sums replicas per class, which is what
+    # the fleet readout needs.
+    _SLO_FAMILIES = {
+        "vllm:slo_requests_total",
+        "vllm:goodput_requests_total",
+        "vllm:slo_ttft_attained_total",
+        "vllm:slo_itl_attained_total",
+    }
+
+    def parse_slo_metrics(text: str) -> dict:
+        import re
+
+        out: dict[str, dict[str, float]] = {}
+        for line in text.splitlines():
+            if line.startswith("#"):
+                continue
+            parts = line.split()
+            if len(parts) != 2 or parts[0].split("{")[0] not in _SLO_FAMILIES:
+                continue
+            m = re.search(r'slo_class="([^"]*)"', parts[0])
+            cls = m.group(1) if m else "default"
+            fam = out.setdefault(parts[0].split("{")[0], {})
+            fam[cls] = fam.get(cls, 0.0) + float(parts[1])
+        return out
+
+    async def scrape_metrics(session) -> tuple[dict, dict]:
+        """ONE /metrics fetch parsed for both the summed throughput
+        families and the per-class SLO families — a second fetch would
+        double the scrape load the bench puts on the server it is
+        measuring."""
+        try:
+            async with session.get(f"{url}/metrics") as r:
+                text = await r.text()
+        except Exception:  # noqa: BLE001 — metrics are optional
+            return {}, {}
+        return (
+            parse_summed_metrics(text),
+            parse_slo_metrics(text) if per_class else {},
+        )
+
     shared_prefix_len = getattr(args, "shared_prefix_len", 0) or 0
     shared_prefix = [(7 * j) % 900 + 1 for j in range(shared_prefix_len)]
 
@@ -418,6 +492,9 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
         }
         if getattr(args, "deadline_ms", None):
             body["deadline_ms"] = args.deadline_ms
+        slo_class = class_for(i)
+        if slo_class is not None:
+            body["slo_class"] = slo_class
         t0 = time.perf_counter()
         chunk_times: list[float] = []
         got_tokens = 0
@@ -470,17 +547,28 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
             # Deadline/pressure shed mid-generation: partial output —
             # keep it out of the completed-latency distribution too.
             counts["timed_out"] += 1
+            if slo_class is not None:
+                per_class[slo_class]["shed"] += 1
             return
         counts["completed"] += 1
+        if slo_class is not None:
+            per_class[slo_class]["completed"] += 1
         if chunk_times:
-            ttfts.append(chunk_times[0] - t0)
+            ttft = chunk_times[0] - t0
+            ttfts.append(ttft)
             out_tokens += got_tokens
+            itl = None
             if got_tokens > 1:
                 # Client-side per-token interval: tokens arrive in fused
                 # bursts, so spread the span over the tokens after the
                 # first (the serving ITL definition).
                 span = chunk_times[-1] - chunk_times[0]
-                itls.append(span / (got_tokens - 1))
+                itl = span / (got_tokens - 1)
+                itls.append(itl)
+            if slo_class is not None:
+                per_class[slo_class]["ttfts"].append(ttft)
+                if itl is not None:
+                    per_class[slo_class]["itls"].append(itl)
 
     async def one(session, i: int) -> None:
         if request_rate is not None:
@@ -494,7 +582,7 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
 
     timeout = aiohttp.ClientTimeout(total=None, sock_read=600)
     async with aiohttp.ClientSession(timeout=timeout) as session:
-        before = await scrape_metrics(session)
+        before, slo_before = await scrape_metrics(session)
         t0 = time.perf_counter()
         if request_rate is not None:
             import random
@@ -510,7 +598,7 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                 *(one(session, i) for i in range(args.num_prompts))
             )
         elapsed = time.perf_counter() - t0
-        after = await scrape_metrics(session)
+        after, slo_after = await scrape_metrics(session)
 
     result = {
         "mode": "serve",
@@ -537,6 +625,48 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
     if request_rate is not None:
         result["offered_rps"] = request_rate
         result["arrival_process"] = "poisson"
+    if per_class:
+        # Per-class attainment readout (ISSUE 12): client percentiles
+        # plus the server's own goodput judgment over the run window.
+        def slo_delta(family: str, cls: str) -> float:
+            return (slo_after.get(family) or {}).get(cls, 0.0) - (
+                slo_before.get(family) or {}
+            ).get(cls, 0.0)
+
+        result["per_class"] = {}
+        for cls, st in per_class.items():
+            entry: dict = {
+                "completed": st["completed"],
+                "shed": st["shed"],
+                "ttft_s": (
+                    _percentiles(st["ttfts"]) if st["ttfts"] else None
+                ),
+                "itl_ms": (
+                    {
+                        k: round(v * 1e3, 3)
+                        for k, v in _percentiles(st["itls"]).items()
+                    }
+                    if st["itls"]
+                    else None
+                ),
+            }
+            reqs = slo_delta("vllm:slo_requests_total", cls)
+            if reqs > 0:
+                entry["server_goodput"] = slo_delta(
+                    "vllm:goodput_requests_total", cls
+                )
+                entry["server_goodput_ratio"] = round(
+                    entry["server_goodput"] / reqs, 4
+                )
+                entry["server_ttft_attain_ratio"] = round(
+                    slo_delta("vllm:slo_ttft_attained_total", cls) / reqs,
+                    4,
+                )
+                entry["server_itl_attain_ratio"] = round(
+                    slo_delta("vllm:slo_itl_attained_total", cls) / reqs,
+                    4,
+                )
+            result["per_class"][cls] = entry
     if itls and request_rate is None:
         # The dispatch tax as the CLIENT sees it (ISSUE 7): throughput
         # implied by the p50 inter-token pace at this concurrency minus

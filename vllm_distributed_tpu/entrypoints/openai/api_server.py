@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import hmac
 import json
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -71,6 +72,12 @@ TRACE_HEADER = "X-VDT-Trace-Id"
 # arrival (the deadline_ms body field wins when both are present).
 DEADLINE_HEADER = "X-VDT-Deadline-Ms"
 
+# Request header naming the request's SLO class for goodput accounting
+# (ISSUE 12; the slo_class body field wins when both are present).
+# Sanitized and cardinality-bounded server-side (engine/slo.py) before
+# it becomes a metric label.
+SLO_CLASS_HEADER = "X-VDT-SLO-Class"
+
 # Stable identity of this serving replica (VDT_REPLICA_ID, default
 # host:port), stamped on every response so a router/bench/log reader can
 # attribute behavior per replica (ISSUE 10 satellite).
@@ -99,14 +106,16 @@ class ServerState:
 
 
 # Endpoints that stay open without an API key (probes + scrapers), the
-# same split vLLM's build_app auth middleware makes.
-_UNAUTHENTICATED = {"/health", "/ping", "/version", "/metrics"}
+# same split vLLM's build_app auth middleware makes.  /slo is a scraper
+# surface like /metrics (the router's fleet merge pulls it).
+_UNAUTHENTICATED = {"/health", "/ping", "/version", "/metrics", "/slo"}
 
 # Probe/scrape endpoints never open a root span (they would drown the
 # trace ring in noise and trace nothing request-shaped).  /drain can
 # block for the full drain timeout — a span that long is noise too.
 _UNTRACED = {
-    "/health", "/ping", "/version", "/metrics", "/debug/traces", "/drain",
+    "/health", "/ping", "/version", "/metrics", "/slo", "/debug/traces",
+    "/debug/flightrecorder", "/debug/profile", "/drain",
 }
 
 
@@ -246,6 +255,17 @@ def _apply_deadline(request: web.Request, params) -> web.Response | None:
         )
     params.deadline_ms = ms
     return None
+
+
+def _apply_slo_class(request: web.Request, req_model, params) -> None:
+    """Fold the X-VDT-SLO-Class header into the sampling params.  An
+    EXPLICIT body field wins (req_model.slo_class is None only when the
+    body omitted it, so a client naming "default" beats the header).
+    Never rejects: the class is telemetry, and engine/slo.py sanitizes
+    + bounds whatever arrives."""
+    header = request.headers.get(SLO_CLASS_HEADER)
+    if header and req_model.slo_class is None:
+        params.slo_class = header
 
 
 def _apply_chat_template(state: ServerState, req: ChatCompletionRequest) -> str:
@@ -462,6 +482,7 @@ async def chat_completions(request: web.Request) -> web.Response:
     err = _apply_deadline(request, params)
     if err is not None:
         return err
+    _apply_slo_class(request, req, params)
 
     # Admission pre-check (no reservation): overload rejects become
     # proper 429s HERE, before any SSE stream opens; generate() runs
@@ -726,6 +747,7 @@ async def completions(request: web.Request) -> web.Response:
     err = _apply_deadline(request, params)
     if err is not None:
         return err
+    _apply_slo_class(request, req, params)
 
     try:
         state.engine.check_admission(
@@ -929,11 +951,105 @@ async def _stream_completion(
 
 async def metrics(request: web.Request) -> web.Response:
     """Engine-loop Prometheus instruments (TTFT/ITL/throughput/queues —
-    the reference serves vLLM's via build_app, launch.py:429-432)."""
+    the reference serves vLLM's via build_app, launch.py:429-432).
+    Each scrape also pulls the worker-side XLA/HBM telemetry snapshot
+    (ISSUE 12) so compile counters and memory gauges stay current in
+    steady state — best-effort: a dead/recovering engine just serves
+    the previous values."""
     state: ServerState = request.app["state"]
+    try:
+        await state.engine.refresh_device_telemetry()
+    except Exception as e:  # noqa: BLE001 — scrape must answer anyway
+        logger.debug("device-telemetry refresh failed: %s", e)
     return web.Response(
         body=state.engine.metrics.render(), content_type="text/plain"
     )
+
+
+async def slo(request: web.Request) -> web.Response:
+    """Per-class SLO/goodput view (ISSUE 12, engine/slo.py): attainment
+    counters, mergeable log-bucket TTFT/ITL histograms, and the bounded
+    ring of raw per-request timelines.  The router's /router/slo merges
+    N replicas' views associatively into the fleet picture; the
+    ``timelines`` ring is what the merge is bit-recomputable from
+    (``?timelines=0`` omits it for cheap scrapes)."""
+    state: ServerState = request.app["state"]
+    include = request.query.get("timelines", "1") not in ("0", "false")
+    snap = state.engine.metrics.slo_snapshot(include_timelines=include)
+    if snap is None:
+        return _error(
+            "SLO accounting disabled (--disable-log-stats)", 404
+        )
+    if state.replica_id:
+        snap["replica_id"] = state.replica_id
+    return web.json_response(snap)
+
+
+async def debug_flightrecorder(request: web.Request) -> web.Response:
+    """The engine flight recorder's bounded per-step ring (ISSUE 12),
+    on demand.  ``?dump=1`` also writes the JSON artifact (same format
+    as the automatic HostFailure/recovery/drain dumps) and returns its
+    path."""
+    state: ServerState = request.app["state"]
+    recorder = state.engine.engine.flight_recorder
+    if not recorder.enabled:
+        return _error(
+            "flight recorder disabled (VDT_FLIGHT_RECORDER_SIZE=0)", 404
+        )
+    body = recorder.snapshot()
+    if request.query.get("dump") in ("1", "true"):
+        body["path"] = recorder.dump("on_demand")
+    return web.json_response(body)
+
+
+async def debug_profile(request: web.Request) -> web.Response:
+    """Gated server-side jax.profiler capture (ISSUE 12):
+    ``POST /debug/profile?seconds=N`` records a trace into the
+    configured profile directory (--profile-dir / VDT_PROFILE_DIR) and
+    returns the artifact path.  404 while unconfigured — profiling is
+    an operator opt-in, like /debug/traces.  One capture at a time."""
+    state: ServerState = request.app["state"]
+    profile_dir = (
+        state.engine.config.observability_config.profile_dir
+    )
+    if not profile_dir:
+        return _error(
+            "profiling disabled: start with --profile-dir (or "
+            "VDT_PROFILE_DIR) to enable POST /debug/profile",
+            404,
+        )
+    try:
+        seconds = float(request.query.get("seconds", "1"))
+    except ValueError:
+        return _error("seconds must be a number")
+    if not 0 < seconds <= 120:
+        return _error("seconds must be in (0, 120]")
+    if request.app.get("_profiling"):
+        return _error("a profile capture is already running", 409)
+    path = os.path.join(
+        profile_dir, f"profile-{int(time.time() * 1000)}"
+    )
+
+    def capture() -> None:
+        # Runs on an executor thread: the sleep must not block the
+        # event loop for the capture window.
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.profiler.start_trace(path)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+
+    request.app["_profiling"] = True
+    try:
+        await asyncio.get_running_loop().run_in_executor(None, capture)
+    except Exception as e:  # noqa: BLE001 — surface, don't 500-crash
+        return _error(f"profile capture failed: {e}", 503)
+    finally:
+        request.app["_profiling"] = False
+    return web.json_response({"path": path, "seconds": seconds})
 
 
 async def debug_traces(request: web.Request) -> web.Response:
@@ -1133,6 +1249,7 @@ async def internal_resume(request: web.Request) -> web.Response:
     err = _apply_deadline(request, params)
     if err is not None:
         return err
+    _apply_slo_class(request, req, params)
     engine.register_resumable(
         JournalEntry(
             request_id=rid,
@@ -1216,7 +1333,10 @@ def build_app(state: ServerState) -> web.Application:
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/embeddings", embeddings)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/slo", slo)
     app.router.add_get("/debug/traces", debug_traces)
+    app.router.add_get("/debug/flightrecorder", debug_flightrecorder)
+    app.router.add_post("/debug/profile", debug_profile)
     app.router.add_post("/internal/resume", internal_resume)
     return app
 

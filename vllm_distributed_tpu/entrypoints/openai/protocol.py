@@ -69,6 +69,11 @@ class _SamplingFields(BaseModel):
     # header sets it too; an explicit body field wins).  None = server
     # default.
     deadline_ms: int | None = None
+    # SLO class for goodput accounting (ISSUE 12; the X-VDT-SLO-Class
+    # header sets it too; an explicit body field wins — None means
+    # "not sent", so a client explicitly naming "default" still beats
+    # the header).  Sanitized and cardinality-bounded server-side.
+    slo_class: str | None = None
 
     def to_sampling_params(
         self, default_max_tokens: int, is_chat: bool
@@ -110,6 +115,7 @@ class _SamplingFields(BaseModel):
             ignore_eos=self.ignore_eos,
             include_stop_str_in_output=self.include_stop_str_in_output,
             deadline_ms=self.deadline_ms,
+            slo_class=self.slo_class or "default",
         )
 
 

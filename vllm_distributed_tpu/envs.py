@@ -212,6 +212,26 @@ environment_variables: dict[str, Callable[[], Any]] = {
         os.environ.get("VDT_ROUTER_READ_TIMEOUT_SECONDS", "600")
     ),
     # --- observability ---
+    # SLO targets for goodput accounting (engine/slo.py, ISSUE 12), in
+    # milliseconds.  A bare number sets the "default" class; per-class:
+    # "default:500,interactive:200,batch:5000".  Empty = no targets
+    # (every class attains trivially; goodput == completed requests).
+    "VDT_SLO_TTFT_MS": lambda: os.environ.get("VDT_SLO_TTFT_MS", ""),
+    "VDT_SLO_ITL_MS": lambda: os.environ.get("VDT_SLO_ITL_MS", ""),
+    # Flight recorder (engine/flight_recorder.py): per-step records
+    # kept in the always-on ring (0 disables), and where the JSON
+    # artifacts land on HostFailure/recovery/drain (per-host; empty =
+    # <tmpdir>/vdt-flightrecorder).
+    "VDT_FLIGHT_RECORDER_SIZE": lambda: int(
+        os.environ.get("VDT_FLIGHT_RECORDER_SIZE", "512")
+    ),
+    "VDT_FLIGHT_RECORDER_DIR": lambda: os.environ.get(
+        "VDT_FLIGHT_RECORDER_DIR", ""
+    ),
+    # Server-side jax.profiler captures (POST /debug/profile): artifact
+    # directory; empty disables the endpoint (404).  --profile-dir
+    # wins.  Per-host: a profile is local state like a drain journal.
+    "VDT_PROFILE_DIR": lambda: os.environ.get("VDT_PROFILE_DIR", ""),
     # Per-request tracing (tracing.py): default off; the engine step
     # loop runs the no-op tracer path and /debug/traces answers 404.
     # Replicated to agents so worker-side RPC spans land in the same
@@ -298,6 +318,10 @@ NON_REPLICATED_ENV_VARS = {
     # onto remote workers would have every host writing (and on boot,
     # consuming) the same file.
     "VDT_DRAIN_JOURNAL_PATH",
+    # Flight-recorder artifacts and profiler captures are local state
+    # for the same reason (and workers run no engine loop to record).
+    "VDT_FLIGHT_RECORDER_DIR",
+    "VDT_PROFILE_DIR",
     # Replica identity and router knobs are per-process: replicating a
     # replica's id onto its workers (or a router's backend set onto
     # anything) would be meaningless at best and confusing in logs.

@@ -54,6 +54,24 @@ _STEP_BUCKETS = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
 )
+# Per-SLO-class latency histograms (ISSUE 12), in MILLISECONDS to match
+# the VDT_SLO_*_MS target units.  Coarser than the engine's log-bucket
+# histograms (engine/slo.py) — the fleet-exact merge runs over those;
+# these exist so ordinary Prometheus dashboards get per-class curves.
+_SLO_TTFT_MS_BUCKETS = (
+    1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+    2500.0, 5000.0, 10000.0, 30000.0, 60000.0, 120000.0,
+)
+_SLO_ITL_MS_BUCKETS = (
+    0.5, 1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0,
+)
+# XLA compile wall time (trace+lower+compile+first run): sub-second on
+# warm AOT/disk caches, tens of seconds cold on a pod slice.
+_COMPILE_BUCKETS = (
+    0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 40.0,
+    80.0, 160.0, 320.0,
+)
 
 # Span name (tracing.py) -> per-stage histogram attribute.  The tracer's
 # metrics sink feeds these, so the Prometheus histograms and the traces
@@ -104,6 +122,18 @@ DOCUMENTED_METRICS = (
     "vllm:engine_restarts_total",
     "vllm:requests_replayed_total",
     "vllm:engine_recovery_seconds",
+    # ---- SLO/goodput accounting (ISSUE 12) ----
+    "vllm:slo_requests_total",
+    "vllm:slo_ttft_attained_total",
+    "vllm:slo_itl_attained_total",
+    "vllm:goodput_requests_total",
+    "vllm:slo_ttft_ms",
+    "vllm:slo_itl_ms",
+    # ---- XLA/device telemetry (ISSUE 12) ----
+    "vllm:xla_compiles_total",
+    "vllm:xla_compile_seconds",
+    "vllm:hbm_live_bytes",
+    "vllm:step_roofline_frac",
 )
 
 
@@ -330,6 +360,84 @@ class EngineMetrics:
             "recovery cycle",
             _RECOVERY_BUCKETS,
         )
+        # ---- SLO/goodput accounting (ISSUE 12).  slo_class is a
+        # BOUNDED label: SloAccounting sanitizes and caps the class set
+        # (overflow folds into "other"), so client-controlled names can
+        # never explode series cardinality (vdt-lint VDT009).
+        self._slo_requests = Counter(
+            "vllm:slo_requests",
+            "Finished requests per SLO class (attainment denominator)",
+            ["model_name", "slo_class"],
+            registry=self.registry,
+        )
+        self._slo_ttft_attained = Counter(
+            "vllm:slo_ttft_attained",
+            "Finished requests whose TTFT met the class target "
+            "(VDT_SLO_TTFT_MS; no target = trivially attained)",
+            ["model_name", "slo_class"],
+            registry=self.registry,
+        )
+        self._slo_itl_attained = Counter(
+            "vllm:slo_itl_attained",
+            "Finished requests whose WORST inter-token latency met the "
+            "class target (VDT_SLO_ITL_MS; no target or single-token "
+            "output = trivially attained)",
+            ["model_name", "slo_class"],
+            registry=self.registry,
+        )
+        self._goodput_requests = Counter(
+            "vllm:goodput_requests",
+            "DistServe goodput: requests that completed (stop/length) "
+            "within BOTH their TTFT and ITL SLO targets",
+            ["model_name", "slo_class"],
+            registry=self.registry,
+        )
+        self._slo_ttft_ms = Histogram(
+            "vllm:slo_ttft_ms",
+            "TTFT per SLO class, milliseconds (per-class dashboard "
+            "view; the fleet-exact merge runs over the /slo log-bucket "
+            "histograms)",
+            ["model_name", "slo_class"],
+            buckets=_SLO_TTFT_MS_BUCKETS,
+            registry=self.registry,
+        )
+        self._slo_itl_ms = Histogram(
+            "vllm:slo_itl_ms",
+            "Inter-token latency per SLO class, milliseconds",
+            ["model_name", "slo_class"],
+            buckets=_SLO_ITL_MS_BUCKETS,
+            registry=self.registry,
+        )
+        # ---- XLA/device telemetry (ISSUE 12), fed by the driver's
+        # pull of worker DeviceTelemetry snapshots (one representative
+        # host: the executor's reply rank).
+        self._xla_compiles = Counter(
+            "vllm:xla_compiles",
+            "jit compiles observed on the reply-rank worker, by the "
+            "triggering bucket-shape kind (prefill | decode | spec); a "
+            "climbing counter in steady state is a recompile storm",
+            ["model_name", "kind"],
+            registry=self.registry,
+        )
+        self.xla_compile_seconds = histogram(
+            "vllm:xla_compile_seconds",
+            "Wall time of each observed jit compile "
+            "(trace+lower+compile+first run)",
+            _COMPILE_BUCKETS,
+        )
+        self.hbm_live_bytes = gauge(
+            "vllm:hbm_live_bytes",
+            "Live HBM bytes on the reply-rank worker's first device "
+            "(memory creep is a gauge, not an OOM post-mortem)",
+        )
+        self.step_roofline_frac = gauge(
+            "vllm:step_roofline_frac",
+            "Last step's estimated bytes-touched/second over the "
+            "device's peak HBM bandwidth (0 when unknown)",
+        )
+        from vllm_distributed_tpu.engine.slo import SloAccounting
+
+        self.slo = SloAccounting()
         self._dead_labels: tuple[str, str] | None = None
         self._model_name = model_name
 
@@ -393,6 +501,14 @@ class EngineMetrics:
         if self.enabled:
             self.spec_acceptance_length.observe(num_emitted)
 
+    def _slo_class(self, req_metrics) -> str:
+        """Resolve (and cache) the request's bounded SLO-class label."""
+        cls = req_metrics.slo_class_resolved
+        if cls is None:
+            cls = self.slo.resolve(req_metrics.slo_class)
+            req_metrics.slo_class_resolved = cls
+        return cls
+
     def record_new_tokens(self, req_metrics, n: int, now: float | None = None) -> None:
         """n new tokens for one request: TTFT on the first, ITL after.
         ``now`` and every interval endpoint are MONOTONIC clock reads
@@ -402,13 +518,19 @@ class EngineMetrics:
             return
         now = now if now is not None else time.monotonic()
         self.generation_tokens.inc(n)
+        cls = self._slo_class(req_metrics)
         last = req_metrics.last_token_time_mono
         if req_metrics.first_token_time_mono is not None and last is None:
             # first batch of tokens for this request
-            self.ttft.observe(
+            ttft = (
                 req_metrics.first_token_time_mono
                 - req_metrics.arrival_time_mono
             )
+            self.ttft.observe(ttft)
+            self.slo.record_ttft(cls, ttft)
+            self._slo_ttft_ms.labels(
+                model_name=self._model_name, slo_class=cls
+            ).observe(max(ttft, 0.0) * 1000.0)
             n_after_first = n - 1
             # A fused dispatch can deliver the first token WITH its
             # successors: their intervals start at the first token.
@@ -419,6 +541,24 @@ class EngineMetrics:
             per_tok = max(now - last, 0.0) / n_after_first
             for _ in range(n_after_first):
                 self.itl.observe(per_tok)
+            # SLO accounting (ISSUE 12): class histogram + the request's
+            # own per-bucket tally (what the fleet merge is recomputable
+            # from) + worst-interval tracking for the ITL attainment.
+            idx = self.slo.record_itl(cls, per_tok, n_after_first)
+            buckets = req_metrics.slo_itl_buckets
+            if buckets is None:
+                buckets = req_metrics.slo_itl_buckets = {}
+            buckets[idx] = buckets.get(idx, 0) + n_after_first
+            if (
+                req_metrics.slo_itl_max_s is None
+                or per_tok > req_metrics.slo_itl_max_s
+            ):
+                req_metrics.slo_itl_max_s = per_tok
+            itl_ms = self._slo_itl_ms.labels(
+                model_name=self._model_name, slo_class=cls
+            )
+            for _ in range(n_after_first):
+                itl_ms.observe(per_tok * 1000.0)
         req_metrics.last_token_time_mono = now
 
     def record_replica_info(self, replica_id: str) -> None:
@@ -496,6 +636,56 @@ class EngineMetrics:
         self._success.labels(
             model_name=self._model_name, finished_reason=reason or "unknown"
         ).inc()
+        # SLO/goodput accounting (ISSUE 12): attainment of this
+        # request's class targets, from the same monotonic stamps.
+        cls = self._slo_class(req_metrics)
+        ttft_s = req_metrics.ttft
+        ttft_ok, itl_ok, good = self.slo.record_finished(
+            cls,
+            ttft_s,
+            req_metrics.slo_itl_max_s,
+            req_metrics.slo_itl_buckets,
+            reason,
+        )
+        labels = dict(model_name=self._model_name, slo_class=cls)
+        self._slo_requests.labels(**labels).inc()
+        if ttft_ok:
+            self._slo_ttft_attained.labels(**labels).inc()
+        if itl_ok:
+            self._slo_itl_attained.labels(**labels).inc()
+        if good:
+            self._goodput_requests.labels(**labels).inc()
+
+    # ---- XLA/device telemetry hooks (ISSUE 12), fed by
+    # LLMEngine.refresh_device_telemetry from worker snapshots ----
+    def record_xla_compiles(self, kind: str, n: int) -> None:
+        """Counter fed from CUMULATIVE per-kind worker totals (delta
+        computed by the engine), so a recompile storm that overflows
+        the bounded event ring between scrapes still counts exactly."""
+        if self.enabled and n > 0:
+            self._xla_compiles.labels(
+                model_name=self._model_name, kind=kind
+            ).inc(n)
+
+    def record_xla_compile_seconds(self, seconds: float) -> None:
+        """Histogram fed from individual timed events (best-effort: the
+        event ring is bounded, the counter above is the exact tally)."""
+        if self.enabled:
+            self.xla_compile_seconds.observe(max(seconds, 0.0))
+
+    def record_device_snapshot(self, snap: dict) -> None:
+        """Gauges from one worker DeviceTelemetry snapshot (compile
+        events are folded separately so each is counted exactly once)."""
+        if not self.enabled:
+            return
+        self.hbm_live_bytes.set(snap.get("hbm_live_bytes", 0) or 0)
+        self.step_roofline_frac.set(snap.get("roofline_frac", 0.0) or 0.0)
+
+    def slo_snapshot(self, include_timelines: bool = True) -> dict | None:
+        """Replica /slo view (None while metrics are disabled)."""
+        if not self.enabled:
+            return None
+        return self.slo.snapshot(include_timelines=include_timelines)
 
     def observe_span(self, name: str, duration: float) -> None:
         """Tracer metrics sink (tracing.Tracer.set_metrics_sink): every

@@ -81,6 +81,16 @@ class RequestMetrics:
     # Tokens served from the prefix cache at the last admission
     # (0 unless --enable-prefix-caching hit; RequestOutput-visible).
     cached_tokens: int = 0
+    # ---- SLO accounting (ISSUE 12, engine/slo.py) ----
+    # Raw client-supplied class (slo_class sampling param / header) and
+    # its sanitized, cardinality-bounded form (cached by EngineMetrics).
+    slo_class: str = "default"
+    slo_class_resolved: str | None = None
+    # Worst observed inter-token interval (monotonic), and the
+    # request's own log-bucket ITL tally — the per-request timeline the
+    # fleet histogram merge is bit-recomputable from.
+    slo_itl_max_s: float | None = None
+    slo_itl_buckets: dict[int, int] | None = None
 
     @property
     def ttft(self) -> float | None:

@@ -56,6 +56,7 @@ logger = init_logger(__name__)
 # stack just for four strings).
 TRACE_HEADER = "X-VDT-Trace-Id"
 DEADLINE_HEADER = "X-VDT-Deadline-Ms"
+SLO_CLASS_HEADER = "X-VDT-SLO-Class"
 REPLICA_HEADER = "X-VDT-Replica-Id"
 ROUTER_HEADER = "X-VDT-Router"
 
@@ -189,6 +190,9 @@ def _forward_headers(request: web.Request, trace_ctx) -> dict[str, str]:
     deadline = request.headers.get(DEADLINE_HEADER)
     if deadline:
         headers[DEADLINE_HEADER] = deadline
+    slo_class = request.headers.get(SLO_CLASS_HEADER)
+    if slo_class:
+        headers[SLO_CLASS_HEADER] = slo_class
     if trace_ctx is not None:
         headers[TRACE_HEADER] = f"{trace_ctx[0]}-{trace_ctx[1]}"
     return headers
@@ -922,8 +926,25 @@ async def metrics(request: web.Request) -> web.Response:
         except Exception:  # noqa: BLE001 — a dead replica just drops out of the aggregate
             return None
 
-    parts = await asyncio.wait_for(
-        asyncio.gather(*(scrape(r) for r in state.pool.replicas)),
+    # Refresh the fleet per-class goodput gauges (ISSUE 12) so one
+    # scrape of the router carries both the per-replica families and
+    # the merged vdt_router:fleet_* series the autoscaler wants.  The
+    # /slo sweep runs CONCURRENTLY with the /metrics sweep — the two
+    # are independent, and serializing them would double scrape latency
+    # behind one slow replica.
+    async def fleet_refresh() -> None:
+        try:
+            await _fleet_slo(state)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — gauges are best-effort
+            logger.debug("fleet SLO refresh failed: %s", e)
+
+    parts, _ = await asyncio.wait_for(
+        asyncio.gather(
+            asyncio.gather(*(scrape(r) for r in state.pool.replicas)),
+            fleet_refresh(),
+        ),
         timeout=15,
     )
     merged = merge_expositions([p for p in parts if p is not None])
@@ -931,6 +952,54 @@ async def metrics(request: web.Request) -> web.Response:
     return web.Response(
         text=merged + own, content_type="text/plain"
     )
+
+
+async def _fleet_slo(state: RouterState) -> dict:
+    """Scrape every routable replica's /slo and fold the per-class
+    views into the fleet picture (ISSUE 12).  The merge is pure integer
+    addition over log-bucket histograms (engine/slo.py), so the result
+    is bit-equal to recomputing from the union of the replicas' raw
+    timelines regardless of scrape order.  Also refreshes the
+    vdt_router:fleet_* gauges — the exact series the autoscaler
+    (ROADMAP item 5) scrapes."""
+    import aiohttp
+
+    from vllm_distributed_tpu.engine.slo import merge_class_views
+
+    timeout = aiohttp.ClientTimeout(total=10, connect=state.connect_timeout)
+
+    async def scrape(replica: Replica) -> tuple[str, dict] | None:
+        try:
+            async with state.session.get(
+                f"{replica.url}/slo?timelines=0", timeout=timeout
+            ) as resp:
+                if resp.status != 200:
+                    return None
+                return (replica.replica_id, await resp.json())
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — a dead replica drops out of the merge
+            return None
+
+    parts = await asyncio.wait_for(
+        asyncio.gather(*(scrape(r) for r in state.pool.replicas)),
+        timeout=15,
+    )
+    views = [p for p in parts if p is not None]
+    classes = merge_class_views([v for _, v in views])
+    state.metrics.update_fleet_slo(classes)
+    return {
+        "classes": classes,
+        "replicas_merged": [rid for rid, _ in views],
+    }
+
+
+async def router_slo(request: web.Request) -> web.Response:
+    """Fleet per-class SLO/goodput (ISSUE 12): merged histograms,
+    attainment counts, goodput ratios, and p50/p99 from the merged
+    log-bucket histograms."""
+    state: RouterState = request.app["router_state"]
+    return web.json_response(await _fleet_slo(state))
 
 
 async def router_state(request: web.Request) -> web.Response:
@@ -1018,6 +1087,7 @@ def build_router_app(state: RouterState) -> web.Application:
     app.router.add_get("/version", version)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/router/state", router_state)
+    app.router.add_get("/router/slo", router_slo)
     app.router.add_get("/v1/models", list_models)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat_completions)

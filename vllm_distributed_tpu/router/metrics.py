@@ -126,6 +126,42 @@ class RouterMetrics:
             ["replica_id"],
             registry=self.registry,
         )
+        # ---- fleet SLO/goodput (ISSUE 12): per-class gauges refreshed
+        # from the associative merge of replica /slo views — the exact
+        # series the autoscaler (ROADMAP item 5) scrapes.  slo_class is
+        # a bounded label (sanitized + capped replica-side, VDT009).
+        self._fleet_requests = Gauge(
+            "vdt_router:fleet_slo_requests",
+            "Fleet finished requests per SLO class (merged)",
+            ["slo_class"],
+            registry=self.registry,
+        )
+        self._fleet_goodput = Gauge(
+            "vdt_router:fleet_goodput_requests",
+            "Fleet goodput per SLO class: requests completed within "
+            "both TTFT and ITL targets (merged)",
+            ["slo_class"],
+            registry=self.registry,
+        )
+        self._fleet_goodput_ratio = Gauge(
+            "vdt_router:fleet_goodput_ratio",
+            "Fleet goodput / finished requests per SLO class",
+            ["slo_class"],
+            registry=self.registry,
+        )
+        self._fleet_ttft_p99 = Gauge(
+            "vdt_router:fleet_ttft_p99_ms",
+            "Fleet p99 TTFT per SLO class from the merged log-bucket "
+            "histograms (bucket-representative value)",
+            ["slo_class"],
+            registry=self.registry,
+        )
+        self._fleet_itl_p99 = Gauge(
+            "vdt_router:fleet_itl_p99_ms",
+            "Fleet p99 inter-token latency per SLO class (merged)",
+            ["slo_class"],
+            registry=self.registry,
+        )
 
     def record_request(self, kind: str, outcome: str) -> None:
         self.counts[f"requests.{kind}.{outcome}"] += 1
@@ -141,6 +177,33 @@ class RouterMetrics:
         self.counts[f"placements.{policy}"] += 1
         if self.enabled:
             self._placements.labels(policy=policy).inc()
+
+    def update_fleet_slo(self, classes: dict) -> None:
+        """Refresh the fleet per-class gauges from one merged view
+        (engine/slo.py merge_class_views output).  Mirrored into
+        ``counts`` like everything else so tests and /router/state can
+        read it without prometheus_client."""
+        for cls, d in classes.items():
+            self.counts[f"fleet.{cls}.requests"] = d.get("requests", 0)
+            self.counts[f"fleet.{cls}.goodput"] = d.get("goodput", 0)
+            if not self.enabled:
+                continue
+            self._fleet_requests.labels(slo_class=cls).set(
+                d.get("requests", 0)
+            )
+            self._fleet_goodput.labels(slo_class=cls).set(
+                d.get("goodput", 0)
+            )
+            ratio = d.get("goodput_ratio")
+            if ratio is not None:
+                self._fleet_goodput_ratio.labels(slo_class=cls).set(ratio)
+            for gauge, key in (
+                (self._fleet_ttft_p99, "ttft_p99_ms"),
+                (self._fleet_itl_p99, "itl_p99_ms"),
+            ):
+                value = d.get(key)
+                if value is not None:
+                    gauge.labels(slo_class=cls).set(value)
 
     def update_replicas(self, pool) -> None:
         if not self.enabled:

@@ -39,6 +39,12 @@ class SamplingParams:
     # is shed before prefill; an expired running request finishes with
     # finish_reason="timeout" and partial output.
     deadline_ms: int | None = None
+    # SLO class for goodput accounting (ISSUE 12; slo_class body field /
+    # X-VDT-SLO-Class header).  Keys the per-class attainment counters
+    # and log-bucket histograms against the VDT_SLO_TTFT_MS /
+    # VDT_SLO_ITL_MS targets; sanitized and cardinality-bounded by
+    # engine/slo.py before it becomes a metric label.
+    slo_class: str = "default"
 
     def __post_init__(self) -> None:
         if self.temperature < 0.0:
@@ -82,4 +88,5 @@ class SamplingParams:
             detokenize=self.detokenize,
             include_stop_str_in_output=self.include_stop_str_in_output,
             deadline_ms=self.deadline_ms,
+            slo_class=self.slo_class,
         )

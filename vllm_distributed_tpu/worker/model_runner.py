@@ -20,6 +20,7 @@ reference gets by shipping SchedulerOutput, not tensors (SURVEY.md §2.5).
 from __future__ import annotations
 
 import hashlib
+import time
 import zlib
 from dataclasses import dataclass, field
 from functools import partial
@@ -47,6 +48,10 @@ from vllm_distributed_tpu.ops.sampling import (
 from vllm_distributed_tpu.outputs import ModelRunnerOutput
 from vllm_distributed_tpu.sampling_params import SamplingParams
 from vllm_distributed_tpu.utils import cdiv, next_power_of_2
+from vllm_distributed_tpu.worker.telemetry import (
+    DeviceTelemetry,
+    peak_hbm_bandwidth,
+)
 
 logger = init_logger(__name__)
 
@@ -143,6 +148,14 @@ class ModelRunner:
         from vllm_distributed_tpu.worker.aot_cache import AotCache
 
         self._aot = AotCache(None)  # armed in load_model (single-chip)
+        # XLA/device telemetry (ISSUE 12): every first execution of a
+        # distinct (kind, statics) shape key is counted and timed as a
+        # compile; per-step achieved-vs-roofline bandwidth rides along.
+        self.telemetry = DeviceTelemetry()
+        self._compiled_keys: set[str] = set()
+        self._param_bytes = 0
+        self._kv_token_bytes = 0
+        self._peak_bw = 0.0
 
     # ---- lifecycle (the collective_rpc verbs, launch.py:290-292) ----
     def load_model(self, load_format: str = "auto") -> None:
@@ -240,6 +253,67 @@ class ModelRunner:
             envs.VDT_COMPILE_CACHE_DIR if use_aot else None,
             context=context,
         )
+        # Device-telemetry constants (ISSUE 12): resident param bytes
+        # and per-KV-token bytes for the step roofline estimate, peak
+        # HBM bandwidth from the device kind.  All best-effort — the
+        # gauges degrade to 0, never fail the load.
+        try:
+            self._param_bytes = sum(
+                int(x.size) * x.dtype.itemsize
+                for x in jax.tree_util.tree_leaves(self.params)
+                if hasattr(x, "size") and hasattr(x, "dtype")
+            )
+        except Exception:  # noqa: BLE001 — telemetry only
+            self._param_bytes = 0
+        kv_itemsize = (
+            1
+            if self.config.cache_config.cache_dtype == "int8"
+            else (4 if mc.dtype == "float32" else 2)
+        )
+        try:
+            self._kv_token_bytes = (
+                mc.get_num_layers()
+                * 2  # K and V
+                * mc.get_num_kv_heads()
+                * mc.get_head_dim()
+                * kv_itemsize
+            )
+        except Exception:  # noqa: BLE001 — telemetry only
+            self._kv_token_bytes = 0
+        try:
+            self._peak_bw = peak_hbm_bandwidth(
+                getattr(jax.local_devices()[0], "device_kind", "")
+            )
+        except Exception:  # noqa: BLE001 — telemetry only
+            self._peak_bw = 0.0
+
+    # ---- device telemetry helpers (ISSUE 12) ----
+    def _observed_call(self, kind: str, shape_key: str, fn):
+        """Run one jitted step program.  The FIRST execution of each
+        distinct (kind, shape) key is timed and recorded as an XLA
+        compile (trace+lower+compile dominate that call); later calls
+        are passthrough.  AOT-cache hits still count: a warm artifact
+        load is exactly the stall class the counter tracks, just
+        cheaper — the histogram shows the difference."""
+        key = f"{kind}:{shape_key}"
+        if key in self._compiled_keys:
+            return fn()
+        t0 = time.perf_counter()
+        out = fn()
+        self._compiled_keys.add(key)
+        self.telemetry.record_compile(kind, time.perf_counter() - t0, key)
+        return out
+
+    def _record_step_bw(
+        self, seconds: float, kv_tokens: int, passes: int = 1
+    ) -> None:
+        """Achieved-vs-roofline gauge: weights + live-KV bytes per HBM
+        pass over the measured step wall time (an estimate — exact DMA
+        accounting would need a profiler, which /debug/profile is for)."""
+        est = passes * (
+            self._param_bytes + self._kv_token_bytes * max(kv_tokens, 0)
+        )
+        self.telemetry.record_step(seconds, int(est), self._peak_bw)
 
     def _shard_kernels(self) -> None:
         """Partition the Pallas kernels over the mesh "tp" axis.
@@ -953,21 +1027,27 @@ class ModelRunner:
                     packed, NamedSharding(self.mesh, P())
                 )
             statics = dict(spec=pack_spec, max_q_pad=max_q_pad, **flags)
-            if self._aot.enabled:
-                sampled, logprobs, self.kv_caches = self._aot.call(
-                    f"step:{sorted(statics.items())}",
-                    partial(
-                        type(self)._jit_step_packed.__wrapped__,
-                        self,
-                        **statics,
-                    ),
-                    (self.params, self.kv_caches, packed),
-                    donate_args=(1,),
-                )
-            else:
-                sampled, logprobs, self.kv_caches = self._jit_step_packed(
+
+            def _run_step():
+                if self._aot.enabled:
+                    return self._aot.call(
+                        f"step:{sorted(statics.items())}",
+                        partial(
+                            type(self)._jit_step_packed.__wrapped__,
+                            self,
+                            **statics,
+                        ),
+                        (self.params, self.kv_caches, packed),
+                        donate_args=(1,),
+                    )
+                return self._jit_step_packed(
                     self.params, self.kv_caches, packed, **statics
                 )
+
+            t_step0 = time.perf_counter()
+            sampled, logprobs, self.kv_caches = self._observed_call(
+                "prefill", f"{sorted(statics.items())}", _run_step
+            )
         else:
             meta = AttentionMetadata(
                 q_seq_ids=jnp.asarray(seq_ids),
@@ -984,14 +1064,20 @@ class ModelRunner:
             token_ids = jax.device_put(token_ids, spec)
             meta = jax.tree.map(lambda x: jax.device_put(x, spec), meta)
             smeta = jax.tree.map(lambda x: jax.device_put(x, spec), smeta)
-            sampled, logprobs, self.kv_caches = self._jit_step(
-                self.params,
-                self.kv_caches,
-                token_ids,
-                meta,
-                smeta,
-                max_q_pad=max_q_pad,
-                **flags,
+            t_step0 = time.perf_counter()
+            sampled, logprobs, self.kv_caches = self._observed_call(
+                "prefill",
+                f"t={t_pad},s={s_pad},p={pages_pad},q={max_q_pad},"
+                f"{sorted(flags.items())}",
+                lambda: self._jit_step(
+                    self.params,
+                    self.kv_caches,
+                    token_ids,
+                    meta,
+                    smeta,
+                    max_q_pad=max_q_pad,
+                    **flags,
+                ),
             )
 
         if logprobs is not None:
@@ -1000,6 +1086,9 @@ class ModelRunner:
             logprobs = np.asarray(logprobs)
         else:
             sampled = np.asarray(jax.device_get(sampled))
+        self._record_step_bw(
+            time.perf_counter() - t_step0, int(seq_lens.sum())
+        )
 
         out = ModelRunnerOutput()
         for s, (state, n) in enumerate(zip(states, num_new)):
@@ -1313,20 +1402,33 @@ class ModelRunner:
         if self.mesh is not None:
             packed = jax.device_put(packed, NamedSharding(self.mesh, P()))
         statics = dict(spec=pack_spec, max_q_pad=max_q_pad)
-        if self._aot.enabled:
-            toks, n_emit, self.kv_caches = self._aot.call(
-                f"spec_step:{sorted(statics.items())}",
-                partial(
-                    type(self)._jit_spec_step.__wrapped__, self, **statics
-                ),
-                (self.params, self.kv_caches, packed),
-                donate_args=(1,),
-            )
-        else:
-            toks, n_emit, self.kv_caches = self._jit_spec_step(
+
+        def _run_spec():
+            if self._aot.enabled:
+                return self._aot.call(
+                    f"spec_step:{sorted(statics.items())}",
+                    partial(
+                        type(self)._jit_spec_step.__wrapped__,
+                        self,
+                        **statics,
+                    ),
+                    (self.params, self.kv_caches, packed),
+                    donate_args=(1,),
+                )
+            return self._jit_spec_step(
                 self.params, self.kv_caches, packed, **statics
             )
+
+        t_step0 = time.perf_counter()
+        toks, n_emit, self.kv_caches = self._observed_call(
+            "spec", f"{sorted(statics.items())}", _run_spec
+        )
         toks, n_emit = jax.device_get((toks, n_emit))
+        # A verify window streams weights+KV ONCE for up to K+1 tokens —
+        # the roofline asymmetry spec decode exists to exploit.
+        self._record_step_bw(
+            time.perf_counter() - t_step0, int(seq_lens.sum())
+        )
         toks = np.asarray(toks)
         n_emit = np.asarray(n_emit)
 
@@ -1489,27 +1591,39 @@ class ModelRunner:
             do_penalties=flags["do_penalties"],
             do_top_k_p=flags["do_top_k_p"],
         )
-        if self._aot.enabled:
-            toks, carry_out, self.kv_caches = self._aot.call(
-                f"decode_steps:{sorted(statics.items())}",
-                partial(
-                    type(self)._jit_decode_steps.__wrapped__,
-                    self,
-                    **statics,
-                ),
-                (self.params, self.kv_caches, packed, carry_tok),
-                donate_args=(1,),
-            )
-        else:
-            toks, carry_out, self.kv_caches = self._jit_decode_steps(
+        def _run_decode():
+            if self._aot.enabled:
+                return self._aot.call(
+                    f"decode_steps:{sorted(statics.items())}",
+                    partial(
+                        type(self)._jit_decode_steps.__wrapped__,
+                        self,
+                        **statics,
+                    ),
+                    (self.params, self.kv_caches, packed, carry_tok),
+                    donate_args=(1,),
+                )
+            return self._jit_decode_steps(
                 self.params, self.kv_caches, packed, carry_tok, **statics
             )
+
+        t_step0 = time.perf_counter()
+        toks, carry_out, self.kv_caches = self._observed_call(
+            "decode", f"{sorted(statics.items())}", _run_decode
+        )
         # Each sequence's LAST VALID token stays on device as the next
         # dispatch's input (under-K tails: token n_active-1, not K-1).
         self._decode_carry = (order, base_lens + n_active, carry_out)
+        kv_tokens_scanned = int(base_lens.sum())
 
         def resolve() -> ModelRunnerOutput:
             host_toks = np.asarray(jax.device_get(toks))  # [K, s_pad]
+            # K micro-steps = K weights+KV HBM passes.  Wall time spans
+            # dispatch→resolve (includes any pipeline overlap — an
+            # estimate, like the byte count).
+            self._record_step_bw(
+                time.perf_counter() - t_step0, kv_tokens_scanned, k_steps
+            )
             out = ModelRunnerOutput()
             for s, st in enumerate(states):
                 n = int(n_active[s])

@@ -1,0 +1,120 @@
+"""Worker-side XLA/device telemetry (ISSUE 12 tentpole, part 2).
+
+PR 11's bucketed spec-decode shapes made compile behavior load-bearing:
+a shape bucket that escapes warmup costs a multi-second mid-serve XLA
+compile, and nothing surfaced it in steady state — only offline benches
+noticed.  ``DeviceTelemetry`` is the worker-local ledger the model
+runner writes:
+
+- **compiles**: every first execution of a distinct (kind, static
+  shape) jit key is counted and timed, tagged with the triggering
+  bucket kind (``prefill``/``decode``/``spec``) — a recompile storm
+  shows up as a climbing ``vllm:xla_compiles_total`` instead of
+  mystery latency spikes;
+- **HBM**: live/limit bytes from the runtime's ``memory_stats`` so
+  memory creep is a gauge, not an OOM post-mortem;
+- **step roofline**: estimated bytes-touched / step-time over the
+  device's peak HBM bandwidth — the steady-state twin of the offline
+  bench's ``roofline_frac``.
+
+The driver pulls snapshots over the existing ``collective_rpc`` path
+(``get_device_telemetry``) on ``/metrics`` scrapes; compile events
+carry monotonically increasing sequence numbers so the engine folds
+each event into its Prometheus instruments exactly once.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+# Peak HBM bandwidth (bytes/s) by device-kind prefix, for the roofline
+# gauge.  Rough public numbers; an unknown kind reports frac 0.0 (the
+# gauge is a trend signal, not a benchmark).
+_PEAK_BW_BY_KIND = (
+    ("TPU v6", 1640e9),
+    ("TPU v5p", 2765e9),
+    ("TPU v5e", 819e9),
+    ("TPU v5", 819e9),
+    ("TPU v4", 1228e9),
+    ("TPU v3", 900e9),
+)
+
+
+def peak_hbm_bandwidth(device_kind: str) -> float:
+    for prefix, bw in _PEAK_BW_BY_KIND:
+        if device_kind.startswith(prefix):
+            return bw
+    return 0.0
+
+
+class DeviceTelemetry:
+    """Thread-safe ledger of compile/memory/bandwidth observations on
+    one worker.  All record paths are O(1); ``snapshot`` is called only
+    on the (rare) driver pull."""
+
+    def __init__(self, max_events: int = 256) -> None:
+        self._lock = threading.Lock()
+        self._seq = 0
+        # (seq, kind, seconds, shape_key) — bounded; the cumulative
+        # totals below survive ring eviction.
+        self.compile_events: deque[tuple] = deque(maxlen=max_events)
+        self.compiles: dict[str, int] = {}
+        self.compile_seconds_total = 0.0
+        self.last_step_seconds = 0.0
+        self.last_step_bytes = 0
+        self.roofline_frac = 0.0
+
+    def record_compile(self, kind: str, seconds: float, key: str) -> None:
+        with self._lock:
+            self._seq += 1
+            self.compile_events.append((self._seq, kind, seconds, key))
+            self.compiles[kind] = self.compiles.get(kind, 0) + 1
+            self.compile_seconds_total += seconds
+
+    def record_step(
+        self, seconds: float, est_bytes: int, peak_bw: float
+    ) -> None:
+        """One executed step: achieved-vs-roofline bandwidth."""
+        if seconds <= 0:
+            return
+        with self._lock:
+            self.last_step_seconds = seconds
+            self.last_step_bytes = est_bytes
+            self.roofline_frac = (
+                (est_bytes / seconds) / peak_bw if peak_bw > 0 else 0.0
+            )
+
+    def _memory_stats(self) -> tuple[int, int]:
+        """(live_bytes, limit_bytes) from the runtime; (0, 0) when the
+        backend exposes none (CPU tests, mock workers)."""
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats()
+            if stats:
+                return (
+                    int(stats.get("bytes_in_use", 0)),
+                    int(stats.get("bytes_limit", 0)),
+                )
+        except Exception as e:  # noqa: BLE001 — telemetry only, never fatal
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "device memory_stats unavailable: %s", e
+            )
+        return 0, 0
+
+    def snapshot(self, probe_memory: bool = True) -> dict:
+        live, limit = self._memory_stats() if probe_memory else (0, 0)
+        with self._lock:
+            return {
+                "compile_events": [list(e) for e in self.compile_events],
+                "compiles": dict(self.compiles),
+                "compile_seconds_total": self.compile_seconds_total,
+                "hbm_live_bytes": live,
+                "hbm_limit_bytes": limit,
+                "last_step_seconds": self.last_step_seconds,
+                "last_step_bytes": self.last_step_bytes,
+                "roofline_frac": self.roofline_frac,
+            }

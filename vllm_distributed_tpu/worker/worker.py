@@ -181,6 +181,15 @@ class Worker:
     def check_health(self) -> bool:
         return True
 
+    def get_device_telemetry(self) -> dict | None:
+        """XLA compile / HBM / roofline snapshot (ISSUE 12): the driver
+        pulls this on /metrics scrapes and folds it into the engine's
+        Prometheus instruments.  Non-reply ranks skip the snapshot (and
+        its device memory probe) entirely — their reply is discarded."""
+        if self.runner is None or not self.is_driver_worker:
+            return None
+        return self.runner.telemetry.snapshot()
+
     def shutdown(self) -> None:
         """Leave the jax.distributed world cleanly (both sides must reach
         the coordination-service shutdown barrier, or the survivor is
