@@ -628,6 +628,9 @@ def _serve_probe() -> dict:
             concurrency=16,
             input_len=32,
             output_len=128,
+            # Closed-loop probe: the ISSUE 13 --ramp rate sweep is the
+            # fleet/autoscaler workload, not a single-replica number.
+            ramp=None,
         )
         # Warmup passes at EVERY measured concurrency (each join batch
         # size is its own prefill program shape), then the measured

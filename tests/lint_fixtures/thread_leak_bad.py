@@ -1,5 +1,8 @@
-"""Positive corpus for VDT005 thread-leak."""
+"""Positive corpus for VDT005 thread-leak (threads and, since
+ISSUE 13, orphanable child processes)."""
 
+import multiprocessing
+import subprocess
 import threading
 
 
@@ -14,3 +17,11 @@ class Owner:
         threading.Thread(target=work).start()  # EXPECT
         explicit = threading.Thread(target=work, daemon=False)  # EXPECT
         explicit.start()
+
+    def spawn_children(self):
+        # Child processes with no reachable wait()/join(): unreaped,
+        # each lingers as a zombie holding its port.
+        self._proc = subprocess.Popen(["sleep", "1"])  # EXPECT
+        subprocess.Popen(["sleep", "1"])  # EXPECT
+        self._worker = multiprocessing.Process(target=work)  # EXPECT
+        self._worker.start()

@@ -1,5 +1,8 @@
-"""Negative corpus for VDT005: daemons, joined threads, late daemon=."""
+"""Negative corpus for VDT005: daemons, joined threads, late daemon=,
+and reaped child processes."""
 
+import multiprocessing
+import subprocess
 import threading
 
 
@@ -17,5 +20,23 @@ class Owner:
         self._late.daemon = True
         self._late.start()
 
+    def spawn_children(self):
+        # Reaped children: a bounded wait()/join()/communicate() is
+        # reachable in this file (boundedness itself is VDT003's half).
+        self._proc = subprocess.Popen(["sleep", "1"])
+        self._worker = multiprocessing.Process(target=work)
+        self._worker.start()
+        self._sidecar = multiprocessing.Process(target=work, daemon=True)
+        self._sidecar.start()
+        self._piped = subprocess.Popen(["true"])
+
+    def run_managed(self):
+        # The context-manager form reaps on __exit__.
+        with subprocess.Popen(["true"]) as managed:
+            managed.poll()
+
     def shutdown(self):
         self._joined.join(timeout=5)
+        self._proc.wait(timeout=5)
+        self._worker.join(timeout=5)
+        self._piped.communicate(timeout=5)

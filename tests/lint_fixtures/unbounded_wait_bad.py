@@ -32,3 +32,10 @@ async def router_forwarding_loop(session, frames, resp):
     frame = await frames.get()  # EXPECT
     await asyncio.gather(one(), two())  # EXPECT
     return body, frame
+
+
+def reap_child(proc):
+    # The ISSUE 13 fleet reap gone wrong: an unbounded child-process
+    # wait wedges the router's scale-down/shutdown on one stuck
+    # replica instead of escalating TERM -> KILL.
+    return proc.wait()  # EXPECT

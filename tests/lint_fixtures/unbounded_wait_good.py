@@ -63,3 +63,14 @@ def step_queue_loop(inbox, stop, results):
     stop.wait(timeout=5)
     # dict.get always takes a key — a positional arg is not a queue wait.
     return {"a": 1}.get("a")
+
+
+def reap_child(proc):
+    # The ISSUE 13 fleet reap done right: the child wait is
+    # deadline-bounded so a stuck replica escalates to KILL instead of
+    # wedging the router.
+    try:
+        return proc.wait(timeout=5)
+    except Exception:
+        proc.kill()
+        return proc.wait(timeout=5)

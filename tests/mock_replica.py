@@ -1,0 +1,220 @@
+"""Forkable mock-uniproc replica for fleet tests and the chaos ramp
+harness (ISSUE 13).
+
+One managed replica = AsyncLLM over ``MockUniProcExecutor`` (no chips,
+no agents) + the real OpenAI api_server, as its own OS process — the
+thing the router's ``ReplicaManager`` spawns, health-gates, drains,
+kills, and reaps.  Two launch paths share ``_child_main``:
+
+- ``MockReplicaLauncher``: multiprocessing fork (fast — no jax
+  re-import), the ChildHandle surface the manager drives.  Used by
+  tests/test_fleet.py and ``chaos_soak --ramp``.
+- ``python -m tests.mock_replica --port N``: a real subprocess, for
+  exercising the ``CommandLauncher`` template path end to end.
+
+The child honors the usual mock determinism env (VDT_MOCK_TOKEN_SEQ
+position streams make any dropped/duplicated/restarted token visible),
+installs the ISSUE 8 SIGTERM drain, and keeps capacity deliberately
+small (``max_num_seqs``) so a modest rate ramp builds a real waiting
+queue — the autoscaler's primary signal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import multiprocessing
+import os
+
+
+def _child_main(
+    port: int,
+    replica_id: str,
+    model_dir: str,
+    extra_env: dict[str, str] | None = None,
+    max_num_seqs: int = 2,
+) -> None:
+    for k, v in (extra_env or {}).items():
+        os.environ[k] = v
+    import asyncio
+    import signal
+
+    from tests.mock_worker import MockUniProcExecutor
+    from vllm_distributed_tpu.config import EngineArgs
+    from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+    from vllm_distributed_tpu.entrypoints.openai.api_server import (
+        build_app,
+        init_app_state,
+        serve_http,
+    )
+
+    async def main() -> None:
+        engine = AsyncLLM.from_engine_args(
+            EngineArgs(
+                model=model_dir,
+                skip_tokenizer_init=True,
+                load_format="dummy",
+                num_kv_pages=128,
+                max_model_len=256,
+                num_decode_steps=1,
+                max_num_seqs=max_num_seqs,
+                distributed_executor_backend=MockUniProcExecutor,
+            )
+        )
+        state = init_app_state(
+            engine,
+            served_model_name="mock-replica",
+            replica_id=replica_id,
+        )
+        # Tiny shutdown_timeout: a kill must sever live streams (the
+        # migration trigger), not wait them out.
+        runner = await serve_http(
+            build_app(state),
+            host="127.0.0.1",
+            port=port,
+            shutdown_timeout=0.05,
+        )
+        stop = asyncio.Event()
+        sigterm_seen = False
+
+        def _on_sigterm() -> None:
+            # ISSUE 8 parity: first SIGTERM drains (journal/cut
+            # in-flight streams so the router migrates them), second
+            # exits immediately.
+            nonlocal sigterm_seen
+            if stop.is_set():
+                return
+            if sigterm_seen:
+                stop.set()
+                return
+            sigterm_seen = True
+
+            async def _drain_and_stop() -> None:
+                try:
+                    await state.engine.drain()
+                except Exception:  # noqa: BLE001 — drain is best-effort on the way down
+                    pass
+                finally:
+                    stop.set()
+
+            asyncio.get_running_loop().create_task(_drain_and_stop())
+
+        loop = asyncio.get_running_loop()
+        try:
+            loop.add_signal_handler(signal.SIGTERM, _on_sigterm)
+            loop.add_signal_handler(signal.SIGINT, stop.set)
+        except (NotImplementedError, RuntimeError):
+            pass
+        try:
+            await stop.wait()
+        finally:
+            await runner.cleanup()
+            engine.shutdown()
+
+    asyncio.run(main())
+
+
+class ForkHandle:
+    """multiprocessing.Process adapter for the manager's ChildHandle
+    duck type (pid / poll / terminate / kill / wait)."""
+
+    def __init__(self, proc: multiprocessing.Process) -> None:
+        self._proc = proc
+
+    @property
+    def pid(self) -> int | None:
+        return self._proc.pid
+
+    def poll(self) -> int | None:
+        if self._proc.is_alive():
+            return None
+        return self._proc.exitcode
+
+    def terminate(self) -> None:
+        self._proc.terminate()
+
+    def kill(self) -> None:
+        self._proc.kill()
+
+    def wait(self, timeout: float | None = None) -> int | None:
+        self._proc.join(timeout)
+        return self._proc.exitcode
+
+
+class MockReplicaLauncher:
+    """Fork-based launcher: spawns ``_child_main`` as a daemon child.
+    Keeps every handle it minted so harnesses can assert nothing
+    outlives the manager (``leaked()``) and reach into a live child to
+    SIGKILL it mid-resize (``alive()``)."""
+
+    def __init__(
+        self,
+        model_dir: str,
+        extra_env: dict[str, str] | None = None,
+        max_num_seqs: int = 2,
+    ) -> None:
+        self.model_dir = model_dir
+        self.extra_env = dict(extra_env or {})
+        self.max_num_seqs = max_num_seqs
+        self.spawned: list[tuple[str, ForkHandle]] = []
+
+    def spawn(self, replica_id: str, port: int) -> ForkHandle:
+        proc = multiprocessing.Process(
+            target=_child_main,
+            args=(
+                port,
+                replica_id,
+                self.model_dir,
+                self.extra_env,
+                self.max_num_seqs,
+            ),
+            daemon=True,
+        )
+        proc.start()
+        handle = ForkHandle(proc)
+        self.spawned.append((replica_id, handle))
+        return handle
+
+    def alive(self) -> list[tuple[str, ForkHandle]]:
+        return [(rid, h) for rid, h in self.spawned if h.poll() is None]
+
+    def leaked(self) -> list[str]:
+        """Replica ids whose child process is still alive — must be
+        empty after the manager stops (the no-zombie contract)."""
+        return [rid for rid, _ in self.alive()]
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--replica-id", type=str, default="")
+    parser.add_argument(
+        "--model-dir",
+        type=str,
+        default="",
+        help="llama config dir; written fresh to a tempdir when empty",
+    )
+    parser.add_argument("--max-num-seqs", type=int, default=2)
+    args = parser.parse_args()
+    model_dir = args.model_dir
+    if not model_dir:
+        import tempfile
+
+        from vllm_distributed_tpu.testing import write_llama_config
+
+        model_dir = write_llama_config(
+            os.path.join(
+                tempfile.mkdtemp(prefix="vdt_mock_replica_"), "m"
+            )
+        )
+    replica_id = (
+        args.replica_id
+        or os.environ.get("VDT_REPLICA_ID")
+        or f"mock-{args.port}"
+    )
+    _child_main(
+        args.port, replica_id, model_dir, max_num_seqs=args.max_num_seqs
+    )
+
+
+if __name__ == "__main__":
+    main()

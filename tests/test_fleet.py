@@ -1,0 +1,816 @@
+"""Elastic fleet suite (ISSUE 13): replica lifecycle + autoscaling.
+
+Layered like the feature: pure-policy units for the autoscaler's
+``decide`` (hysteresis, cooldowns, bounds, secondary triggers, on
+synthetic gauge traces); ReplicaManager state-machine units over fake
+child handles (spawn → health-gated warmup → routable, crash-loop
+backoff + restart-budget exhaustion, scale-down drains BEFORE reap,
+shutdown reaps everything); pool/metrics membership hygiene (no stale
+``replica="<id>"`` series after removal); a CommandLauncher
+integration over a real stdlib-only subprocess; and the acceptance
+runs — a 1→3→1 resize over forked mock-uniproc replicas under
+streaming load with zero lost admitted work (manual /router/scale),
+and a short autoscaled resize-chaos ramp smoke
+(tools/chaos_soak.run_fleet_ramp) with a SIGKILL mid-resize.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from vllm_distributed_tpu.router.fleet import (
+    AutoscalerConfig,
+    Autoscaler,
+    CommandLauncher,
+    FleetSignals,
+    ReplicaManager,
+    decide,
+)
+from vllm_distributed_tpu.router.metrics import RouterMetrics
+from vllm_distributed_tpu.router.pool import ReplicaPool
+
+pytestmark = pytest.mark.fleet
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------
+# autoscaler policy units (pure decide() on synthetic gauge traces)
+# ---------------------------------------------------------------------
+CFG = AutoscalerConfig(
+    min_replicas=1,
+    max_replicas=4,
+    interval=1.0,
+    up_waiting=4.0,
+    down_waiting=1.0,
+    up_cooldown=10.0,
+    down_cooldown=30.0,
+)
+
+
+def _sig(routable=2, waiting=0.0, reject_rate=0.0, itl=None):
+    return FleetSignals(
+        routable=routable,
+        waiting=waiting,
+        reject_rate=reject_rate,
+        itl_p99_ms=itl,
+    )
+
+
+def test_decide_holds_inside_hysteresis_band():
+    # Between the down and up watermarks: no decision either way.
+    for per in (1.0, 2.5, 4.0):
+        assert decide(
+            2, _sig(waiting=2 * per), CFG, 100.0, 0.0, 0.0
+        ) == (2, None)
+
+
+def test_decide_scales_up_on_queue_depth_and_respects_cooldown():
+    hot = _sig(waiting=2 * 5.0)  # 5 waiting per replica > 4
+    assert decide(2, hot, CFG, 100.0, 0.0, 0.0) == (3, "queue_depth")
+    # Inside the up-cooldown window: hold even though still hot.
+    assert decide(3, hot, CFG, 100.0, 95.0, 0.0) == (3, None)
+    # Cooldown elapsed: another single step.
+    assert decide(3, hot, CFG, 120.0, 100.0, 0.0) == (4, "queue_depth")
+    # At the ceiling: clamp.
+    assert decide(4, hot, CFG, 200.0, 100.0, 0.0) == (4, None)
+
+
+def test_decide_scales_down_only_when_idle_and_cooled():
+    idle = _sig(waiting=0.0)
+    # Idle but the down-cooldown hasn't elapsed since the last down.
+    assert decide(3, idle, CFG, 100.0, 0.0, 90.0) == (3, None)
+    # Idle but a recent scale-UP also blocks the down (anti-flap).
+    assert decide(3, idle, CFG, 100.0, 90.0, 0.0) == (3, None)
+    # Both cooldowns clear: one step down.
+    assert decide(3, idle, CFG, 100.0, 0.0, 0.0) == (2, "idle")
+    # Never below the floor.
+    assert decide(1, idle, CFG, 500.0, 0.0, 0.0) == (1, None)
+
+
+def test_decide_secondary_triggers_and_bounds():
+    cfg = AutoscalerConfig(
+        min_replicas=1,
+        max_replicas=4,
+        up_waiting=4.0,
+        down_waiting=1.0,
+        up_cooldown=10.0,
+        down_cooldown=30.0,
+        max_reject_rate=0.5,
+        itl_p99_ms=200.0,
+    )
+    # Shallow queues but a hot 429 rate: still scale up.
+    assert decide(
+        2, _sig(waiting=0.0, reject_rate=1.0), cfg, 100.0, 0.0, 0.0
+    ) == (3, "reject_rate")
+    # Shallow queues but fleet ITL p99 over target: scale up.
+    assert decide(
+        2, _sig(waiting=0.0, itl=350.0), cfg, 100.0, 0.0, 0.0
+    ) == (3, "itl_p99")
+    # A hot trigger also VETOES the idle scale-down.
+    assert decide(
+        2, _sig(waiting=0.0, reject_rate=1.0), cfg, 100.0, 0.0, 0.0
+    )[0] >= 2
+    # Out-of-bounds targets snap back.
+    assert decide(0, _sig(), cfg, 0.0, 0.0, 0.0) == (1, "min_bound")
+    assert decide(9, _sig(), cfg, 0.0, 0.0, 0.0) == (4, "max_bound")
+    # No routable replica: signals unreadable, hold (respawn is the
+    # manager's job, not a scaling decision).
+    assert decide(2, _sig(routable=0), cfg, 100.0, 0.0, 0.0) == (2, None)
+
+
+def test_autoscaler_tick_trace_up_then_hold_then_down():
+    """Drive Autoscaler.tick over a synthetic gauge trace: a burst
+    scales up once per cooldown window, the idle tail scales back
+    down."""
+
+    class FakeManager:
+        target = 1
+
+        def scale_to(self, n, reason=""):
+            self.target = n
+
+    async def go():
+        pool = ReplicaPool([], allow_empty=True)
+        r = pool.add("http://h:1", replica_id="r1", state="healthy")
+        cfg = AutoscalerConfig(
+            min_replicas=1,
+            max_replicas=3,
+            up_waiting=2.0,
+            down_waiting=0.5,
+            up_cooldown=0.0,  # every tick may step in this unit
+            down_cooldown=0.0,
+        )
+        mgr = FakeManager()
+        scaler = Autoscaler(
+            mgr, pool, RouterMetrics(enabled=False), cfg
+        )
+        r.waiting = 10.0
+        assert await scaler.tick() == (2, "queue_depth")
+        assert await scaler.tick() == (3, "queue_depth")
+        assert await scaler.tick() == (3, None)  # at the ceiling
+        r.waiting = 0.0
+        assert await scaler.tick() == (2, "idle")
+        assert await scaler.tick() == (1, "idle")
+        assert await scaler.tick() == (1, None)  # at the floor
+        assert [d["to"] for d in scaler.decisions] == [2, 3, 2, 1]
+
+    _run(go())
+
+
+# ---------------------------------------------------------------------
+# manager state-machine units (fake child handles, injected probes)
+# ---------------------------------------------------------------------
+class FakeHandle:
+    def __init__(self, pid: int, exit_code: int | None = None):
+        self.pid = pid
+        self._exit = exit_code  # non-None = born dead (crash-loop unit)
+        self.log: list[str] = []
+
+    def poll(self):
+        return self._exit
+
+    def terminate(self):
+        self.log.append("terminate")
+        if self._exit is None:
+            self._exit = -15
+
+    def kill(self):
+        self.log.append("kill")
+        if self._exit is None:
+            self._exit = -9
+
+    def wait(self, timeout=None):
+        self.log.append("wait")
+        return self._exit
+
+
+class FakeLauncher:
+    def __init__(self, born_dead: bool = False):
+        self.born_dead = born_dead
+        self.spawned: list[FakeHandle] = []
+
+    def spawn(self, replica_id, port):
+        handle = FakeHandle(
+            pid=1000 + len(self.spawned),
+            exit_code=1 if self.born_dead else None,
+        )
+        self.spawned.append(handle)
+        return handle
+
+
+def _manager(launcher, pool=None, **kw):
+    pool = pool or ReplicaPool([], allow_empty=True)
+    kw.setdefault("warmup_timeout", 5.0)
+    kw.setdefault("drain_timeout", 5.0)
+    kw.setdefault("check_interval", 0.01)
+    kw.setdefault("max_restarts", 3)
+    kw.setdefault("restart_window", 300.0)
+    kw.setdefault("backoff_base", 0.0)
+    kw.setdefault("backoff_cap", 0.0)
+    return (
+        ReplicaManager(
+            pool, RouterMetrics(enabled=False), launcher, **kw
+        ),
+        pool,
+    )
+
+
+def test_spawn_health_gates_before_routable():
+    """A spawned replica is NOT in the pool until its health probe
+    passes; once it passes, it enters already routable."""
+    probes = {"n": 0, "ok_after": 3}
+
+    async def health_check(url):
+        probes["n"] += 1
+        return probes["n"] >= probes["ok_after"]
+
+    async def go():
+        manager, pool = _manager(
+            FakeLauncher(), health_check=health_check
+        )
+        manager.scale_to(1)
+        await manager._reconcile()
+        (mr,) = manager.replicas
+        assert mr.state == "starting"
+        assert pool.replicas == []  # never routable before healthy
+        await asyncio.wait_for(mr.task, timeout=5)
+        assert mr.state == "ready"
+        assert probes["n"] == probes["ok_after"]
+        (replica,) = pool.replicas
+        assert replica.url == mr.url
+        assert replica.replica_id == mr.replica_id
+        assert replica.routable  # healthy immediately, no poll tick
+        events = [e["kind"] for e in manager.events]
+        assert events == ["scale", "spawn", "ready"]
+        await manager.stop(drain=False)
+
+    _run(go())
+
+
+def test_warmup_timeout_counts_as_crash():
+    async def health_check(url):
+        return False  # never comes up
+
+    async def go():
+        manager, pool = _manager(
+            FakeLauncher(),
+            health_check=health_check,
+            warmup_timeout=0.05,
+            max_restarts=1,
+        )
+        manager.scale_to(1)
+        await manager._reconcile()
+        (mr,) = manager.replicas
+        await asyncio.wait_for(mr.task, timeout=5)
+        assert manager.replicas == []
+        assert pool.replicas == []
+        kinds = [e["kind"] for e in manager.events]
+        assert "warmup_failed" in kinds
+        # The dead child was reaped (terminate/kill then wait).
+        handle = mr.handle
+        assert "wait" in handle.log
+        await manager.stop(drain=False)
+
+    _run(go())
+
+
+def test_crash_loop_backoff_and_budget_exhaustion():
+    """Born-dead children burn the restart budget, then the manager
+    goes terminal (exhausted) instead of spinning; a manual resize
+    clears exhaustion."""
+
+    async def health_check(url):  # pragma: no cover - never reached
+        return False
+
+    async def go():
+        launcher = FakeLauncher(born_dead=True)
+        manager, pool = _manager(
+            launcher, health_check=health_check, max_restarts=2
+        )
+        manager.scale_to(1)
+        # Tick until the budget is spent (each reconcile spawns at most
+        # one child and sweeps the corpse on the next pass).
+        for _ in range(20):
+            await manager._reconcile()
+            if manager.exhausted:
+                break
+            await asyncio.sleep(0.01)
+        assert manager.exhausted
+        spawned_at_exhaustion = len(launcher.spawned)
+        # Budget == max_restarts: 1 initial spawn + 2 respawns... the
+        # crash path counts every death; at most max_restarts deaths
+        # are forgiven, so spawn count is bounded by max_restarts + 1.
+        assert spawned_at_exhaustion <= manager.max_restarts + 1
+        kinds = [e["kind"] for e in manager.events]
+        assert "restart_budget_exhausted" in kinds
+        # Terminal: further reconciles spawn nothing.
+        for _ in range(3):
+            await manager._reconcile()
+        assert len(launcher.spawned) == spawned_at_exhaustion
+        assert pool.replicas == []
+        # Operator override: an explicit resize clears exhaustion.
+        manager.scale_to(1, reason="manual")
+        assert not manager.exhausted
+        await manager.stop(drain=False)
+
+    _run(go())
+
+
+def test_scale_down_drains_before_reap():
+    """The scale-down ordering contract: /drain completes (in-flight
+    work journal-migrates) BEFORE the process sees TERM/KILL, and the
+    child is reaped synchronously."""
+    order: list[str] = []
+
+    async def health_check(url):
+        return True
+
+    async def drainer(url, timeout):
+        order.append(f"drain:{url}")
+
+    async def go():
+        manager, pool = _manager(
+            FakeLauncher(), health_check=health_check, drainer=drainer
+        )
+        manager.scale_to(2)
+        await manager._reconcile()  # spawn 1 (one per tick)
+        await manager._reconcile()  # spawn 2
+        for mr in list(manager.replicas):
+            await asyncio.wait_for(mr.task, timeout=5)
+        assert manager.ready_count() == 2
+        assert len(pool.replicas) == 2
+        manager.scale_to(1)
+        await manager._reconcile()
+        victim = next(
+            r
+            for r in manager.replicas
+            if r.task is not None and not r.task.done()
+        )
+        await asyncio.wait_for(victim.task, timeout=5)
+        # The newest replica was picked, drained, then terminated.
+        assert victim.replica_id == "fleet-2"
+        assert order == [f"drain:{victim.url}"]
+        assert victim.handle.log[0] == "terminate"
+        assert "wait" in victim.handle.log  # synchronous reap
+        assert manager.ready_count() == 1
+        assert len(pool.replicas) == 1
+        kinds = [
+            (e["kind"], e["replica_id"])
+            for e in manager.events
+            if e["replica_id"] == victim.replica_id
+        ]
+        # drain strictly precedes stopped.
+        assert kinds.index(("drain", victim.replica_id)) < kinds.index(
+            ("stopped", victim.replica_id)
+        )
+        await manager.stop(drain=False)
+
+    _run(go())
+
+
+def test_manager_stop_drains_all_and_reaps():
+    """Router-exit parity with the replica-side SIGTERM drain: stop()
+    drains every serving replica (bounded) and reaps every child."""
+    drained: list[str] = []
+
+    async def health_check(url):
+        return True
+
+    async def drainer(url, timeout):
+        drained.append(url)
+
+    async def go():
+        launcher = FakeLauncher()
+        manager, pool = _manager(
+            launcher, health_check=health_check, drainer=drainer
+        )
+        manager.scale_to(2)
+        await manager._reconcile()
+        await manager._reconcile()
+        for mr in list(manager.replicas):
+            await asyncio.wait_for(mr.task, timeout=5)
+        urls = sorted(r.url for r in manager.replicas)
+        # The injected drainer stands in for HTTP; stop()'s drain
+        # phase only runs once a session exists (set by start()).
+        manager.session = object()
+        await manager.stop(drain=True)
+        assert sorted(drained) == urls
+        assert manager.replicas == [] and pool.replicas == []
+        for handle in launcher.spawned:
+            assert handle.poll() is not None  # dead
+            assert "wait" in handle.log  # reaped
+
+    _run(go())
+
+
+# ---------------------------------------------------------------------
+# pool + metrics membership hygiene
+# ---------------------------------------------------------------------
+def test_pool_membership_and_remove_hook():
+    pool = ReplicaPool([], allow_empty=True)
+    removed: list[str] = []
+    pool.on_remove.append(lambda r: removed.append(r.replica_id))
+    r = pool.add("http://h:1/", replica_id="r1", state="healthy")
+    assert r.routable
+    assert pool.add("http://h:1") is r  # idempotent, no dup
+    assert len(pool.replicas) == 1
+    assert pool.remove("http://h:1").replica_id == "r1"
+    assert pool.replicas == [] and removed == ["r1"]
+    assert pool.remove("http://h:1") is None  # idempotent
+
+
+def test_pool_rejects_empty_unless_allowed():
+    with pytest.raises(ValueError):
+        ReplicaPool([])
+    assert ReplicaPool([], allow_empty=True).replicas == []
+
+
+def test_metrics_forget_replica_drops_series():
+    metrics = RouterMetrics()
+    if not metrics.enabled:
+        pytest.skip("prometheus_client unavailable")
+    pool = ReplicaPool([], allow_empty=True)
+    pool.on_remove.append(
+        lambda replica: metrics.forget_replica(replica.replica_id)
+    )
+    for rid in ("alive", "doomed"):
+        pool.add(f"http://{rid}:1", replica_id=rid, state="healthy")
+    metrics.update_replicas(pool)
+    text = metrics.render().decode()
+    assert 'replica_id="doomed"' in text
+    pool.remove("http://doomed:1")
+    metrics.update_replicas(pool)
+    text = metrics.render().decode()
+    # No stale series after scale-down: the doomed replica's labeled
+    # rows are gone from the router's own exposition too.
+    assert 'replica_id="doomed"' not in text
+    assert 'replica_id="alive"' in text
+
+
+def test_pool_remove_forgets_affinity_chains():
+    """A removed replica's prefix-affinity chains are dropped: a
+    churning autoscaled fleet must not accumulate departed replicas'
+    index state (or keep steering prompts at ghosts) forever."""
+    from vllm_distributed_tpu.router.app import RouterState
+
+    state = RouterState(
+        [],
+        policy="affinity",
+        health_interval=60.0,
+        allow_empty_pool=True,
+    )
+    state.pool.add("http://h:1", replica_id="doomed", state="healthy")
+    keys = state.index.keys_for(prompt_token_ids=list(range(32)))
+    state.index.observe("doomed", keys)
+    assert state.index.score(keys) == {"doomed": 32}
+    state.pool.remove("http://h:1")
+    assert state.index.score(keys) == {}
+    assert state.index.num_blocks("doomed") == 0
+
+
+def test_probe_jitter_bounded_by_interval():
+    pool = ReplicaPool([], allow_empty=True, health_interval=2.0)
+    assert 0 < pool._probe_jitter() <= 0.5
+    pool.health_interval = 100.0
+    assert pool._probe_jitter() == 1.0  # hard cap
+
+
+def test_parse_ramp():
+    from vllm_distributed_tpu.entrypoints.cli import parse_ramp
+
+    assert parse_ramp("5:6,14:12,0:8") == [
+        (5.0, 6.0),
+        (14.0, 12.0),
+        (0.0, 8.0),
+    ]
+    assert parse_ramp(" 2.5:1.5 ") == [(2.5, 1.5)]
+    for bad in ("", "5", "5:0", "-1:5", "a:b"):
+        with pytest.raises(SystemExit):
+            parse_ramp(bad)
+
+
+# ---------------------------------------------------------------------
+# CommandLauncher over a real (stdlib-only) subprocess
+# ---------------------------------------------------------------------
+_HEALTH_SERVER = """
+import json, sys
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        if self.path == "/health":
+            body = json.dumps({"status": "ok"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+    def log_message(self, *args):
+        pass
+
+
+HTTPServer(("127.0.0.1", int(sys.argv[1])), H).serve_forever()
+"""
+
+
+def test_command_launcher_template_validation():
+    with pytest.raises(ValueError):
+        CommandLauncher("vdt serve model")  # no {port}
+
+
+def test_command_launcher_spawns_real_subprocess(tmp_path):
+    """The --fleet-cmd path end to end: a real child process from the
+    template, health-gated into the pool, then reaped on stop."""
+    script = tmp_path / "health_server.py"
+    script.write_text(_HEALTH_SERVER)
+
+    async def go():
+        import aiohttp
+
+        launcher = CommandLauncher(f"{sys.executable} {script} {{port}}")
+        pool = ReplicaPool(
+            [], allow_empty=True, connect_timeout=2, probe_timeout=2
+        )
+        manager = ReplicaManager(
+            pool,
+            RouterMetrics(enabled=False),
+            launcher,
+            warmup_timeout=20.0,
+            drain_timeout=1.0,
+            check_interval=0.05,
+            max_restarts=1,
+            restart_window=300.0,
+            backoff_base=0.0,
+            backoff_cap=0.0,
+        )
+        async with aiohttp.ClientSession() as session:
+            manager.session = session
+            manager.scale_to(1)
+            await manager._reconcile()
+            (mr,) = manager.replicas
+            # The child got its identity via the environment.
+            assert mr.replica_id == "fleet-1"
+            await asyncio.wait_for(mr.task, timeout=20)
+            assert mr.state == "ready"
+            assert pool.replicas[0].routable
+            pid = mr.handle.pid
+            await manager.stop(drain=False)
+            assert manager.replicas == []
+            # Synchronously reaped: the pid is gone (no zombie).
+            assert mr.handle.poll() is not None
+            with pytest.raises(ProcessLookupError):
+                os.kill(pid, 0)
+
+    _run(go())
+
+
+# ---------------------------------------------------------------------
+# acceptance: 1→3→1 resize under load (forked mock-uniproc replicas)
+# ---------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    from vllm_distributed_tpu.testing import write_llama_config
+
+    return write_llama_config(
+        str(tmp_path_factory.mktemp("fleet_model") / "m")
+    )
+
+
+MOCK_ENV = {
+    "VDT_MOCK_TOKEN_SEQ": "1",
+    "VDT_MOCK_EXECUTE_SLEEP_SECONDS": "0.02",
+}
+
+
+def test_resize_1_3_1_under_load_loses_nothing(model_dir, monkeypatch):
+    """The ISSUE 13 resize acceptance: scale a live fleet 1→3→1 through
+    /router/scale while streaming load runs end to end.  Every admitted
+    stream completes with the mock's exact position-token sequence
+    (scale-downs drain + migrate, scale-ups health-gate), and no child
+    outlives the router."""
+    for k, v in MOCK_ENV.items():
+        monkeypatch.setenv(k, v)
+    from tests.mock_replica import MockReplicaLauncher
+    from vllm_distributed_tpu.entrypoints.openai.api_server import (
+        serve_http,
+    )
+    from vllm_distributed_tpu.router.app import (
+        RouterState,
+        build_router_app,
+    )
+    from vllm_distributed_tpu.utils import get_open_port
+
+    max_tokens = 10
+    prompt = [1, 2, 3]
+    expected = list(range(len(prompt), len(prompt) + max_tokens))
+    stats = {"admitted": 0, "completed": 0, "lost": 0, "mismatches": 0,
+             "rejected": 0}
+
+    async def go():
+        import aiohttp
+
+        launcher = MockReplicaLauncher(
+            model_dir, extra_env=dict(MOCK_ENV), max_num_seqs=4
+        )
+        state = RouterState(
+            [],
+            policy="least_loaded",
+            health_interval=0.25,
+            connect_timeout=2,
+            read_timeout=30,
+            allow_empty_pool=True,
+        )
+        manager = ReplicaManager(
+            state.pool,
+            state.metrics,
+            launcher,
+            target=1,
+            warmup_timeout=60,
+            drain_timeout=10,
+            check_interval=0.2,
+            max_restarts=5,
+            restart_window=3600.0,
+            backoff_base=0.2,
+            backoff_cap=1.0,
+        )
+        state.attach_fleet(manager)
+        port = get_open_port()
+        runner = await serve_http(
+            build_router_app(state), host="127.0.0.1", port=port
+        )
+        url = f"http://127.0.0.1:{port}"
+        timeout = aiohttp.ClientTimeout(total=None, sock_read=60)
+
+        async def one_stream(session, tag):
+            body = {
+                "prompt": list(prompt),
+                "max_tokens": max_tokens,
+                "temperature": 0.0,
+                "ignore_eos": True,
+                "stream": True,
+            }
+            try:
+                async with session.post(
+                    f"{url}/v1/completions",
+                    json=body,
+                    headers={"X-VDT-Router": "1"},
+                    timeout=timeout,
+                ) as resp:
+                    if resp.status == 429:
+                        stats["rejected"] += 1
+                        return
+                    if resp.status != 200:
+                        stats["lost"] += 1
+                        return
+                    stats["admitted"] += 1
+                    toks: list[int] = []
+                    finished = False
+                    async for raw in resp.content:
+                        line = raw.decode().strip()
+                        if not line.startswith("data:"):
+                            continue
+                        payload = line[5:].strip()
+                        if payload == "[DONE]":
+                            finished = True
+                            break
+                        obj = json.loads(payload)
+                        if "error" in obj and not obj.get("choices"):
+                            break
+                        for ch in obj.get("choices") or ():
+                            toks += ch.get("vdt_token_ids") or []
+                    if not finished:
+                        stats["lost"] += 1
+                    elif toks != expected:
+                        stats["mismatches"] += 1
+                    else:
+                        stats["completed"] += 1
+            except Exception:  # noqa: BLE001 — an admitted stream erroring IS lost work
+                stats["lost"] += 1
+
+        async def load(session, stop):
+            """Closed-loop background load: 3 lanes of back-to-back
+            streams, riding across every resize."""
+
+            async def lane(j):
+                k = 0
+                while not stop.is_set():
+                    await one_stream(session, f"lane{j}-{k}")
+                    k += 1
+
+            await asyncio.gather(*(lane(j) for j in range(3)))
+
+        async def wait_until(cond, timeout_s, what):
+            deadline = time.monotonic() + timeout_s
+            while not cond():
+                assert time.monotonic() < deadline, (
+                    f"timed out waiting for {what}: "
+                    f"{manager.snapshot()['replicas']}"
+                )
+                await asyncio.sleep(0.1)
+
+        async with aiohttp.ClientSession() as session:
+            await wait_until(
+                lambda: manager.ready_count() >= 1, 60, "first replica"
+            )
+            stop = asyncio.Event()
+            load_task = asyncio.ensure_future(load(session, stop))
+            try:
+                await asyncio.sleep(0.5)
+                async with session.post(
+                    f"{url}/router/scale", json={"replicas": 3}
+                ) as r:
+                    assert r.status == 200, await r.text()
+                await wait_until(
+                    lambda: manager.ready_count() == 3, 90, "scale to 3"
+                )
+                await asyncio.sleep(1.0)  # serve a while at 3
+                async with session.post(
+                    f"{url}/router/scale", json={"replicas": 1}
+                ) as r:
+                    assert r.status == 200, await r.text()
+                await wait_until(
+                    lambda: len(manager.active()) == 1
+                    and manager.ready_count() == 1,
+                    90,
+                    "scale to 1",
+                )
+                await asyncio.sleep(0.5)  # serve a while back at 1
+            finally:
+                stop.set()
+                await asyncio.wait_for(load_task, timeout=90)
+            # Membership hygiene end to end: the merged exposition
+            # carries exactly the one live replica.
+            async with session.get(f"{url}/metrics") as r:
+                exposition = await r.text()
+            live_id = manager.replicas[0].replica_id
+            import re
+
+            labeled = set(
+                re.findall(r'replica(?:_id)?="([^"]+)"', exposition)
+            )
+            assert labeled == {live_id}, labeled
+            async with session.get(f"{url}/router/fleet") as r:
+                fleet = await r.json()
+        await runner.cleanup()
+        return fleet, launcher
+
+    fleet, launcher = _run(go())
+    # Zero lost admitted work, zero token mismatches, through both
+    # resizes.
+    assert stats["lost"] == 0, (stats, fleet["events"])
+    assert stats["mismatches"] == 0, stats
+    assert stats["admitted"] == stats["completed"] > 0
+    # Every scale-down drained before it stopped.
+    ready_ids = {
+        e["replica_id"] for e in fleet["events"] if e["kind"] == "ready"
+    }
+    drained: set[str] = set()
+    for e in fleet["events"]:
+        if e["kind"] == "drain":
+            drained.add(e["replica_id"])
+        elif e["kind"] == "stopped" and e["replica_id"] in ready_ids:
+            assert e["replica_id"] in drained, fleet["events"]
+    # No child outlived the router.
+    assert launcher.leaked() == []
+
+
+def test_fleet_ramp_smoke(model_dir):
+    """Short autoscaled resize-chaos ramp (tools/chaos_soak.py --ramp):
+    rate sweep up and down with a SIGKILL mid-resize — replica count
+    follows the ramp within bounds, zero lost admitted work, zero
+    token mismatches, drain-before-stop on every scale-down."""
+    from tools.chaos_soak import run_fleet_ramp
+
+    report = run_fleet_ramp(
+        max_replicas=3,
+        ramp="4:3,12:8,1:4,0:8",
+        max_tokens=10,
+        kill_mid_resize=True,
+        autoscale_interval=0.5,
+        up_cooldown=1.0,
+        down_cooldown=2.0,
+    )
+    assert report["bounded"], report
+    assert report["lost"] == 0 and report["mismatches"] == 0
+    assert report["scaled_up"] and report["scaled_down"]
+    assert report["max_ready_observed"] <= 3
+    assert report["drained_before_stop"]
+    assert report["leaked_children"] == []

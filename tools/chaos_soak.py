@@ -721,6 +721,394 @@ def run_router_soak(
                 os.environ[k] = v
 
 
+# ---------------------------------------------------------------------
+# Resize-chaos ramp (ISSUE 13): an autoscaled fleet of managed mock
+# replicas under a Poisson rate sweep, with a SIGKILL mid-resize —
+# asserting zero lost admitted work, zero token mismatches, every
+# scale-down preceded by a drain, and the replica count following the
+# ramp up AND down within bounds.
+# ---------------------------------------------------------------------
+def run_fleet_ramp(
+    *,
+    max_replicas: int = 3,
+    ramp: str = "5:6,14:12,2:6,0:10",
+    max_tokens: int = 12,
+    kill_mid_resize: bool = True,
+    stall_bound_s: float = 45.0,
+    autoscale_interval: float = 0.75,
+    up_cooldown: float = 1.5,
+    down_cooldown: float = 2.5,
+) -> dict:
+    """Run the resize-chaos ramp; returns the report dict.  Mutates
+    (and restores) os.environ — call from a dedicated process or a test
+    that tolerates env churn.
+
+    The fleet starts at 1 managed mock-uniproc replica (capacity
+    deliberately tiny, max_num_seqs=2, so the sweep genuinely
+    overloads one replica); the autoscaler follows the waiting-depth
+    signal up to ``max_replicas`` and back down over the idle tail.
+    ``kill_mid_resize`` SIGKILLs a serving replica while a scale-up is
+    still warming — the crash path, the warmup path, and the migration
+    path all land in the same instant, which is exactly the window a
+    real resize is most fragile in."""
+    import asyncio
+    import random
+
+    from tests.mock_replica import MockReplicaLauncher
+    from vllm_distributed_tpu.entrypoints.cli import parse_ramp
+    from vllm_distributed_tpu.router.app import (
+        RouterState,
+        build_router_app,
+    )
+    from vllm_distributed_tpu.router.fleet import (
+        Autoscaler,
+        AutoscalerConfig,
+        ReplicaManager,
+    )
+    from vllm_distributed_tpu.entrypoints.openai.api_server import (
+        serve_http,
+    )
+    from vllm_distributed_tpu.testing import write_llama_config
+    from vllm_distributed_tpu.utils import get_open_port
+
+    segments = parse_ramp(ramp)
+    saved = {k: os.environ.get(k) for k in ROUTER_AGENT_ENV}
+    os.environ.update(ROUTER_AGENT_ENV)
+    tmpdir = tempfile.mkdtemp(prefix="vdt_fleet_ramp_")
+    model_dir = write_llama_config(os.path.join(tmpdir, "m"))
+    prompt = [1, 2, 3]
+    expected = list(range(len(prompt), len(prompt) + max_tokens))
+
+    stats = {
+        "offered": 0,
+        "admitted": 0,
+        "completed": 0,
+        "mismatches": 0,
+        "lost": 0,
+        "rejected": 0,
+    }
+    stalls: list[float] = []
+    ttfts: list[float] = []
+    timeline: list[dict] = []
+    kill_info: dict = {}
+
+    async def go() -> dict:
+        import aiohttp
+
+        launcher = MockReplicaLauncher(
+            model_dir, extra_env=dict(ROUTER_AGENT_ENV)
+        )
+        state = RouterState(
+            [],
+            policy="least_loaded",
+            health_interval=0.25,
+            connect_timeout=2,
+            # Generous per-read deadline: at peak the sweep deliberately
+            # overloads the fleet, so a (re)queued request can sit well
+            # over 30s before its first token — that silence is the
+            # scale-up SIGNAL, not a dead replica.
+            read_timeout=60,
+            allow_empty_pool=True,
+        )
+        manager = ReplicaManager(
+            state.pool,
+            state.metrics,
+            launcher,
+            target=1,
+            warmup_timeout=60,
+            drain_timeout=10,
+            check_interval=0.2,
+            max_restarts=10,
+            restart_window=3600,
+            backoff_base=0.2,
+            backoff_cap=1.0,
+        )
+        autoscaler = Autoscaler(
+            manager,
+            state.pool,
+            state.metrics,
+            AutoscalerConfig(
+                min_replicas=1,
+                max_replicas=max_replicas,
+                interval=autoscale_interval,
+                up_waiting=2.0,
+                down_waiting=0.5,
+                up_cooldown=up_cooldown,
+                down_cooldown=down_cooldown,
+            ),
+        )
+        state.attach_fleet(manager, autoscaler)
+        router_port = get_open_port()
+        router_runner = await serve_http(
+            build_router_app(state), host="127.0.0.1", port=router_port
+        )
+        router_url = f"http://127.0.0.1:{router_port}"
+        # The client outlasts worst-case queue wait + migrations: a
+        # stream the fleet admitted must be given time to finish, or
+        # the harness manufactures its own "lost work".
+        timeout = aiohttp.ClientTimeout(total=None, sock_read=150)
+
+        async def one_stream(session, tag: str) -> None:
+            body = {
+                "prompt": list(prompt),
+                "max_tokens": max_tokens,
+                "temperature": 0.0,
+                "ignore_eos": True,
+                "stream": True,
+            }
+            try:
+                async with session.post(
+                    f"{router_url}/v1/completions",
+                    json=body,
+                    headers={"X-VDT-Router": "1"},
+                    timeout=timeout,
+                ) as resp:
+                    if resp.status == 429:
+                        stats["rejected"] += 1
+                        return
+                    if resp.status != 200:
+                        stats["lost"] += 1
+                        return
+                    stats["admitted"] += 1
+                    toks: list[int] = []
+                    finished = False
+                    req_t0 = time.monotonic()
+                    last = None
+                    worst_gap = 0.0
+                    async for raw in resp.content:
+                        line = raw.decode().strip()
+                        if not line.startswith("data:"):
+                            continue
+                        payload = line[5:].strip()
+                        if payload == "[DONE]":
+                            finished = True
+                            break
+                        obj = json.loads(payload)
+                        if "error" in obj and not obj.get("choices"):
+                            break  # router gave up: lost work
+                        now = time.monotonic()
+                        if last is None:
+                            # Queue wait under deliberate overload is
+                            # the scale-up signal, reported as TTFT;
+                            # the STALL bound judges mid-stream
+                            # blackouts (kills, drains, migrations).
+                            ttfts.append(now - req_t0)
+                        else:
+                            worst_gap = max(worst_gap, now - last)
+                        last = now
+                        for ch in obj.get("choices") or ():
+                            toks += ch.get("vdt_token_ids") or []
+                    stalls.append(worst_gap)
+                    if not finished:
+                        stats["lost"] += 1
+                    elif toks != expected:
+                        stats["mismatches"] += 1
+                        print(
+                            f"{tag}: TOKEN MISMATCH {toks} != {expected}",
+                            file=sys.stderr,
+                        )
+                    else:
+                        stats["completed"] += 1
+            except Exception as e:  # noqa: BLE001 — an admitted stream erroring out IS lost work
+                stats["lost"] += 1
+                print(f"{tag}: stream error {e}", file=sys.stderr)
+
+        async def sampler(stop: "asyncio.Event") -> None:
+            while not stop.is_set():
+                timeline.append(
+                    {
+                        "mono": round(time.monotonic(), 2),
+                        "target": manager.target,
+                        "ready": manager.ready_count(),
+                    }
+                )
+                await asyncio.sleep(0.2)
+
+        async def chaos(stop: "asyncio.Event") -> None:
+            """SIGKILL a serving replica while a scale-up is still
+            warming (fallback: once a survivor exists), exactly once."""
+            while not stop.is_set():
+                ready = manager.ready_count()
+                starting = any(
+                    r.state == "starting" for r in manager.replicas
+                )
+                if ready >= 2 and (starting or manager.target >= 3):
+                    victims = [
+                        r for r in manager.replicas if r.state == "ready"
+                    ]
+                    victim = victims[0]
+                    kill_info.update(
+                        {
+                            "replica_id": victim.replica_id,
+                            "mono": round(time.monotonic(), 2),
+                            "during_scale_event": starting,
+                            "fleet_ready_at_kill": ready,
+                        }
+                    )
+                    victim.handle.kill()
+                    return
+                await asyncio.sleep(0.1)
+
+        async with aiohttp.ClientSession() as session:
+            # Wait out the first warmup: the ramp measures resize
+            # behavior, not cold boot.
+            deadline = time.monotonic() + 90
+            while manager.ready_count() < 1:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("first replica never became ready")
+                await asyncio.sleep(0.1)
+            stop = asyncio.Event()
+            aux = [
+                asyncio.ensure_future(sampler(stop)),
+            ]
+            if kill_mid_resize:
+                aux.append(asyncio.ensure_future(chaos(stop)))
+            rng = random.Random(1234)
+            tasks: list = []
+            idx = 0
+            try:
+                for rate, dur in segments:
+                    seg_t0 = time.monotonic()
+                    while True:
+                        remaining = dur - (time.monotonic() - seg_t0)
+                        if remaining <= 0:
+                            break
+                        if rate <= 0:
+                            await asyncio.sleep(remaining)
+                            break
+                        stats["offered"] += 1
+                        tasks.append(
+                            asyncio.ensure_future(
+                                one_stream(session, f"ramp-{idx}")
+                            )
+                        )
+                        idx += 1
+                        await asyncio.sleep(
+                            min(rng.expovariate(rate), remaining)
+                        )
+                if tasks:
+                    await asyncio.wait_for(
+                        asyncio.gather(*tasks), timeout=240
+                    )
+                # Let the autoscaler walk the fleet back to min over
+                # the idle tail (bounded).
+                settle_deadline = time.monotonic() + (
+                    3 * down_cooldown + 10
+                )
+                while (
+                    manager.target > 1
+                    or manager.ready_count() > 1
+                    or len(manager.active()) > 1
+                ):
+                    if time.monotonic() > settle_deadline:
+                        break
+                    await asyncio.sleep(0.2)
+                timeline.append(
+                    {
+                        "mono": round(time.monotonic(), 2),
+                        "target": manager.target,
+                        "ready": manager.ready_count(),
+                    }
+                )
+            finally:
+                stop.set()
+                for t in aux:
+                    t.cancel()
+            events = list(manager.events)
+            decisions = list(autoscaler.decisions)
+            final = {
+                "target": manager.target,
+                "ready": manager.ready_count(),
+            }
+        await router_runner.cleanup()  # drains + reaps the fleet
+        return {
+            "events": events,
+            "decisions": decisions,
+            "final": final,
+            "leaked": launcher.leaked(),
+        }
+
+    try:
+        out = asyncio.new_event_loop().run_until_complete(go())
+        events = out["events"]
+        # Drain-before-stop ordering: every replica that ever served
+        # (has a "ready" event) and was stopped by the manager must
+        # show a "drain" event before its "stopped" event.  Crashed
+        # replicas (the SIGKILL chaos) never get a "stopped" event —
+        # they get "crash" — so they don't relax the invariant.
+        ready_ids = {
+            e["replica_id"] for e in events if e["kind"] == "ready"
+        }
+        drained_before_stop = True
+        drained_ids = set()
+        for e in events:
+            if e["kind"] == "drain":
+                drained_ids.add(e["replica_id"])
+            elif e["kind"] == "stopped" and e["replica_id"] in ready_ids:
+                if e["replica_id"] not in drained_ids:
+                    drained_before_stop = False
+        max_ready = max((s["ready"] for s in timeline), default=0)
+        scaled_up = any(
+            e["kind"] == "scale" and e["to"] > e["from_target"]
+            for e in events
+        )
+        scaled_down = any(
+            e["kind"] == "scale" and e["to"] < e["from_target"]
+            for e in events
+        )
+        report = {
+            "mode": "fleet_ramp",
+            "ramp": ramp,
+            "max_replicas": max_replicas,
+            **stats,
+            "kill": kill_info or None,
+            "max_ready_observed": max_ready,
+            "final": out["final"],
+            "scaled_up": scaled_up,
+            "scaled_down": scaled_down,
+            "drained_before_stop": drained_before_stop,
+            "restarts_total": len(
+                [e for e in events if e["kind"] == "crash"]
+            ),
+            "decisions": out["decisions"],
+            "leaked_children": out["leaked"],
+            "stall_seconds": {
+                "p50": round(_percentile(stalls, 0.5), 3),
+                "max": round(max(stalls), 3) if stalls else 0.0,
+            },
+            "ttft_seconds": {
+                "p50": round(_percentile(ttfts, 0.5), 3),
+                "p99": round(_percentile(ttfts, 0.99), 3),
+                "max": round(max(ttfts), 3) if ttfts else 0.0,
+            },
+            # The acceptance contract (ISSUE 13): no admitted stream
+            # lost or corrupted through any resize or the mid-resize
+            # kill; the fleet followed the ramp up AND down within
+            # bounds; every scale-down drained first; no child leaked.
+            # When the kill is armed it must have actually FIRED — a
+            # sweep that never reached the chaos window proved nothing
+            # about the resize-kill collision and must not pass.
+            "bounded": (
+                stats["lost"] == 0
+                and stats["mismatches"] == 0
+                and scaled_up
+                and scaled_down
+                and max_ready <= max_replicas
+                and drained_before_stop
+                and not out["leaked"]
+                and (not kill_mid_resize or bool(kill_info))
+                and (not stalls or max(stalls) <= stall_bound_s)
+            ),
+        }
+        return report
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--cycles", type=int, default=5)
@@ -757,7 +1145,44 @@ def main() -> None:
         choices=["affinity", "least_loaded", "round_robin"],
         help="router placement policy for --replicas mode",
     )
+    parser.add_argument(
+        "--ramp",
+        type=str,
+        nargs="?",
+        const="5:6,14:12,2:6,0:10",
+        default=None,
+        metavar="R1:S1,R2:S2,...",
+        help="ISSUE 13 resize-chaos ramp mode: an AUTOSCALED fleet of "
+        "managed mock replicas under this piecewise Poisson rate "
+        "sweep, with a SIGKILL mid-resize — asserts zero lost "
+        "admitted work, zero token mismatches, drain-before-stop on "
+        "every scale-down, and the replica count following the ramp "
+        "up and down (default sweep when the flag is bare)",
+    )
+    parser.add_argument(
+        "--ramp-max-replicas",
+        type=int,
+        default=3,
+        help="autoscaler ceiling for --ramp mode",
+    )
+    parser.add_argument(
+        "--no-kill",
+        action="store_true",
+        help="--ramp mode: skip the mid-resize SIGKILL (pure "
+        "autoscale acceptance run)",
+    )
     args = parser.parse_args()
+    if args.ramp is not None:
+        report = run_fleet_ramp(
+            max_replicas=args.ramp_max_replicas,
+            ramp=args.ramp,
+            max_tokens=args.max_tokens,
+            kill_mid_resize=not args.no_kill,
+        )
+        print(json.dumps(report))
+        if not report["bounded"]:
+            sys.exit(1)
+        return
     if args.replicas > 1:
         report = run_router_soak(
             replicas=args.replicas,

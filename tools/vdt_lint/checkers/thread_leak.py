@@ -1,4 +1,5 @@
-"""VDT005 thread-leak: threads are daemons or joined on shutdown.
+"""VDT005 thread-leak: threads are daemons or joined; child processes
+are reaped.
 
 The PR 3 leak class: a non-daemon thread with no reachable ``join()``
 keeps the process alive after the engine is torn down (chaos-soak's
@@ -6,6 +7,14 @@ no-leaked-threads assertion exists because this bit us).  Every
 ``threading.Thread`` must either be created ``daemon=True`` or have a
 ``.join(...)`` on its binding somewhere in the same file (the shutdown
 path), mirroring ``MultiHostExecutor._teardown``'s loop-thread join.
+
+ISSUE 13 extends the same invariant to child PROCESSES: a
+``subprocess.Popen`` / ``multiprocessing.Process`` with no reachable
+``.wait(...)`` / ``.join(...)`` (and, for multiprocessing, no
+``daemon=True``) is an orphanable child — unreaped, it lingers as a
+zombie holding its port, exactly what the router fleet's synchronous
+reap exists to prevent.  Whether those waits are deadline-BOUNDED is
+VDT003's half of the contract.
 """
 
 from __future__ import annotations
@@ -17,6 +26,16 @@ from tools.vdt_lint.astutil import dotted_name
 from tools.vdt_lint.core import Checker, FileContext, Finding, register
 
 _THREAD_TARGETS = {"threading.Thread", "Thread"}
+# Child-process constructors: same binding discipline, zombie-shaped
+# consequence.  Popen has no daemon concept (daemon= on it would be a
+# TypeError anyway, so sharing the daemon check is harmless).
+_PROCESS_TARGETS = {
+    "subprocess.Popen",
+    "Popen",
+    "multiprocessing.Process",
+    "mp.Process",
+    "Process",
+}
 
 
 def _binding_of(call: ast.Call, parents: dict[int, ast.AST]) -> str | None:
@@ -60,16 +79,26 @@ class ThreadLeakChecker(Checker):
                 parents[id(child)] = node
 
         joined: set[str] = set()
+        reaped: set[str] = set()
         daemonized: set[str] = set()
+        # Popen used as a context manager reaps on __exit__.
+        in_with: set[int] = set()
         for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    in_with.add(id(item.context_expr))
             if (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "join"
+                and node.func.attr in ("join", "wait", "communicate")
             ):
                 name = dotted_name(node.func.value)
                 if name is not None:
-                    joined.add(name)
+                    # communicate() waits the child too (its timeout
+                    # discipline is VDT003's half, like wait/join).
+                    reaped.add(name)
+                    if node.func.attr == "join":
+                        joined.add(name)
             if isinstance(node, ast.Assign):
                 for t in node.targets:
                     if isinstance(t, ast.Attribute) and t.attr == "daemon":
@@ -83,7 +112,14 @@ class ThreadLeakChecker(Checker):
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
-            if dotted_name(node.func) not in _THREAD_TARGETS:
+            target = dotted_name(node.func)
+            if target in _THREAD_TARGETS:
+                kind = "thread"
+            elif target in _PROCESS_TARGETS:
+                if id(node) in in_with:
+                    continue  # `with Popen(...)` reaps on __exit__
+                kind = "process"
+            else:
                 continue
             daemon_kw = next(
                 (kw for kw in node.keywords if kw.arg == "daemon"), None
@@ -94,16 +130,33 @@ class ThreadLeakChecker(Checker):
             ):
                 continue
             binding = _binding_of(node, parents)
+            cleaned = joined if kind == "thread" else reaped
             if binding is not None and (
-                binding in joined or binding in daemonized
+                binding in cleaned or binding in daemonized
             ):
                 continue
-            where = (
-                f"`{binding}`" if binding is not None else "an unbound thread"
-            )
-            yield ctx.finding(
-                self,
-                node,
-                f"Thread bound to {where} is neither daemon=True nor "
-                "joined in this file — it outlives shutdown",
-            )
+            if kind == "thread":
+                where = (
+                    f"`{binding}`"
+                    if binding is not None
+                    else "an unbound thread"
+                )
+                yield ctx.finding(
+                    self,
+                    node,
+                    f"Thread bound to {where} is neither daemon=True "
+                    "nor joined in this file — it outlives shutdown",
+                )
+            else:
+                where = (
+                    f"`{binding}`"
+                    if binding is not None
+                    else "an unbound child process"
+                )
+                yield ctx.finding(
+                    self,
+                    node,
+                    f"child process bound to {where} has no reachable "
+                    "wait()/join() in this file — unreaped, it lingers "
+                    "as a zombie holding its port",
+                )

@@ -427,6 +427,16 @@ class RouterArgs:
     connect_timeout: float | None = None
     read_timeout: float | None = None
     api_key: str | None = None
+    # Elastic fleet (ISSUE 13; default off — static --replica URLs
+    # behave exactly as before): spawn and supervise this many managed
+    # `vdt serve` replicas from the --fleet-cmd template.
+    fleet_size: int = 0
+    fleet_cmd: str | None = None  # None -> $VDT_FLEET_CMD
+    # Arm the autoscaler control loop over the managed fleet
+    # (min/max None -> $VDT_AUTOSCALE_MIN/MAX_REPLICAS).
+    autoscale: bool = False
+    autoscale_min: int | None = None
+    autoscale_max: int | None = None
 
     @staticmethod
     def add_cli_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
@@ -486,6 +496,38 @@ class RouterArgs:
             "bounds how long a silent replica stalls a stream before "
             "migration (default: $VDT_ROUTER_READ_TIMEOUT_SECONDS or "
             "600)",
+        )
+        parser.add_argument(
+            "--fleet-size", type=int, default=0,
+            help="spawn and supervise this many managed `vdt serve` "
+            "replicas as child processes (health-gated warmup, "
+            "drain-before-terminate scale-down, crash-loop restarts); "
+            "0 = static --replica URLs only",
+        )
+        parser.add_argument(
+            "--fleet-cmd", type=str, default=None,
+            help="command template for managed replicas with {port} "
+            "(and optional {replica_id}) placeholders, e.g. "
+            "'vdt serve MODEL --host 127.0.0.1 --port {port}' "
+            "(default: $VDT_FLEET_CMD)",
+        )
+        parser.add_argument(
+            "--autoscale", action="store_true", default=False,
+            help="arm the autoscaler control loop: hold the managed "
+            "replica count to the traffic (queue-depth watermarks "
+            "with hysteresis, optional 429-rate and fleet-ITL-p99 "
+            "triggers, per-direction cooldowns) within "
+            "[--autoscale-min, --autoscale-max]",
+        )
+        parser.add_argument(
+            "--autoscale-min", type=int, default=None,
+            help="autoscaler floor (default: "
+            "$VDT_AUTOSCALE_MIN_REPLICAS or 1)",
+        )
+        parser.add_argument(
+            "--autoscale-max", type=int, default=None,
+            help="autoscaler ceiling (default: "
+            "$VDT_AUTOSCALE_MAX_REPLICAS or 4)",
         )
         return parser
 

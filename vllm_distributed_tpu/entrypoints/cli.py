@@ -119,6 +119,17 @@ def make_parser() -> argparse.ArgumentParser:
         "pollute the latency percentiles",
     )
     bench.add_argument(
+        "--ramp",
+        type=str,
+        default=None,
+        metavar="R1:S1,R2:S2,...",
+        help="serve mode: piecewise OPEN-LOOP Poisson arrival schedule "
+        "— run at R1 req/s for S1 seconds, then R2 for S2, ... "
+        "(sweep the rate up and down to exercise an autoscaled "
+        "fleet).  Client p50/p99 and rejected/timed-out counts are "
+        "reported per segment; --num-prompts is ignored",
+    )
+    bench.add_argument(
         "--deadline-ms",
         type=int,
         default=None,
@@ -301,10 +312,14 @@ async def _router_async(args: argparse.Namespace) -> None:
     configure_from_env(host="router")
     router_args = RouterArgs.from_cli_args(args)
     urls = router_args.resolved_replicas()
-    if not urls:
+    from vllm_distributed_tpu import envs
+
+    fleet_on = router_args.fleet_size > 0 or router_args.autoscale
+    if not urls and not fleet_on:
         raise SystemExit(
-            "router needs replicas: pass --replica URL (repeatable) or "
-            "set VDT_ROUTER_REPLICAS"
+            "router needs replicas: pass --replica URL (repeatable), "
+            "set VDT_ROUTER_REPLICAS, or enable the managed fleet "
+            "(--fleet-size/--autoscale with --fleet-cmd)"
         )
     state = RouterState(
         urls,
@@ -317,31 +332,122 @@ async def _router_async(args: argparse.Namespace) -> None:
         connect_timeout=router_args.connect_timeout,
         read_timeout=router_args.read_timeout,
         api_key=args.api_key,
+        allow_empty_pool=fleet_on,
     )
+    if fleet_on:
+        # Elastic fleet (ISSUE 13): the router owns `vdt serve`
+        # replicas as supervised children, optionally resized by the
+        # autoscaler control loop.
+        from vllm_distributed_tpu.router.app import _fleet_slo
+        from vllm_distributed_tpu.router.fleet import (
+            Autoscaler,
+            AutoscalerConfig,
+            CommandLauncher,
+            ReplicaManager,
+        )
+
+        template = router_args.fleet_cmd or envs.VDT_FLEET_CMD
+        if not template:
+            raise SystemExit(
+                "fleet mode needs a replica command template: pass "
+                "--fleet-cmd 'vdt serve ... --port {port}' or set "
+                "VDT_FLEET_CMD"
+            )
+        autoscaler = None
+        cfg = None
+        if router_args.autoscale:
+            cfg = AutoscalerConfig.from_env()
+            if router_args.autoscale_min is not None:
+                cfg.min_replicas = router_args.autoscale_min
+            if router_args.autoscale_max is not None:
+                cfg.max_replicas = router_args.autoscale_max
+        target = router_args.fleet_size or (
+            cfg.min_replicas if cfg is not None else 0
+        )
+        manager = ReplicaManager(
+            state.pool,
+            state.metrics,
+            CommandLauncher(template),
+            target=target,
+        )
+        if cfg is not None:
+
+            async def _slo_classes() -> dict:
+                return (await _fleet_slo(state)).get("classes", {})
+
+            autoscaler = Autoscaler(
+                manager,
+                state.pool,
+                state.metrics,
+                cfg,
+                slo_probe=_slo_classes,
+            )
+        state.attach_fleet(manager, autoscaler)
     app = build_router_app(state)
     runner = await serve_http(app, host=args.host, port=args.port)
-    logger.info(
-        "router fronting %d replica(s) with policy=%s: %s",
-        len(urls),
-        state.policy,
-        ", ".join(urls),
-    )
+    if fleet_on:
+        logger.info(
+            "router managing a fleet of %d replica(s)%s (template: %s)",
+            state.manager.target,
+            " with autoscaling" if state.autoscaler is not None else "",
+            router_args.fleet_cmd or envs.VDT_FLEET_CMD,
+        )
+    if urls:
+        logger.info(
+            "router fronting %d replica(s) with policy=%s: %s",
+            len(urls),
+            state.policy,
+            ", ".join(urls),
+        )
     stop = asyncio.Event()
+    sigterm_seen = False
 
-    def _on_signal() -> None:
+    def _on_sigterm() -> None:
+        # Graceful fleet drain on SIGTERM (ISSUE 13 satellite, parity
+        # with the replica-side SIGTERM drain from ISSUE 8): drain
+        # every MANAGED replica (bounded by
+        # VDT_FLEET_DRAIN_TIMEOUT_SECONDS) and reap every child before
+        # exit, so a router kill never leaks `vdt serve` processes.  A
+        # second SIGTERM (or SIGINT) skips the wait; children are still
+        # reaped by the runner cleanup below.
+        nonlocal sigterm_seen
+        if stop.is_set():
+            return
+        if sigterm_seen or state.manager is None:
+            stop.set()
+            return
+        sigterm_seen = True
+
+        async def _drain_and_stop() -> None:
+            try:
+                await state.manager.stop(drain=True)
+            except Exception:  # noqa: BLE001 — drain is best-effort; cleanup still reaps
+                logger.exception("fleet drain on SIGTERM failed")
+            finally:
+                stop.set()
+
+        logger.warning("SIGTERM: draining managed fleet before shutdown")
+        asyncio.get_running_loop().create_task(_drain_and_stop())
+
+    def _on_sigint() -> None:
         stop.set()
 
     import signal
 
     loop = asyncio.get_running_loop()
-    for sig in (signal.SIGTERM, signal.SIGINT):
+    for sig, handler in (
+        (signal.SIGTERM, _on_sigterm),
+        (signal.SIGINT, _on_sigint),
+    ):
         try:
-            loop.add_signal_handler(sig, _on_signal)
+            loop.add_signal_handler(sig, handler)
         except (NotImplementedError, RuntimeError):
             pass
     try:
         await stop.wait()
     finally:
+        # _on_cleanup stops the autoscaler and the manager (idempotent
+        # if SIGTERM already drained) — all children reaped either way.
         await runner.cleanup()
 
 
@@ -350,6 +456,35 @@ def cmd_router(args: argparse.Namespace) -> None:
 
 
 # ---- bench ----
+def parse_ramp(spec: str) -> list[tuple[float, float]]:
+    """Parse a piecewise arrival schedule: ``"r1:s1,r2:s2,..."`` →
+    ``[(rate_rps, seconds), ...]``.  A zero rate is an idle dwell
+    (useful as the settle tail of an autoscale-down assertion).  Shared
+    by bench-serve ``--ramp`` and the chaos ramp harness
+    (tools/chaos_soak.py)."""
+    segments: list[tuple[float, float]] = []
+    for piece in spec.split(","):
+        piece = piece.strip()
+        if not piece:
+            continue
+        rate_s, sep, dur_s = piece.partition(":")
+        try:
+            if not sep:
+                raise ValueError
+            rate, dur = float(rate_s), float(dur_s)
+            if rate < 0 or dur <= 0:
+                raise ValueError
+        except ValueError:
+            raise SystemExit(
+                f"bad --ramp segment {piece!r}: want RATE:SECONDS with "
+                "RATE >= 0 and SECONDS > 0"
+            )
+        segments.append((rate, dur))
+    if not segments:
+        raise SystemExit("--ramp needs at least one RATE:SECONDS segment")
+    return segments
+
+
 def _percentiles(xs: list[float]) -> dict:
     xs = sorted(xs)
 
@@ -377,6 +512,28 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
     # percentiles of the requests the server actually served.
     request_rate = getattr(args, "request_rate", None)
     counts = {"completed": 0, "rejected": 0, "timed_out": 0, "errors": 0}
+
+    # Piecewise rate sweep (ISSUE 13): open-loop segments with
+    # per-segment accounting, the workload an autoscaler acceptance run
+    # (and the chaos ramp harness) is judged against.
+    ramp = getattr(args, "ramp", None)
+    ramp_segments = parse_ramp(ramp) if ramp else None
+    if ramp_segments and request_rate is not None:
+        raise SystemExit("--ramp and --request-rate are mutually exclusive")
+    seg_stats: list[dict] = [
+        {
+            "rate_rps": rate,
+            "seconds": dur,
+            "offered": 0,
+            "completed": 0,
+            "rejected": 0,
+            "timed_out": 0,
+            "errors": 0,
+            "ttfts": [],
+            "itls": [],
+        }
+        for rate, dur in (ramp_segments or ())
+    ]
 
     # Per-class request mix (ISSUE 12): "name[:weight]" entries expand
     # into a deterministic assignment pattern so the same command line
@@ -473,7 +630,7 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
     shared_prefix_len = getattr(args, "shared_prefix_len", 0) or 0
     shared_prefix = [(7 * j) % 900 + 1 for j in range(shared_prefix_len)]
 
-    async def drive_one(session, i: int) -> None:
+    async def drive_one(session, i: int, seg: dict | None = None) -> None:
         nonlocal out_tokens
         prompt = shared_prefix + [
             (13 * i + j) % 900 + 1 for j in range(args.input_len)
@@ -507,6 +664,8 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                     # Load shed: an accounted outcome, not an error and
                     # not a latency sample.
                     counts["rejected"] += 1
+                    if seg is not None:
+                        seg["rejected"] += 1
                     await resp.read()
                     return
                 resp.raise_for_status()
@@ -542,15 +701,21 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                         chunk_times.append(time.perf_counter())
         except Exception:  # noqa: BLE001 — bench client: count, move on
             counts["errors"] += 1
+            if seg is not None:
+                seg["errors"] += 1
             return
         if finish_reason in ("timeout", "overloaded"):
             # Deadline/pressure shed mid-generation: partial output —
             # keep it out of the completed-latency distribution too.
             counts["timed_out"] += 1
+            if seg is not None:
+                seg["timed_out"] += 1
             if slo_class is not None:
                 per_class[slo_class]["shed"] += 1
             return
         counts["completed"] += 1
+        if seg is not None:
+            seg["completed"] += 1
         if slo_class is not None:
             per_class[slo_class]["completed"] += 1
         if chunk_times:
@@ -565,17 +730,21 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                 span = chunk_times[-1] - chunk_times[0]
                 itl = span / (got_tokens - 1)
                 itls.append(itl)
+            if seg is not None:
+                seg["ttfts"].append(ttft)
+                if itl is not None:
+                    seg["itls"].append(itl)
             if slo_class is not None:
                 per_class[slo_class]["ttfts"].append(ttft)
                 if itl is not None:
                     per_class[slo_class]["itls"].append(itl)
 
-    async def one(session, i: int) -> None:
-        if request_rate is not None:
+    async def one(session, i: int, seg: dict | None = None) -> None:
+        if request_rate is not None or seg is not None:
             # Open loop: arrivals don't wait for departures — offered
             # load is what the operator configured, not what the
             # server can absorb.
-            await drive_one(session, i)
+            await drive_one(session, i, seg)
         else:
             async with sem:
                 await drive_one(session, i)
@@ -584,7 +753,34 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
     async with aiohttp.ClientSession(timeout=timeout) as session:
         before, slo_before = await scrape_metrics(session)
         t0 = time.perf_counter()
-        if request_rate is not None:
+        if ramp_segments is not None:
+            import random
+
+            rng = random.Random(12345)  # reproducible arrival process
+            tasks = []
+            i = 0
+            for seg in seg_stats:
+                seg_t0 = time.perf_counter()
+                rate, dur = seg["rate_rps"], seg["seconds"]
+                while True:
+                    remaining = dur - (time.perf_counter() - seg_t0)
+                    if remaining <= 0:
+                        break
+                    if rate <= 0:
+                        # Idle dwell: no arrivals, just hold the clock
+                        # (the settle tail of a scale-down assertion).
+                        await asyncio.sleep(remaining)
+                        break
+                    seg["offered"] += 1
+                    tasks.append(
+                        asyncio.create_task(one(session, i, seg))
+                    )
+                    i += 1
+                    await asyncio.sleep(
+                        min(rng.expovariate(rate), remaining)
+                    )
+            await asyncio.gather(*tasks)
+        elif request_rate is not None:
             import random
 
             rng = random.Random(12345)  # reproducible arrival process
@@ -600,18 +796,25 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
         elapsed = time.perf_counter() - t0
         after, slo_after = await scrape_metrics(session)
 
+    total_requests = (
+        sum(s["offered"] for s in seg_stats)
+        if ramp_segments is not None
+        else args.num_prompts
+    )
     result = {
         "mode": "serve",
         "url": url,
-        "num_prompts": args.num_prompts,
+        "num_prompts": total_requests,
         "concurrency": (
-            args.concurrency if request_rate is None else None
+            args.concurrency
+            if request_rate is None and ramp_segments is None
+            else None
         ),
         "input_len": args.input_len,
         "output_len": args.output_len,
         "elapsed_s": round(elapsed, 3),
         "output_tokens_per_s": round(out_tokens / elapsed, 1),
-        "requests_per_s": round(args.num_prompts / elapsed, 3),
+        "requests_per_s": round(total_requests / elapsed, 3),
         # Latency percentiles cover COMPLETED requests only; sheds are
         # reported in outcomes below.
         "ttft_s": _percentiles(ttfts) if ttfts else None,
@@ -625,6 +828,32 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
     if request_rate is not None:
         result["offered_rps"] = request_rate
         result["arrival_process"] = "poisson"
+    if ramp_segments is not None:
+        # Per-segment readout: the rate sweep with each segment's
+        # client-side percentiles and shed accounting — what the
+        # autoscaler acceptance run (and the chaos ramp harness) judge.
+        result["arrival_process"] = "poisson_ramp"
+        result["ramp"] = [
+            {
+                "rate_rps": s["rate_rps"],
+                "seconds": s["seconds"],
+                "offered": s["offered"],
+                "completed": s["completed"],
+                "rejected": s["rejected"],
+                "timed_out": s["timed_out"],
+                "errors": s["errors"],
+                "ttft_s": _percentiles(s["ttfts"]) if s["ttfts"] else None,
+                "itl_ms": (
+                    {
+                        k: round(v * 1e3, 3)
+                        for k, v in _percentiles(s["itls"]).items()
+                    }
+                    if s["itls"]
+                    else None
+                ),
+            }
+            for s in seg_stats
+        ]
     if per_class:
         # Per-class attainment readout (ISSUE 12): client percentiles
         # plus the server's own goodput judgment over the run window.
@@ -667,7 +896,7 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                     4,
                 )
             result["per_class"][cls] = entry
-    if itls and request_rate is None:
+    if itls and request_rate is None and ramp_segments is None:
         # The dispatch tax as the CLIENT sees it (ISSUE 7): throughput
         # implied by the p50 inter-token pace at this concurrency minus
         # the wall-clock throughput.  ~0 when the driver holds the p50

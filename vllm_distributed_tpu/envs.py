@@ -211,6 +211,83 @@ environment_variables: dict[str, Callable[[], Any]] = {
     "VDT_ROUTER_READ_TIMEOUT_SECONDS": lambda: float(
         os.environ.get("VDT_ROUTER_READ_TIMEOUT_SECONDS", "600")
     ),
+    # --- elastic fleet (ISSUE 13) ---
+    # Command template the router's ReplicaManager launches managed
+    # replicas with ({port} and {replica_id} placeholders, e.g.
+    # "vdt serve MODEL --host 127.0.0.1 --port {port}").  Empty = fleet
+    # mode needs --fleet-cmd.
+    "VDT_FLEET_CMD": lambda: os.environ.get("VDT_FLEET_CMD", ""),
+    # Health-gated warmup: how long a freshly spawned replica may take
+    # to answer /health 200 before the spawn counts as a crash.  A
+    # replica is never routable before its first healthy answer.
+    "VDT_FLEET_WARMUP_TIMEOUT_SECONDS": lambda: float(
+        os.environ.get("VDT_FLEET_WARMUP_TIMEOUT_SECONDS", "120")
+    ),
+    # Scale-down drain bound: how long the manager waits for a
+    # replica's /drain (journal-migration of its in-flight streams)
+    # before terminating it anyway.  Also bounds the router's SIGTERM
+    # drain of the whole managed fleet.
+    "VDT_FLEET_DRAIN_TIMEOUT_SECONDS": lambda: float(
+        os.environ.get("VDT_FLEET_DRAIN_TIMEOUT_SECONDS", "30")
+    ),
+    # Reconcile/crash-poll cadence of the fleet supervisor loop.
+    "VDT_FLEET_CHECK_INTERVAL_SECONDS": lambda: float(
+        os.environ.get("VDT_FLEET_CHECK_INTERVAL_SECONDS", "0.5")
+    ),
+    # Crash-loop policy, mirroring the PR 3 engine supervisor: at most
+    # this many restarts within the window (0 = never restart a crashed
+    # replica), with exponential backoff between attempts.
+    "VDT_FLEET_MAX_RESTARTS": lambda: int(
+        os.environ.get("VDT_FLEET_MAX_RESTARTS", "3")
+    ),
+    "VDT_FLEET_RESTART_WINDOW_SECONDS": lambda: float(
+        os.environ.get("VDT_FLEET_RESTART_WINDOW_SECONDS", "300")
+    ),
+    "VDT_FLEET_RESTART_BACKOFF_SECONDS": lambda: float(
+        os.environ.get("VDT_FLEET_RESTART_BACKOFF_SECONDS", "1")
+    ),
+    "VDT_FLEET_RESTART_BACKOFF_CAP_SECONDS": lambda: float(
+        os.environ.get("VDT_FLEET_RESTART_BACKOFF_CAP_SECONDS", "30")
+    ),
+    # --- autoscaler (ISSUE 13; --autoscale arms the loop) ---
+    # Control-loop tick interval and replica-count bounds.
+    "VDT_AUTOSCALE_INTERVAL_SECONDS": lambda: float(
+        os.environ.get("VDT_AUTOSCALE_INTERVAL_SECONDS", "5")
+    ),
+    "VDT_AUTOSCALE_MIN_REPLICAS": lambda: int(
+        os.environ.get("VDT_AUTOSCALE_MIN_REPLICAS", "1")
+    ),
+    "VDT_AUTOSCALE_MAX_REPLICAS": lambda: int(
+        os.environ.get("VDT_AUTOSCALE_MAX_REPLICAS", "4")
+    ),
+    # Hysteresis watermarks on the primary signal (mean waiting-queue
+    # depth per routable replica, the PR 7 admission gauge the pool
+    # already scrapes): scale up above the high mark, down below the
+    # low mark, hold in between.
+    "VDT_AUTOSCALE_UP_WAITING": lambda: float(
+        os.environ.get("VDT_AUTOSCALE_UP_WAITING", "4")
+    ),
+    "VDT_AUTOSCALE_DOWN_WAITING": lambda: float(
+        os.environ.get("VDT_AUTOSCALE_DOWN_WAITING", "1")
+    ),
+    # Per-direction cooldowns: no two scale-ups (downs) closer than
+    # this, so one burst can't slam the fleet to max and back.
+    "VDT_AUTOSCALE_UP_COOLDOWN_SECONDS": lambda: float(
+        os.environ.get("VDT_AUTOSCALE_UP_COOLDOWN_SECONDS", "15")
+    ),
+    "VDT_AUTOSCALE_DOWN_COOLDOWN_SECONDS": lambda: float(
+        os.environ.get("VDT_AUTOSCALE_DOWN_COOLDOWN_SECONDS", "60")
+    ),
+    # Secondary scale-up triggers (0 = off): fleet 429 rate (rejections
+    # per second over the tick window) and fleet ITL p99 (ms, from the
+    # ISSUE 12 /router/slo merge) above which the fleet grows even if
+    # queues look shallow.
+    "VDT_AUTOSCALE_MAX_REJECT_RATE": lambda: float(
+        os.environ.get("VDT_AUTOSCALE_MAX_REJECT_RATE", "0")
+    ),
+    "VDT_AUTOSCALE_ITL_P99_MS": lambda: float(
+        os.environ.get("VDT_AUTOSCALE_ITL_P99_MS", "0")
+    ),
     # --- observability ---
     # SLO targets for goodput accounting (engine/slo.py, ISSUE 12), in
     # milliseconds.  A bare number sets the "default" class; per-class:
@@ -335,6 +412,26 @@ NON_REPLICATED_ENV_VARS = {
     "VDT_ROUTER_MAX_MIGRATIONS",
     "VDT_ROUTER_CONNECT_TIMEOUT_SECONDS",
     "VDT_ROUTER_READ_TIMEOUT_SECONDS",
+    # Fleet lifecycle + autoscaler knobs configure the ROUTER process's
+    # control loops; replicating them to engine workers (or to the
+    # managed replicas themselves) would be meaningless.
+    "VDT_FLEET_CMD",
+    "VDT_FLEET_WARMUP_TIMEOUT_SECONDS",
+    "VDT_FLEET_DRAIN_TIMEOUT_SECONDS",
+    "VDT_FLEET_CHECK_INTERVAL_SECONDS",
+    "VDT_FLEET_MAX_RESTARTS",
+    "VDT_FLEET_RESTART_WINDOW_SECONDS",
+    "VDT_FLEET_RESTART_BACKOFF_SECONDS",
+    "VDT_FLEET_RESTART_BACKOFF_CAP_SECONDS",
+    "VDT_AUTOSCALE_INTERVAL_SECONDS",
+    "VDT_AUTOSCALE_MIN_REPLICAS",
+    "VDT_AUTOSCALE_MAX_REPLICAS",
+    "VDT_AUTOSCALE_UP_WAITING",
+    "VDT_AUTOSCALE_DOWN_WAITING",
+    "VDT_AUTOSCALE_UP_COOLDOWN_SECONDS",
+    "VDT_AUTOSCALE_DOWN_COOLDOWN_SECONDS",
+    "VDT_AUTOSCALE_MAX_REJECT_RATE",
+    "VDT_AUTOSCALE_ITL_P99_MS",
 }
 
 # Extra vars replicated even though they are not VDT_* (launch.py:70-72).

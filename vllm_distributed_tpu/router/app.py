@@ -98,6 +98,7 @@ class RouterState:
         connect_timeout: float | None = None,
         read_timeout: float | None = None,
         api_key: str | None = None,
+        allow_empty_pool: bool = False,
     ) -> None:
         def _env(value, name):
             return getattr(envs, name) if value is None else value
@@ -124,6 +125,10 @@ class RouterState:
                 health_interval, "VDT_ROUTER_HEALTH_INTERVAL_SECONDS"
             ),
             connect_timeout=self.connect_timeout,
+            # Fleet mode (ISSUE 13) starts with an empty pool: the
+            # ReplicaManager populates it as spawned replicas pass
+            # their health-gated warmup.
+            allow_empty=allow_empty_pool,
         )
         self.index = PrefixAffinityIndex(
             block_tokens=_env(
@@ -137,6 +142,27 @@ class RouterState:
         self.request_counter = Counter()
         self._rr = 0
         self.session = None  # aiohttp.ClientSession, set on startup
+        # Elastic fleet (ISSUE 13): set by attach_fleet() before the
+        # app starts; None = static replica set, exactly the PR 8
+        # behavior.
+        self.manager = None  # router.fleet.ReplicaManager
+        self.autoscaler = None  # router.fleet.Autoscaler
+        # Pool-membership hygiene: when a replica leaves (scale-down,
+        # crash), its labeled series leave the router's exposition and
+        # its prefix-affinity chains are dropped — a departed replica's
+        # caches are gone, and a churning autoscaled fleet must not
+        # accumulate dead replicas' index state forever.
+        def _forget(replica) -> None:
+            self.metrics.forget_replica(replica.replica_id)
+            self.index.forget(replica.replica_id)
+
+        self.pool.on_remove.append(_forget)
+
+    def attach_fleet(self, manager, autoscaler=None) -> None:
+        """Install the fleet lifecycle layer; started on app startup
+        (the manager needs the router's client session)."""
+        self.manager = manager
+        self.autoscaler = autoscaler
 
     # ---- placement ----
     def place(
@@ -1005,15 +1031,68 @@ async def router_slo(request: web.Request) -> web.Response:
 async def router_state(request: web.Request) -> web.Response:
     """Introspection: pool snapshot, tally counters, affinity stats."""
     state: RouterState = request.app["router_state"]
+    body = {
+        "policy": state.policy,
+        "replicas": state.pool.snapshot(),
+        "counters": dict(state.metrics.counts),
+        "affinity_blocks": {
+            r.replica_id: state.index.num_blocks(r.replica_id)
+            for r in state.pool.replicas
+        },
+    }
+    if state.manager is not None:
+        body["fleet"] = {
+            "target": state.manager.target,
+            "ready": state.manager.ready_count(),
+            "exhausted": state.manager.exhausted,
+        }
+    return web.json_response(body)
+
+
+async def router_fleet(request: web.Request) -> web.Response:
+    """Fleet lifecycle introspection (ISSUE 13): managed replica state
+    machine, event log (spawn/ready/drain/stop/crash ordering — the
+    chaos harness asserts every scale-down drained first), restart
+    budget, and autoscaler decisions.  404 on a static router."""
+    state: RouterState = request.app["router_state"]
+    if state.manager is None:
+        return _error("fleet mode is not enabled on this router", 404)
+    body = state.manager.snapshot()
+    if state.autoscaler is not None:
+        body["autoscaler"] = state.autoscaler.snapshot()
+    return web.json_response(body)
+
+
+async def router_scale(request: web.Request) -> web.Response:
+    """Manual resize: ``POST /router/scale {"replicas": N}`` (or
+    ``?replicas=N``).  Sets the fleet target; the supervisor converges
+    — scale-ups health-gate before serving, scale-downs drain before
+    the process dies.  404 on a static router."""
+    state: RouterState = request.app["router_state"]
+    if state.manager is None:
+        return _error("fleet mode is not enabled on this router", 404)
+    raw = request.query.get("replicas")
+    if raw is None:
+        try:
+            body = await request.json()
+            raw = (body or {}).get("replicas")
+        except Exception:  # noqa: BLE001 — surfaced as the 400 below
+            raw = None
+    try:
+        n = int(raw)
+        if n < 0:
+            raise ValueError
+    except (TypeError, ValueError):
+        return _error(
+            "replicas must be a non-negative integer "
+            "(?replicas=N or JSON {\"replicas\": N})"
+        )
+    state.manager.scale_to(n, reason="manual")
     return web.json_response(
         {
-            "policy": state.policy,
-            "replicas": state.pool.snapshot(),
-            "counters": dict(state.metrics.counts),
-            "affinity_blocks": {
-                r.replica_id: state.index.num_blocks(r.replica_id)
-                for r in state.pool.replicas
-            },
+            "target": state.manager.target,
+            "ready": state.manager.ready_count(),
+            "active": len(state.manager.active()),
         }
     )
 
@@ -1051,10 +1130,21 @@ async def _on_startup(app: web.Application) -> None:
     # states to place against, then the steady poll loop.
     await state.pool.probe_all(state.session)
     state.pool.start(state.session)
+    if state.manager is not None:
+        state.manager.start(state.session)
+    if state.autoscaler is not None:
+        state.autoscaler.start()
 
 
 async def _on_cleanup(app: web.Application) -> None:
     state: RouterState = app["router_state"]
+    if state.autoscaler is not None:
+        await state.autoscaler.stop()
+    if state.manager is not None:
+        # Idempotent: if the CLI's SIGTERM handler already drained and
+        # reaped the managed fleet, this is a no-op sweep.  Children
+        # are ALWAYS reaped here — a router exit never leaks them.
+        await state.manager.stop(drain=True)
     await state.pool.stop()
     if state.session is not None:
         await state.session.close()
@@ -1088,6 +1178,8 @@ def build_router_app(state: RouterState) -> web.Application:
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/router/state", router_state)
     app.router.add_get("/router/slo", router_slo)
+    app.router.add_get("/router/fleet", router_fleet)
+    app.router.add_post("/router/scale", router_scale)
     app.router.add_get("/v1/models", list_models)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat_completions)

@@ -126,6 +126,30 @@ class RouterMetrics:
             ["replica_id"],
             registry=self.registry,
         )
+        # ---- elastic fleet (ISSUE 13) ----
+        self._fleet_size = Gauge(
+            "vdt_router:fleet_size",
+            "Managed replicas currently serving (health-gated ready)",
+            registry=self.registry,
+        )
+        self._fleet_target = Gauge(
+            "vdt_router:fleet_target",
+            "Replica-count target the fleet supervisor converges to",
+            registry=self.registry,
+        )
+        self._fleet_scale_events = Counter(
+            "vdt_router:fleet_scale_events",
+            "Fleet resizes by direction (up | down) and trigger "
+            "(manual | autoscale:<reason>)",
+            ["direction", "reason"],
+            registry=self.registry,
+        )
+        self._fleet_restarts = Counter(
+            "vdt_router:fleet_replica_restarts",
+            "Managed-replica deaths by cause (crash | warmup_failed)",
+            ["reason"],
+            registry=self.registry,
+        )
         # ---- fleet SLO/goodput (ISSUE 12): per-class gauges refreshed
         # from the associative merge of replica /slo views — the exact
         # series the autoscaler (ROADMAP item 5) scrapes.  slo_class is
@@ -177,6 +201,39 @@ class RouterMetrics:
         self.counts[f"placements.{policy}"] += 1
         if self.enabled:
             self._placements.labels(policy=policy).inc()
+
+    # ---- elastic fleet (ISSUE 13) ----
+    def record_scale(self, direction: str, reason: str) -> None:
+        self.counts[f"fleet.scale.{direction}"] += 1
+        if self.enabled:
+            self._fleet_scale_events.labels(
+                direction=direction, reason=reason
+            ).inc()
+
+    def record_fleet_restart(self, reason: str) -> None:
+        self.counts[f"fleet.restarts.{reason}"] += 1
+        if self.enabled:
+            self._fleet_restarts.labels(reason=reason).inc()
+
+    def update_fleet(self, manager) -> None:
+        self.counts["fleet.size"] = manager.ready_count()
+        self.counts["fleet.target"] = manager.target
+        if self.enabled:
+            self._fleet_size.set(manager.ready_count())
+            self._fleet_target.set(manager.target)
+
+    def forget_replica(self, replica_id: str) -> None:
+        """Membership hygiene: drop the per-replica series when a
+        replica leaves the pool, so a scaled-down id never lingers in
+        the router's own exposition (the merged replica expositions
+        drop out automatically — they iterate the live pool)."""
+        if not self.enabled:
+            return
+        for gauge in (self._replica_up, self._replica_waiting):
+            try:
+                gauge.remove(replica_id)
+            except KeyError:
+                pass
 
     def update_fleet_slo(self, classes: dict) -> None:
         """Refresh the fleet per-class gauges from one merged view

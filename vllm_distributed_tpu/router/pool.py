@@ -18,6 +18,7 @@ placement briefly, don't mark it down).
 from __future__ import annotations
 
 import asyncio
+import random
 import time
 from dataclasses import dataclass, field
 
@@ -112,21 +113,62 @@ class ReplicaPool:
         health_interval: float = 2.0,
         connect_timeout: float = 5.0,
         probe_timeout: float = 10.0,
+        allow_empty: bool = False,
     ) -> None:
-        seen: set[str] = set()
         self.replicas: list[Replica] = []
         for url in urls:
-            url = url.rstrip("/")
-            if url and url not in seen:
-                seen.add(url)
-                self.replicas.append(Replica(url=url))
-        if not self.replicas:
+            self.add(url)
+        if not self.replicas and not allow_empty:
             raise ValueError("router needs at least one replica URL")
         self.health_interval = health_interval
         self.connect_timeout = connect_timeout
         self.probe_timeout = probe_timeout
         self._task: asyncio.Task | None = None
         self._stopped = asyncio.Event()
+        # Membership hooks (the fleet layer and the metrics exporter
+        # subscribe): called with the Replica on every add/remove so
+        # per-replica series can be created/forgotten in lockstep with
+        # the pool — a scaled-down replica must not linger in the
+        # merged exposition.
+        self.on_remove: list = []
+
+    # ---- membership (ISSUE 13: replica count is a runtime variable) ----
+    def add(
+        self,
+        url: str,
+        *,
+        replica_id: str = "",
+        state: str = "unknown",
+    ) -> Replica | None:
+        """Add a replica URL (idempotent).  The fleet manager passes
+        ``state="healthy"`` after its health-gated warmup so a fresh
+        replica is routable immediately instead of waiting a poll tick.
+        """
+        url = url.rstrip("/")
+        if not url:
+            return None
+        existing = self.by_url(url)
+        if existing is not None:
+            return existing
+        replica = Replica(url=url, replica_id=replica_id, state=state)
+        self.replicas.append(replica)
+        return replica
+
+    def remove(self, url: str) -> Replica | None:
+        """Drop a replica from the pool.  After this returns, the
+        merged /metrics exposition and the /router/slo merge (both
+        iterate ``replicas``) no longer carry its rows; ``on_remove``
+        hooks let the metrics layer drop its labeled series too."""
+        replica = self.by_url(url)
+        if replica is None:
+            return None
+        self.replicas.remove(replica)
+        for hook in self.on_remove:
+            try:
+                hook(replica)
+            except Exception:  # noqa: BLE001 — membership hooks are advisory
+                logger.exception("pool on_remove hook failed")
+        return replica
 
     # ---- lookup ----
     def by_url(self, url: str) -> Replica | None:
@@ -236,14 +278,29 @@ class ReplicaPool:
                 "metrics scrape of %s failed: %s", replica.replica_id, e
             )
 
-    async def probe_all(self, session) -> None:
+    def _probe_jitter(self) -> float:
+        """Max per-replica probe delay: spread N probes over a fraction
+        of the poll interval so replicas aren't scraped in lockstep
+        bursts (N simultaneous /metrics renders every tick)."""
+        return min(self.health_interval * 0.25, 1.0)
+
+    async def probe_all(self, session, *, jitter: bool = True) -> None:
         # Each probe is internally deadline-bounded; the outer bound
         # just guarantees one wedged probe can't stall the poll loop.
+        span = self._probe_jitter() if jitter else 0.0
+
+        async def jittered(replica: Replica) -> None:
+            if span > 0:
+                await asyncio.sleep(random.uniform(0, span))
+            await self.probe(session, replica)
+
         await asyncio.wait_for(
             asyncio.gather(
-                *(self.probe(session, r) for r in self.replicas)
+                *(jittered(r) for r in list(self.replicas))
             ),
-            timeout=2 * (self.probe_timeout + self.connect_timeout) + 5,
+            timeout=(
+                2 * (self.probe_timeout + self.connect_timeout) + 5 + span
+            ),
         )
 
     def start(self, session) -> None:
