@@ -440,6 +440,16 @@ def _measure(engine, build, free_engine, *, batch, k_steps, quant,
         detail["prefix_cache_hit_rate"] = round(
             sched.prefix_cache_hits / sched.prefix_cache_queries, 4
         )
+        # Tiered KV (ISSUE 14): host-tier traffic over the run, when
+        # the spill tier is armed (VDT_KV_SPILL_HOST_PAGES > 0).
+        if getattr(sched, "kv_spill_pages", 0) or getattr(
+            sched, "kv_restore_pages", 0
+        ):
+            detail["kv_spill_pages"] = sched.kv_spill_pages
+            detail["kv_restore_pages"] = sched.kv_restore_pages
+            detail["prefix_cache_host_hit_tokens"] = (
+                sched.prefix_cache_hits_host
+            )
     if warm_engine_probe or prefill_probe:
         # Warm TTFT: a FRESH engine on the same shapes hits the
         # persistent caches this run just wrote (XLA disk cache + AOT

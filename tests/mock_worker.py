@@ -119,6 +119,27 @@ class MockWorker:
         # chips.  Same DeviceTelemetry ledger + snapshot wire format.
         self.telemetry = DeviceTelemetry()
         self._compiled_buckets: set[str] = set()
+        # Simulated per-scheduled-token device time (seconds): makes
+        # prefill cost proportional to chunk length, so prefix-cache /
+        # restore ablations measure a real warm-TTFT delta without
+        # chips.
+        self._token_seconds = float(
+            os.environ.get("VDT_MOCK_TOKEN_SECONDS", "0")
+        )
+        # Tiered-KV simulation (ISSUE 14): the mock "writes" actual
+        # token ids into a per-page store as steps advance, mirrors
+        # spill/restore spans between the page store and a host dict,
+        # and VERIFIES on every prefix-cache admission (new request
+        # with num_computed_tokens > 0) that the attached pages hold
+        # exactly the prompt's tokens — so any protocol bug (stale page
+        # handed out as a hit, restore landing after use, spill
+        # capturing after overwrite, slot reuse races) fails loudly
+        # instead of silently passing the trivially-deterministic
+        # output checks.
+        self._kv_page_size = config.cache_config.page_size
+        self._kv_pages: dict[int, list] = {}
+        self._kv_host: dict[int, list] = {}
+        self._kv_req: dict[str, dict] = {}
 
     # ---- fault injection ----
     def inject_fault(
@@ -161,7 +182,11 @@ class MockWorker:
         self.calls.append("load_model")
 
     def determine_num_pages(self) -> int:
-        # Different per rank so min() aggregation is observable.
+        # An explicit pool size wins (tiering/ablation tests constrain
+        # it); otherwise different per rank so min() aggregation is
+        # observable.
+        if self.config.cache_config.num_pages is not None:
+            return self.config.cache_config.num_pages
         return 100 + self.rank
 
     def initialize_cache(self, num_pages: int) -> None:
@@ -188,6 +213,95 @@ class MockWorker:
             time.sleep(
                 self._hbm_pass_seconds * self._hbm_passes(scheduler_output)
             )
+        if self._token_seconds:
+            time.sleep(
+                self._token_seconds
+                * scheduler_output.total_num_scheduled_tokens
+            )
+
+    # ---- tiered-KV simulation (ISSUE 14) ----
+    def _apply_kv_ops(self, so) -> None:
+        """Mirror the real runner's span application order: all spills
+        (page store -> host dict), then all restores (host dict -> page
+        store, slot consumed).  A restore from a missing slot is a
+        protocol violation and raises."""
+        ps = self._kv_page_size
+        for page, slot in getattr(so, "kv_spill_ops", None) or []:
+            self._kv_host[slot] = list(
+                self._kv_pages.get(page, [None] * ps)
+            )
+        for slot, page in getattr(so, "kv_restore_ops", None) or []:
+            self._kv_pages[page] = self._kv_host.pop(slot)
+
+    def _kv_track(self, so, sampled: dict[str, list[int]]) -> None:
+        """Write this step's token ids into the simulated page store
+        and VERIFY prefix-cache admissions against it (see __init__).
+        getattr-defensive: topology tests drive the mock with minimal
+        hand-built payloads that may omit scheduler-only fields."""
+        ps = self._kv_page_size
+        finished = getattr(so, "finished_req_ids", None) or []
+        preempted = getattr(so, "preempted_req_ids", None) or []
+        for rid in finished + preempted:
+            self._kv_req.pop(rid, None)
+        for nr in getattr(so, "new_requests", None) or []:
+            st = {
+                "tokens": list(nr.prompt_token_ids),
+                "pages": list(nr.page_ids),
+                "computed": nr.num_computed_tokens,
+            }
+            self._kv_req[nr.req_id] = st
+            for pos in range(nr.num_computed_tokens):
+                page = st["pages"][pos // ps]
+                row = self._kv_pages.get(page)
+                got = row[pos % ps] if row is not None else None
+                want = st["tokens"][pos]
+                if got != want:
+                    raise RuntimeError(
+                        f"prefix-cache KV mismatch for {nr.req_id}: "
+                        f"pos {pos} (page {page}) holds {got!r}, "
+                        f"prompt says {want!r} — the allocator served "
+                        "a stale or mis-restored page as a hit"
+                    )
+        for c in getattr(so, "cached_requests", None) or []:
+            st = self._kv_req.get(c.req_id)
+            if st is not None:
+                st["pages"].extend(c.new_page_ids)
+        drafts = getattr(so, "draft_token_ids", None) or {}
+        for rid, n in (
+            getattr(so, "num_scheduled_tokens", None) or {}
+        ).items():
+            st = self._kv_req.get(rid)
+            if st is None:
+                continue  # hand-built test payloads / unknown requests
+            emitted = sampled.get(rid, [])
+            st["tokens"].extend(emitted)
+            # Spec verify windows advance by the EMITTED count (the
+            # rejected-draft rows are overwritten in place and never
+            # reach the prefix index); everything else by the scheduled
+            # width, clamped to known tokens like registrable_tokens.
+            adv = len(emitted) if rid in drafts else n
+            end = min(st["computed"] + adv, len(st["tokens"]))
+            for pos in range(st["computed"], end):
+                page_i = pos // ps
+                if page_i >= len(st["pages"]):
+                    break
+                page = st["pages"][page_i]
+                row = self._kv_pages.get(page)
+                if row is None or len(row) != ps:
+                    row = [None] * ps
+                    self._kv_pages[page] = row
+                row[pos % ps] = st["tokens"][pos]
+            st["computed"] += adv
+
+    def get_kv_tier_info(self) -> dict | None:
+        if not self.is_driver_worker:
+            return None
+        page_bytes = 4096  # deterministic stand-in for the gauge scale
+        return {
+            "page_bytes": page_bytes,
+            "host_slots": len(self._kv_host),
+            "host_bytes": len(self._kv_host) * page_bytes,
+        }
 
     def _simulate_compile(self, scheduler_output) -> None:
         """Record one simulated XLA compile per new (kind, token-bucket)
@@ -295,12 +409,17 @@ class MockWorker:
         self._maybe_fault()
         if self._execute_sleep:
             time.sleep(self._execute_sleep)
+        t0 = time.perf_counter()
+        self._apply_kv_ops(scheduler_output)
+        tier_s = time.perf_counter() - t0
         self._simulate_device(scheduler_output)
         sampled = self._sample(scheduler_output)
+        self._kv_track(scheduler_output, sampled)
         if not self.is_driver_worker:
             return None
         out = ModelRunnerOutput()
         out.sampled_token_ids = sampled
+        out.kv_tier_seconds = tier_s
         return out
 
     # ---- two-phase step (cross-RPC pipelining) ----
@@ -316,9 +435,11 @@ class MockWorker:
         so = self._deferred.get(timeout=10)
         assert so.step_id == step_id, (so.step_id, step_id)
         time.sleep(self._step_seconds)  # pretend the device is busy
+        self._apply_kv_ops(so)  # FIFO order == frame order
         self._simulate_device(so)
         self.timeline.append(("fetch_done", step_id, time.monotonic()))
         sampled = self._sample(so)
+        self._kv_track(so, sampled)
         if not self.is_driver_worker:
             return None
         out = ModelRunnerOutput()

@@ -409,6 +409,200 @@ def run_soak(
 
 
 # ---------------------------------------------------------------------
+# Tiered-KV spill soak (ISSUE 14): kill→recover cycles with an ACTIVE
+# host-DRAM tier.  Disjoint chains cycled through a constrained pool
+# keep spill→restore traffic flowing; every cycle kills the remote host
+# mid-stream and asserts the recovered engine still produces the exact
+# deterministic token streams (the mock worker's page-content
+# verification raises on any stale or mis-restored page served as a
+# hit), the worker's host dict stays bounded by the configured pool
+# across recoveries, and RSS plateaus (no host-memory leak).
+# ---------------------------------------------------------------------
+def run_kv_spill_soak(
+    cycles: int = 3,
+    *,
+    model_dir: str | None = None,
+    chains: int = 6,
+    chain_len: int = 19,
+    max_tokens: int = 6,
+    num_kv_pages: int = 12,
+    host_pages: int = 32,
+    hb_interval: float = 0.5,
+    backoff: float = 0.2,
+) -> dict:
+    """Run the spill-phase kill→recover loop; returns the report dict.
+    Mutates (and restores) os.environ like run_soak."""
+    import asyncio
+
+    from vllm_distributed_tpu.config import EngineArgs
+    from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+    from vllm_distributed_tpu.executor.multihost import MultiHostExecutor
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+    from vllm_distributed_tpu.testing import write_llama_config
+    from vllm_distributed_tpu.utils import get_open_port
+
+    class SoakExecutor(MultiHostExecutor):
+        worker_cls = "tests.mock_worker.MockWorker"
+
+    port = get_open_port()
+    env = {
+        "VDT_SERVER_PORT": str(port),
+        "VDT_HEARTBEAT_INTERVAL_SECONDS": str(hb_interval),
+        "VDT_HEARTBEAT_MISS_THRESHOLD": "3",
+        "VDT_EXECUTE_MODEL_TIMEOUT_SECONDS": "5",
+        "VDT_CONNECT_TIMEOUT_SECONDS": "30",
+        "VDT_MAX_ENGINE_RESTARTS": str(cycles + 2),
+        "VDT_ENGINE_RESTART_BACKOFF_SECONDS": str(backoff),
+        "VDT_ENGINE_RESTART_BACKOFF_CAP_SECONDS": "2",
+        "VDT_CRASH_LOOP_WINDOW_SECONDS": "3600",
+        "VDT_MOCK_TOKEN_SEQ": "1",
+        "VDT_MOCK_EXECUTE_SLEEP_SECONDS": "0.03",
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    agents = None
+    engine = None
+    prompts = [
+        [100 * (i + 1) + j for j in range(chain_len)]
+        for i in range(chains)
+    ]
+    sp = SamplingParams(
+        temperature=0.0, max_tokens=max_tokens, ignore_eos=True
+    )
+    stats = {
+        "spill_pages": 0,
+        "restore_pages": 0,
+        "host_hit_tokens": 0,
+        "host_slots_max": 0,
+        "replay_failures": 0,
+    }
+
+    async def one_chain(tag: str, prompt: list[int], kill_at: int = -1):
+        expected = list(
+            range(len(prompt), len(prompt) + max_tokens)
+        )
+        tokens: list[int] = []
+        killed = False
+        async for out in engine.generate(
+            tag, prompt_token_ids=list(prompt), sampling_params=sp.clone()
+        ):
+            tokens = list(out.outputs[0].token_ids)
+            if kill_at >= 0 and not killed and len(tokens) >= kill_at:
+                agents.kill_current()
+                killed = True
+        if tokens != expected:
+            stats["replay_failures"] += 1
+            print(
+                f"{tag}: TOKEN MISMATCH {tokens} != {expected}",
+                file=sys.stderr,
+            )
+
+    async def go():
+        for cycle in range(cycles):
+            sched = engine.engine.scheduler
+            spill0 = sched.kv_spill_pages
+            restore0 = sched.kv_restore_pages
+            host0 = sched.prefix_cache_hits_host
+            # Warm loop: cycle every chain twice so late chains evict
+            # early ones (spill) and the second pass restores them.
+            for rnd in range(2):
+                for i, p in enumerate(prompts):
+                    await asyncio.wait_for(
+                        one_chain(f"c{cycle}-r{rnd}-{i}", p), timeout=60
+                    )
+            sched = engine.engine.scheduler
+            stats["spill_pages"] += sched.kv_spill_pages - spill0
+            stats["restore_pages"] += sched.kv_restore_pages - restore0
+            stats["host_hit_tokens"] += (
+                sched.prefix_cache_hits_host - host0
+            )
+            info = engine.engine.executor.collective_rpc(
+                "get_kv_tier_info",
+                unique_reply_rank=engine.engine.executor.output_rank,
+                timeout=10.0,
+            )
+            if isinstance(info, dict):
+                stats["host_slots_max"] = max(
+                    stats["host_slots_max"], info.get("host_slots", 0)
+                )
+                if info.get("host_slots", 0) > host_pages:
+                    stats["replay_failures"] += 1
+                    print(
+                        f"cycle {cycle}: host tier over budget "
+                        f"{info['host_slots']} > {host_pages}",
+                        file=sys.stderr,
+                    )
+            # Kill the remote host mid-stream with the tier active; the
+            # supervisor rebuild must come back clean (fresh tiers both
+            # sides) and replay bit-identically.
+            await asyncio.wait_for(
+                one_chain(f"kill-{cycle}", prompts[0], kill_at=2),
+                timeout=60,
+            )
+
+    try:
+        if model_dir is None:
+            tmpdir = tempfile.mkdtemp(prefix="vdt_spill_soak_")
+            model_dir = write_llama_config(os.path.join(tmpdir, "m"))
+        agents = RespawningAgent(port)
+        engine = AsyncLLM.from_engine_args(
+            EngineArgs(
+                model=model_dir,
+                skip_tokenizer_init=True,
+                load_format="dummy",
+                num_hosts=2,
+                num_decode_steps=1,
+                page_size=4,
+                max_model_len=512,
+                enable_prefix_caching=True,
+                num_kv_pages=num_kv_pages,
+                kv_spill_host_pages=host_pages,
+                kv_spill_restore_min_tokens=4,
+                distributed_executor_backend=SoakExecutor,
+            )
+        )
+        rss_before = _rss_mb()
+        asyncio.new_event_loop().run_until_complete(go())
+        rss_after = _rss_mb()
+        return {
+            "cycles": cycles,
+            "chains": chains,
+            "num_kv_pages": num_kv_pages,
+            "host_pages": host_pages,
+            **stats,
+            "restarts_total": engine.supervisor.restarts_total,
+            "agent_respawns": agents.respawns,
+            "rss_before_mb": round(rss_before, 1),
+            "rss_after_mb": round(rss_after, 1),
+            "rss_growth_mb": round(rss_after - rss_before, 1),
+            # The contract the smoke test asserts: the tier was ACTIVE
+            # (spills AND restores happened), stayed bounded, and every
+            # stream — including the killed ones — was bit-identical.
+            "active": (
+                stats["spill_pages"] > 0 and stats["restore_pages"] > 0
+            ),
+            "bounded": (
+                stats["replay_failures"] == 0
+                and stats["host_slots_max"] <= host_pages
+            ),
+        }
+    finally:
+        try:
+            if engine is not None:
+                engine.shutdown()
+        finally:
+            try:
+                if agents is not None:
+                    agents.stop()
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+
+
+# ---------------------------------------------------------------------
 # Router soak (ISSUE 10): kill/drain replicas BEHIND the router under
 # load and assert zero lost admitted work + bounded client stall.
 # ---------------------------------------------------------------------
@@ -1171,7 +1365,23 @@ def main() -> None:
         help="--ramp mode: skip the mid-resize SIGKILL (pure "
         "autoscale acceptance run)",
     )
+    parser.add_argument(
+        "--kv-spill",
+        action="store_true",
+        help="ISSUE 14 spill phase: kill-recover cycles with an ACTIVE "
+        "host-DRAM KV tier — asserts restored-page streams stay "
+        "bit-identical, the host tier stays bounded across "
+        "recoveries, and RSS plateaus (no host-memory leak)",
+    )
     args = parser.parse_args()
+    if args.kv_spill:
+        report = run_kv_spill_soak(
+            cycles=args.cycles, max_tokens=args.max_tokens
+        )
+        print(json.dumps(report))
+        if not (report["bounded"] and report["active"]):
+            sys.exit(1)
+        return
     if args.ramp is not None:
         report = run_fleet_ramp(
             max_replicas=args.ramp_max_replicas,

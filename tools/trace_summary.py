@@ -29,6 +29,7 @@ _STAGE_ORDER = [
     "router.request",
     "api.request",
     "engine.queue",
+    "engine.kv_restore",
     "engine.prefill",
     "engine.decode",
     "scheduler.schedule",

@@ -147,6 +147,18 @@ class CacheConfig:
     # ref-counted LRU reuse (block_manager.PrefixCachingAllocator).
     # Default off — the seed allocator path is byte-for-byte unchanged.
     enable_prefix_caching: bool = False
+    # Prefix index structure (ISSUE 14): "radix" = radix tree over
+    # token sequences with leaf-first cache-aware LRU eviction and the
+    # optional host-DRAM spill tier; "flat" = the PR 1 hash-chain map
+    # (the ablation baseline).  Ignored unless enable_prefix_caching.
+    prefix_cache_index: str = "radix"
+    # Host-DRAM spill tier (ISSUE 14): pages evicted from HBM spill to
+    # a bounded host pool of this many pages and stream back ahead of a
+    # prefill resume.  0 = off; radix index only.
+    kv_spill_host_pages: int = 0
+    # Restore-vs-recompute crossover in tokens: shorter host runs are
+    # recomputed rather than restored.
+    kv_spill_restore_min_tokens: int = 32
     # "auto" follows model dtype; "int8" quantizes the pool per (token,
     # kv head) — ~2x capacity, ~2x less attention HBM traffic; staged
     # decode rows quantize at flush, numerics run f32 in-kernel.
@@ -157,6 +169,18 @@ class CacheConfig:
     def __post_init__(self) -> None:
         if self.page_size & (self.page_size - 1):
             raise ValueError(f"page_size must be a power of 2, got {self.page_size}")
+        if self.prefix_cache_index not in ("radix", "flat"):
+            raise ValueError(
+                f"unsupported prefix_cache_index "
+                f"{self.prefix_cache_index!r}; supported: radix | flat"
+            )
+        if self.kv_spill_host_pages < 0:
+            raise ValueError("kv_spill_host_pages must be >= 0")
+        if self.kv_spill_host_pages > 0 and self.prefix_cache_index != "radix":
+            raise ValueError(
+                "the host-DRAM spill tier needs the radix prefix index "
+                "(--prefix-cache-index radix)"
+            )
         if self.cache_dtype == "fp8":
             raise ValueError(
                 "fp8 KV cache is not supported on TPU (no fp8 VPU "
@@ -568,6 +592,11 @@ class EngineArgs:
     hbm_utilization: float | None = None
     kv_cache_dtype: str = "auto"
     enable_prefix_caching: bool = False
+    prefix_cache_index: str = "radix"
+    # Tiered KV spill knobs (None -> resolved late from VDT_KV_SPILL_*
+    # so the env vars work on both the CLI and programmatic paths).
+    kv_spill_host_pages: int | None = None
+    kv_spill_restore_min_tokens: int | None = None
 
     tensor_parallel_size: int = 1
     pipeline_parallel_size: int = 1
@@ -648,6 +677,31 @@ class EngineArgs:
             help="reuse KV pages across requests sharing a prompt "
             "prefix (content-addressed pages, ref-counted LRU "
             "eviction)",
+        )
+        parser.add_argument(
+            "--prefix-cache-index",
+            type=str,
+            default="radix",
+            choices=["radix", "flat"],
+            help="prefix index structure: radix tree with leaf-first "
+            "cache-aware eviction + optional host-DRAM spill tier, or "
+            "the flat hash-chain map (ablation baseline)",
+        )
+        parser.add_argument(
+            "--kv-spill-host-pages",
+            type=int,
+            default=None,
+            help="host-DRAM spill tier size in KV pages: evicted pages "
+            "spill to host memory and stream back ahead of prefill "
+            "resume (default: $VDT_KV_SPILL_HOST_PAGES or 0 = off)",
+        )
+        parser.add_argument(
+            "--kv-spill-restore-min-tokens",
+            type=int,
+            default=None,
+            help="restore-vs-recompute crossover: host runs shorter "
+            "than this many tokens are recomputed instead of restored "
+            "(default: $VDT_KV_SPILL_RESTORE_MIN_TOKENS or 32)",
         )
         parser.add_argument(
             "--tensor-parallel-size", "-tp", type=int, default=1
@@ -805,12 +859,21 @@ class EngineArgs:
         hbm_utilization = self.hbm_utilization
         if hbm_utilization is None:
             hbm_utilization = envs.VDT_HBM_UTILIZATION
+        kv_spill_host_pages = self.kv_spill_host_pages
+        if kv_spill_host_pages is None:
+            kv_spill_host_pages = envs.VDT_KV_SPILL_HOST_PAGES
+        kv_spill_restore_min = self.kv_spill_restore_min_tokens
+        if kv_spill_restore_min is None:
+            kv_spill_restore_min = envs.VDT_KV_SPILL_RESTORE_MIN_TOKENS
         cache_config = CacheConfig(
             page_size=self.page_size,
             num_pages=self.num_kv_pages,
             hbm_utilization=hbm_utilization,
             cache_dtype=self.kv_cache_dtype,
             enable_prefix_caching=self.enable_prefix_caching,
+            prefix_cache_index=self.prefix_cache_index,
+            kv_spill_host_pages=kv_spill_host_pages,
+            kv_spill_restore_min_tokens=kv_spill_restore_min,
         )
         parallel_config = ParallelConfig(
             tensor_parallel_size=self.tensor_parallel_size,

@@ -17,12 +17,28 @@ cousin of SGLang's RadixAttention, Zheng et al. 2024 — see PAPERS.md):
 full pages are content-addressed, freed pages park in an LRU queue
 instead of becoming garbage, and later requests re-attach them
 ref-counted, skipping the prefill of the shared prefix.
+
+``RadixPrefixCachingAllocator`` (ISSUE 14 tentpole) upgrades the index
+from the flat hash map to a real radix tree keyed by token sequences —
+one node per full page, children keyed by the next page's exact token
+tuple, ref-counted interior nodes, and **leaf-first cache-aware LRU
+eviction** (a hot chain's interior pages can never be stranded by the
+eviction of an unrelated leaf, and matching a prefix refreshes the whole
+chain).  It also owns the second tier: evicted-but-indexed pages spill
+to a bounded host-DRAM pool instead of evaporating, and stream back into
+freshly allocated HBM pages ahead of a prefill resume (the scheduler
+treats restored pages as computed).  The allocator is pure bookkeeping —
+the actual KV bytes move worker-side (``model_runner._apply_kv_tier_ops``)
+driven by the (page, slot) spans this class queues onto each
+``SchedulerOutput``.
 """
 
 from __future__ import annotations
 
 import hashlib
+import heapq
 from collections import OrderedDict
+from dataclasses import dataclass, field
 
 from vllm_distributed_tpu.engine.request import Request
 from vllm_distributed_tpu.utils import cdiv
@@ -340,3 +356,610 @@ class PrefixCachingAllocator(PageAllocator):
                 self._page_key[page] = key
             n_reg += 1
         self._reg[rid] = n_reg
+
+
+class _RadixNode:
+    """One full KV page in the radix tree.  ``key`` is the page's exact
+    token tuple (the edge label from the parent — no hashing, so false
+    positives are structurally impossible).  Exactly one of two
+    residencies at a time: ``page`` set (HBM tier) or ``host_slot`` set
+    (host-DRAM tier); a node with neither is detached from the tree."""
+
+    __slots__ = (
+        "key",
+        "parent",
+        "children",
+        "page",
+        "host_slot",
+        "refs",
+        "resident_children",
+        "last_use",
+        "stamp",
+    )
+
+    def __init__(self, key, parent, page=None) -> None:
+        self.key = key
+        self.parent = parent
+        self.children: dict[tuple, _RadixNode] = {}
+        self.page: int | None = page
+        self.host_slot: int | None = None
+        # Live request attachments.  Every request refs a contiguous
+        # root-anchored path, so refs never increase with depth — the
+        # leaf-first eviction order can rely on a refs==0 node having
+        # only refs==0 resident descendants (modulo the duplicate-
+        # content corner, which the resident-children gate still
+        # protects).
+        self.refs = 0
+        # HBM-resident children (maintained incrementally): a node may
+        # be evicted from HBM only once this drops to zero, which is
+        # what makes eviction leaf-first.
+        self.resident_children = 0
+        self.last_use = 0
+        # Lazy-heap validity stamp: heap entries carry the stamp they
+        # were pushed with; any candidacy/recency change bumps it, so
+        # stale entries are skipped at pop time.
+        self.stamp = 0
+
+
+@dataclass
+class PrefixPlan:
+    """Pure query result of one radix walk: the longest indexed chain
+    matching a prompt, split by tier.  ``resident`` pages attach as-is;
+    ``host`` nodes can be streamed back from the host tier into fresh
+    HBM pages (the scheduler decides restore-vs-recompute against the
+    ``restore_min_tokens`` crossover)."""
+
+    resident: list[_RadixNode] = field(default_factory=list)
+    host: list[_RadixNode] = field(default_factory=list)
+    page_size: int = 0
+
+    @property
+    def resident_tokens(self) -> int:
+        return len(self.resident) * self.page_size
+
+    @property
+    def host_tokens(self) -> int:
+        return len(self.host) * self.page_size
+
+
+class RadixPrefixCachingAllocator(PageAllocator):
+    """Radix-tree prefix index + host-DRAM spill tier (ISSUE 14).
+
+    Tree semantics: every FULL computed page is a node keyed by its
+    exact token tuple under its parent page's node, so longest-prefix
+    match is a root walk with no hash collisions.  Freed pages keep
+    their node (cached-free, counted free); allocation evicts only
+    **resident leaves of the resident subtree** (refs==0, no
+    HBM-resident children), least-recently-used first, where "use"
+    includes query matches — a chain a router keeps steering at stays
+    warm end to end while cold chains are consumed tail-first.
+
+    Spill tier: with ``host_pages > 0``, an evicted node's KV moves to a
+    bounded host-DRAM slot instead of being discarded (the worker copies
+    the page out before any step may overwrite it — the (page, slot)
+    span rides the next dispatched SchedulerOutput ahead of the step's
+    writes).  A later prompt whose chain walks into host-resident nodes
+    restores them into freshly allocated HBM pages (slot→page spans,
+    applied worker-side before the step that reads them) when the
+    restorable run is at least ``restore_min_tokens``; below the
+    crossover the tokens are recomputed and the host copies stay put.
+    The host tier evicts leaf-first LRU like the HBM tier; pruning an
+    unreachable subtree releases its slots.
+
+    Shared pages still need no copy-on-write: only full computed pages
+    are indexed, hits stop at a page boundary strictly inside the
+    prompt, and restores write into freshly allocated pages before the
+    step that reads them — an attached node's page is never written.
+    """
+
+    supports_tiered = True
+
+    def __init__(
+        self,
+        num_pages: int,
+        page_size: int,
+        host_pages: int = 0,
+        restore_min_tokens: int = 0,
+    ) -> None:
+        super().__init__(num_pages, page_size)
+        self.host_pages = max(int(host_pages), 0)
+        self.restore_min_tokens = max(int(restore_min_tokens), 0)
+        self._root = _RadixNode(key=None, parent=None)
+        # page id -> node whose KV lives in that page.
+        self._page_node: dict[int, _RadixNode] = {}
+        # req_id -> root-anchored node path the request holds refs on.
+        self._req_nodes: dict[str, list[_RadixNode]] = {}
+        # req_id -> pages registered so far / deepest chain node.
+        self._reg: dict[str, int] = {}
+        self._reg_node: dict[str, _RadixNode] = {}
+        # Nodes with a page and refs==0 (evictable capacity).
+        self._cached_free = 0
+        # Lazy eviction heaps: (last_use, stamp, node); entries are
+        # validated (stamp + candidacy) at pop time.
+        self._hbm_heap: list[tuple[int, int, _RadixNode]] = []
+        self._host_heap: list[tuple[int, int, _RadixNode]] = []
+        self._tick = 0
+        self._stamp = 0
+        # Host tier state.
+        self._host_free: list[int] = list(range(self.host_pages - 1, -1, -1))
+        self._host_used = 0
+        # Pending KV-tier spans for the next dispatched step, and slots
+        # whose reuse must wait until the restore op that read them has
+        # shipped (a spill into a just-restored slot inside ONE op batch
+        # would be applied before the restore reads it).
+        self._pending_spills: list[tuple[int, int]] = []
+        self._pending_restores: list[tuple[int, int]] = []
+        self._slots_freeing: list[int] = []
+        # Pages whose restore span has been QUEUED but not SHIPPED: the
+        # device copy does not exist yet, so evicting (and re-spilling)
+        # such a page before its restore lands would capture garbage
+        # into the host tier.  Cleared when the batch ships — later
+        # spills ride later frames, which the worker applies after this
+        # batch's restores.
+        self._restoring_pages: set[int] = set()
+
+    # ---- bookkeeping primitives ----
+    def _touch(self, node: _RadixNode) -> None:
+        self._tick += 1
+        node.last_use = self._tick
+        self._push_if_candidate(node)
+
+    def _hbm_candidate(self, node: _RadixNode) -> bool:
+        return (
+            node.page is not None
+            and node.refs == 0
+            and node.resident_children == 0
+            and node.parent is not None
+            # A queued-but-unshipped restore target holds no real KV
+            # yet (rollback can orphan one with refs==0): never spill
+            # it before the restore lands.
+            and node.page not in self._restoring_pages
+        )
+
+    def _host_candidate(self, node: _RadixNode) -> bool:
+        return (
+            node.host_slot is not None
+            and node.refs == 0
+            and not node.children
+            and node.parent is not None
+        )
+
+    def _push_if_candidate(self, node: _RadixNode) -> None:
+        self._stamp += 1
+        node.stamp = self._stamp
+        if self._hbm_candidate(node):
+            heapq.heappush(
+                self._hbm_heap, (node.last_use, node.stamp, node)
+            )
+            if len(self._hbm_heap) > 4 * len(self._page_node) + 64:
+                self._compact(self._hbm_heap, self._hbm_candidate)
+        elif self._host_candidate(node):
+            heapq.heappush(
+                self._host_heap, (node.last_use, node.stamp, node)
+            )
+            if len(self._host_heap) > 4 * self._host_used + 64:
+                self._compact(self._host_heap, self._host_candidate)
+
+    @staticmethod
+    def _compact(heap, candidate) -> None:
+        """Drop stale lazy-heap entries in place (touch-heavy,
+        eviction-light workloads would otherwise grow the heap by one
+        entry per chain touch, unbounded)."""
+        live = [
+            e for e in heap if e[2].stamp == e[1] and candidate(e[2])
+        ]
+        heap[:] = live
+        heapq.heapify(heap)
+
+    def _ref(self, node: _RadixNode) -> None:
+        node.refs += 1
+        if node.refs == 1 and node.page is not None:
+            self._cached_free -= 1
+
+    def _unref(self, node: _RadixNode) -> None:
+        node.refs -= 1
+        assert node.refs >= 0, "radix node ref underflow"
+        if node.refs == 0:
+            if node.page is not None:
+                self._cached_free += 1
+            self._push_if_candidate(node)
+
+    @property
+    def num_free_pages(self) -> int:
+        # Cached-free node pages are reclaimable on demand (leaf-first).
+        return len(self._free) + self._cached_free
+
+    @property
+    def host_slots_used(self) -> int:
+        return self._host_used
+
+    # ---- eviction ----
+    def _take_host_slot(self) -> int | None:
+        """A free host slot, evicting the LRU host leaf if the pool is
+        full.  None when the host tier is disabled or unreclaimable."""
+        if self.host_pages <= 0:
+            return None
+        if self._host_free:
+            self._host_used += 1
+            return self._host_free.pop()
+        while self._host_heap:
+            _, stamp, node = heapq.heappop(self._host_heap)
+            if node.stamp != stamp or not self._host_candidate(node):
+                continue
+            slot = node.host_slot
+            node.host_slot = None
+            self._detach(node)
+            # Slot handed straight to the caller: _host_used is
+            # unchanged (one leaves the tier, one enters).
+            return slot
+        return None
+
+    def _detach(self, node: _RadixNode) -> None:
+        """Remove a pageless, slotless, childless node from the tree."""
+        assert node.page is None and node.host_slot is None
+        assert not node.children and node.refs == 0
+        parent = node.parent
+        del parent.children[node.key]
+        node.parent = None
+        self._stamp += 1
+        node.stamp = self._stamp  # invalidate heap entries
+        self._push_if_candidate(parent)
+
+    def _prune_host_subtree(self, node: _RadixNode) -> None:
+        """Release the (all-host) subtree under a node being evicted to
+        nothing: its chains are unreachable once the parent's KV is
+        gone."""
+        for child in list(node.children.values()):
+            self._prune_host_subtree(child)
+            if child.host_slot is not None:
+                self._host_free.append(child.host_slot)
+                self._host_used -= 1
+                child.host_slot = None
+            self._detach(child)
+
+    def _evict_one(self) -> int:
+        """Reclaim one HBM page: pop the least-recently-used resident
+        leaf, spilling its KV to the host tier when there is (or can be
+        made) room, discarding it otherwise."""
+        while self._hbm_heap:
+            _, stamp, node = heapq.heappop(self._hbm_heap)
+            if node.stamp != stamp or not self._hbm_candidate(node):
+                continue
+            page = node.page
+            node.page = None
+            del self._page_node[page]
+            self._cached_free -= 1
+            parent = node.parent
+            parent.resident_children -= 1
+            slot = self._take_host_slot()
+            if slot is not None:
+                self._pending_spills.append((page, slot))
+                node.host_slot = slot
+                self._push_if_candidate(node)
+            else:
+                self._prune_host_subtree(node)
+                self._detach(node)
+            self._push_if_candidate(parent)
+            return page
+        raise NoFreePagesError(f"out of KV pages ({self.num_pages} total)")
+
+    def _pop_free_page(self) -> int:
+        if self._free:
+            return self._free.pop()
+        return self._evict_one()
+
+    # ---- allocation / release ----
+    def allocate(self, req: Request, num_new_tokens: int) -> list[int]:
+        pages = self._allocated.setdefault(req.request_id, [])
+        need = self.num_pages_needed(
+            req.num_computed_tokens + num_new_tokens
+        )
+        new_pages: list[int] = []
+        while len(pages) < need:
+            try:
+                p = self._pop_free_page()
+            except NoFreePagesError:
+                # Roll back: caller decides to preempt.  Evicted pages
+                # lost their index entry (or moved to host) — a sliver
+                # of cache, never correctness.
+                for q in new_pages:
+                    pages.remove(q)
+                    self._free.append(q)
+                raise
+            pages.append(p)
+            new_pages.append(p)
+        req.page_ids = pages
+        return new_pages
+
+    def free(self, req: Request) -> None:
+        rid = req.request_id
+        pages = self._allocated.pop(rid, [])
+        nodes = self._req_nodes.pop(rid, [])
+        self._reg.pop(rid, None)
+        self._reg_node.pop(rid, None)
+        # Leaf-first unref so the chain tail enters evictability before
+        # the (more shareable) root.
+        for node in reversed(nodes):
+            self._unref(node)
+        # Plain pages (never registered, or duplicate content) return to
+        # the free list; node pages stay with their node (cached-free).
+        for p in reversed(pages):
+            if p not in self._page_node:
+                self._free.append(p)
+        req.page_ids = []
+
+    # ---- the radix walk (scheduler-facing) ----
+    registrable_tokens = staticmethod(
+        PrefixCachingAllocator.registrable_tokens
+    )
+
+    def _walk(
+        self, token_ids: list[int], max_pages: int
+    ) -> tuple[list[_RadixNode], list[_RadixNode]]:
+        """Longest indexed chain matching ``token_ids``: the HBM-resident
+        prefix, then the host-resident run behind it.  Stops at the
+        first detached gap — and at a resident node BEHIND a host run
+        (unreachable until its ancestors are restored)."""
+        ps = self.page_size
+        resident: list[_RadixNode] = []
+        host: list[_RadixNode] = []
+        node = self._root
+        for i in range(max_pages):
+            child = node.children.get(tuple(token_ids[i * ps : (i + 1) * ps]))
+            if child is None:
+                break
+            if child.page is not None and not host:
+                resident.append(child)
+            elif child.host_slot is not None:
+                host.append(child)
+            else:
+                break
+            node = child
+        return resident, host
+
+    def plan_prefix(self, req: Request) -> PrefixPlan:
+        """Pure tiered query (the radix analog of ``query_prefix``).
+        The combined hit stops strictly below prefill_target at a page
+        boundary — at least one token is always recomputed, and the
+        fully-cached tail page is dropped so a shared page is never
+        written (same contract as the hash-chain allocator)."""
+        prefill_target = req.prefill_target
+        max_pages = min(req.num_tokens, prefill_target) // self.page_size
+        resident, host = self._walk(req.all_token_ids, max_pages)
+        if (
+            (resident or host)
+            and (len(resident) + len(host)) * self.page_size
+            >= prefill_target
+        ):
+            if host:
+                host.pop()
+            else:
+                resident.pop()
+        # Matching refreshes the WHOLE chain (cache-aware LRU): a chain
+        # traffic keeps walking stays warm even while its tail is free.
+        for node in resident:
+            self._touch(node)
+        for node in host:
+            self._touch(node)
+        return PrefixPlan(
+            resident=resident, host=host, page_size=self.page_size
+        )
+
+    def query_prefix(self, req: Request) -> tuple[int, list[int]]:
+        """Hash-chain-compatible view of ``plan_prefix``: the resident
+        hit only (oracle tests and the flat-index scheduler path)."""
+        plan = self.plan_prefix(req)
+        return plan.resident_tokens, [n.page for n in plan.resident]
+
+    def can_admit_plan(
+        self, plan: PrefixPlan, num_new_tokens: int, restore: bool
+    ) -> bool:
+        """Admission check for attaching this plan and then prefilling
+        ``num_new_tokens`` more: attaching removes the plan's
+        cached-free resident pages from the free count; everything else
+        (the prefill remainder AND, when restoring, the host run's
+        target pages) must come out of what is left."""
+        resident = plan.resident
+        total = plan.resident_tokens + num_new_tokens
+        if restore:
+            total += plan.host_tokens
+        need_new = self.num_pages_needed(total) - len(resident)
+        free = self.num_free_pages - sum(
+            1 for n in resident if n.refs == 0
+        )
+        return need_new <= free
+
+    def attach_plan(
+        self, req: Request, plan: PrefixPlan, restore: bool
+    ) -> int:
+        """Adopt a planned chain as the request's first pages: resident
+        nodes attach ref-counted; with ``restore`` the host run is
+        streamed back into freshly allocated pages (slot→page spans
+        queued for the next dispatched step).  Atomic: on page
+        exhaustion mid-restore everything is rolled back and
+        NoFreePagesError propagates.  Returns the restored page count.
+        Must be the request's first allocation."""
+        rid = req.request_id
+        owned = self._allocated.setdefault(rid, [])
+        assert not owned, "attach_plan after allocate"
+        nodes = list(plan.resident) + (list(plan.host) if restore else [])
+        for node in nodes:
+            self._ref(node)
+        restored: list[int] = []
+        if restore and plan.host:
+            try:
+                for _ in plan.host:
+                    restored.append(self._pop_free_page())
+            except NoFreePagesError:
+                self._free.extend(reversed(restored))
+                for node in reversed(nodes):
+                    self._unref(node)
+                raise
+            for node, page in zip(plan.host, restored):
+                self._pending_restores.append((node.host_slot, page))
+                # The slot becomes reusable only after this op batch
+                # ships (release_shipped_slots) — a spill reusing it in
+                # the SAME batch would be applied before the restore.
+                self._slots_freeing.append(node.host_slot)
+                # ...and the target page is not evictable until then
+                # either: its device copy does not exist yet.
+                self._restoring_pages.add(page)
+                node.host_slot = None
+                node.page = page
+                self._page_node[page] = node
+                node.parent.resident_children += 1
+        owned.extend(n.page for n in nodes)
+        req.page_ids = owned
+        self._req_nodes[rid] = nodes
+        self._reg[rid] = len(nodes)
+        self._reg_node[rid] = nodes[-1] if nodes else self._root
+        return len(restored)
+
+    # Hash-chain-compatible attach (flat callers and tests).
+    def attach_prefix(self, req: Request, hit_pages: list[int]) -> None:
+        plan = PrefixPlan(
+            resident=[self._page_node[p] for p in hit_pages],
+            page_size=self.page_size,
+        )
+        self.attach_plan(req, plan, restore=False)
+
+    def can_allocate_with_prefix(
+        self, hit_pages: list[int], num_tokens_total: int
+    ) -> bool:
+        plan = PrefixPlan(
+            resident=[self._page_node[p] for p in hit_pages],
+            page_size=self.page_size,
+        )
+        return self.can_admit_plan(
+            plan, num_tokens_total - plan.resident_tokens, restore=False
+        )
+
+    def estimate_cached_tokens(
+        self, token_ids: list[int] | None
+    ) -> int:
+        """Admission-watermark estimate (ISSUE 8): tokens the prompt
+        would NOT need pages-to-prefill for.  Host-tier pages count as
+        cached when their run would actually be restored (at/above the
+        crossover) — a restore still needs target pages, but admission
+        over-rejecting a hit that restores from DRAM is exactly the
+        failure this estimate exists to avoid; the watermark keeps the
+        slack.  Runs on the event loop against a tree the engine thread
+        mutates: dict gets and attribute reads only, worst case a
+        slightly stale estimate."""
+        if not token_ids:
+            return 0
+        ps = self.page_size
+        node = self._root
+        resident = 0
+        host = 0
+        for i in range(len(token_ids) // ps):
+            child = node.children.get(tuple(token_ids[i * ps : (i + 1) * ps]))
+            if child is None:
+                break
+            if child.page is not None and host == 0:
+                resident += 1
+            elif child.host_slot is not None:
+                host += 1
+            else:
+                break
+            node = child
+        tokens = resident * ps
+        if host and host * ps >= self.restore_min_tokens:
+            tokens += host * ps
+        return tokens
+
+    def register_computed(self, req: Request) -> None:
+        """Index every newly FULL computed page (call after
+        num_computed_tokens advances).  Content already indexed under
+        another page is skipped — first writer wins, the duplicate page
+        stays plain — except a host-resident duplicate, which is
+        PROMOTED: the request's freshly computed resident page becomes
+        the node's page and the stale host copy is released (keeps the
+        resident-prefix/host-suffix chain invariant intact)."""
+        rid = req.request_id
+        n_reg = self._reg.get(rid, 0)
+        ps = self.page_size
+        full = self.registrable_tokens(req) // ps
+        if full <= n_reg:
+            return
+        pages = self._allocated.get(rid, [])
+        cursor = self._reg_node.get(rid, self._root)
+        if cursor is None:
+            return  # chain broken earlier (see below); stop registering
+        if cursor is not self._root and (
+            cursor.parent is None or cursor.page is None
+        ):
+            # The saved cursor was evicted or spilled between steps —
+            # possible when it was a duplicate-content node this
+            # request never reffed.  Registering under it would hang a
+            # resident child off a host/detached node and corrupt the
+            # residency invariant; the rest of this chain is a cache
+            # sliver, so tombstone and skip (never correctness).
+            self._reg_node[rid] = None
+            return
+        ids = req.all_token_ids
+        nodes = self._req_nodes.setdefault(rid, [])
+        while n_reg < full and n_reg < len(pages):
+            key = tuple(ids[n_reg * ps : (n_reg + 1) * ps])
+            page = pages[n_reg]
+            child = cursor.children.get(key)
+            if child is None:
+                child = _RadixNode(key=key, parent=cursor, page=page)
+                # Born owned: refs set directly (a page held by a live
+                # request was never counted cached-free, so _ref's
+                # accounting does not apply).
+                child.refs = 1
+                cursor.children[key] = child
+                self._page_node[page] = child
+                cursor.resident_children += 1
+                nodes.append(child)
+            elif child.page is None and child.host_slot is not None:
+                # Promote: adopt the recomputed resident copy.
+                assert child.refs == 0, "host-resident node with refs"
+                self._host_free.append(child.host_slot)
+                self._host_used -= 1
+                child.host_slot = None
+                child.page = page
+                child.refs = 1
+                self._page_node[page] = child
+                child.parent.resident_children += 1
+                nodes.append(child)
+            # else: resident duplicate — first writer wins, our page
+            # stays plain (freed to the plain list with the request).
+            self._touch(child)
+            cursor = child
+            n_reg += 1
+        self._reg[rid] = n_reg
+        self._reg_node[rid] = cursor
+
+    # ---- KV-tier op spans (drained by the scheduler per step) ----
+    def take_tier_ops(
+        self,
+    ) -> tuple[list[tuple[int, int]], list[tuple[int, int]]]:
+        """Drain the pending (page→slot) spill and (slot→page) restore
+        spans for the next dispatched step.  Workers apply all spills,
+        then all restores, then run the step — the order every span
+        above was queued to be correct under."""
+        spills, self._pending_spills = self._pending_spills, []
+        restores, self._pending_restores = self._pending_restores, []
+        return spills, restores
+
+    def release_shipped_slots(self) -> None:
+        """Call once the drained op batch is actually attached to a
+        dispatched step: slots consumed by its restores become reusable
+        for FUTURE spills (never for a spill in the same batch), and
+        the restored pages become evictable again (a later spill rides
+        a later frame, applied after this batch's restores)."""
+        if self._slots_freeing:
+            self._host_free.extend(self._slots_freeing)
+            self._host_used -= len(self._slots_freeing)
+            self._slots_freeing.clear()
+        if self._restoring_pages:
+            # Every queued restore is in the batch that just shipped
+            # (take_tier_ops drains fully each schedule; holds merge).
+            pages = self._restoring_pages
+            self._restoring_pages = set()
+            for page in pages:
+                node = self._page_node.get(page)
+                if node is not None:
+                    self._push_if_candidate(node)

@@ -86,8 +86,29 @@ class LLMEngine:
         # from the executor's heartbeat loop.
         self.executor.metrics = self.metrics
         self._preemptions_seen = 0
-        self._prefix_cache_seen = (0, 0)  # (queries, hits) already recorded
+        # (queries, hits, host_hits) already recorded.
+        self._prefix_cache_seen = (0, 0, 0)
         self._spec_seen = (0, 0)  # (drafted, accepted) already recorded
+        # Tiered KV cache (ISSUE 14): (spilled, restored, slots-used)
+        # already recorded, and the per-page pool byte size for the
+        # vllm:host_kv_bytes gauge — pulled once from the reply-rank
+        # worker over the new kv-tier RPC (best-effort: 0 leaves the
+        # gauge at 0, never fails boot).
+        self._kv_tier_seen = (0, 0, 0)
+        self._kv_page_bytes = 0
+        if (
+            config.cache_config.enable_prefix_caching
+            and config.cache_config.kv_spill_host_pages > 0
+        ):
+            try:
+                info = self.executor.collective_rpc(
+                    "get_kv_tier_info",
+                    unique_reply_rank=self.executor.output_rank,
+                    timeout=30.0,
+                )
+                self._kv_page_bytes = int((info or {}).get("page_bytes", 0))
+            except Exception as e:  # noqa: BLE001 — telemetry only
+                logger.debug("kv-tier info pull failed: %s", e)
         # Flight recorder (ISSUE 12): always-on bounded ring of per-step
         # records, dumped on HostFailure/recovery/drain and served at
         # /debug/flightrecorder.
@@ -551,13 +572,48 @@ class LLMEngine:
         pc = (
             self.scheduler.prefix_cache_queries,
             self.scheduler.prefix_cache_hits,
+            self.scheduler.prefix_cache_hits_host,
         )
         self.metrics.record_prefix_cache(
             pc[0] - self._prefix_cache_seen[0],
             pc[1] - self._prefix_cache_seen[1],
+            pc[2] - self._prefix_cache_seen[2],
         )
         self._prefix_cache_seen = pc
         self.metrics.record_kv_cache_usage(self.scheduler.kv_cache_usage)
+        # Tiered KV cache (ISSUE 14): tier-traffic deltas, host
+        # occupancy, and the restore-stall observables on steps that
+        # carried restore spans.
+        slots = getattr(
+            self.scheduler.allocator, "host_slots_used", 0
+        )
+        kt = (
+            self.scheduler.kv_spill_pages,
+            self.scheduler.kv_restore_pages,
+            # Occupancy moves without tier traffic too (promotes and
+            # subtree prunes release slots) — the gauge must follow.
+            slots,
+        )
+        if kt != self._kv_tier_seen:
+            self.metrics.record_kv_tier(
+                kt[0] - self._kv_tier_seen[0],
+                kt[1] - self._kv_tier_seen[1],
+                host_bytes=slots * self._kv_page_bytes,
+            )
+            self._kv_tier_seen = kt
+        if scheduler_output.kv_restore_ops:
+            stall = runner_output.kv_tier_seconds
+            self.metrics.record_kv_restore_seconds(stall)
+            if self.tracer.enabled:
+                self.tracer.record_span(
+                    "engine.kv_restore",
+                    now - stall,
+                    stall,
+                    parent=scheduler_output.trace_ctx,
+                    step_id=scheduler_output.step_id,
+                    pages=len(scheduler_output.kv_restore_ops),
+                    spilled_pages=len(scheduler_output.kv_spill_ops),
+                )
         if scheduler_output.draft_token_ids:
             sd = (
                 self.scheduler.spec_drafted_tokens,

@@ -29,6 +29,7 @@ from vllm_distributed_tpu.engine.block_manager import (
     NoFreePagesError,
     PageAllocator,
     PrefixCachingAllocator,
+    RadixPrefixCachingAllocator,
 )
 from vllm_distributed_tpu.engine.request import Request, RequestStatus
 from vllm_distributed_tpu.engine.spec_decode import spec_eligible
@@ -93,6 +94,14 @@ class SchedulerOutput:
     # advance (1 + accepted drafts) is reconciled in update_from_output
     # from the emitted token count.  decode_steps is always 1 here.
     draft_token_ids: dict[str, list[int]] = field(default_factory=dict)
+    # Tiered KV cache (ISSUE 14): (hbm_page, host_slot) spans whose KV
+    # the workers copy out to host DRAM, and (host_slot, hbm_page)
+    # spans they stream back, BEFORE executing this step — spills must
+    # land before the evicted page is overwritten, restores before the
+    # restored pages are read.  Applied in order: all spills, then all
+    # restores.
+    kv_spill_ops: list[tuple[int, int]] = field(default_factory=list)
+    kv_restore_ops: list[tuple[int, int]] = field(default_factory=list)
     # Trace context of the first scheduled traced request, if any: the
     # parent for this step's schedule/dispatch/gather spans (a step
     # serves a batch, so one trace adopts the step; the others link via
@@ -115,14 +124,25 @@ class Scheduler:
         self.page_size = cache_config.page_size
         # Prefix caching swaps the allocator behind the same interface;
         # with the flag off the seed allocator (and behaviour) is
-        # untouched.
+        # untouched.  The radix index (ISSUE 14) is the default cache;
+        # "flat" keeps the PR 1 hash-chain as the ablation baseline.
         self.enable_prefix_caching = cache_config.enable_prefix_caching
-        alloc_cls = (
-            PrefixCachingAllocator
-            if self.enable_prefix_caching
-            else PageAllocator
+        if not self.enable_prefix_caching:
+            self.allocator = PageAllocator(num_pages, cache_config.page_size)
+        elif cache_config.prefix_cache_index == "flat":
+            self.allocator = PrefixCachingAllocator(
+                num_pages, cache_config.page_size
+            )
+        else:
+            self.allocator = RadixPrefixCachingAllocator(
+                num_pages,
+                cache_config.page_size,
+                host_pages=cache_config.kv_spill_host_pages,
+                restore_min_tokens=cache_config.kv_spill_restore_min_tokens,
+            )
+        self._tiered = isinstance(
+            self.allocator, RadixPrefixCachingAllocator
         )
-        self.allocator = alloc_cls(num_pages, cache_config.page_size)
         # Bounded upstream by the AdmissionController caps when
         # configured (engine/overload.py); unbounded growth is the
         # operator's explicit choice via max_waiting_requests=0.
@@ -155,8 +175,20 @@ class Scheduler:
         self.num_preemptions = 0
         # Cumulative prefix-cache token counters (metrics): tokens
         # eligible for lookup at admission vs tokens served from cache.
+        # `prefix_cache_hits` is the TOTAL across tiers;
+        # `prefix_cache_hits_host` is the host-restored share of it.
         self.prefix_cache_queries = 0
         self.prefix_cache_hits = 0
+        self.prefix_cache_hits_host = 0
+        # Cumulative tier-traffic counters (ISSUE 14 metrics).
+        self.kv_spill_pages = 0
+        self.kv_restore_pages = 0
+        # Tier-op spans produced by a schedule whose output came up
+        # EMPTY (e.g. the triggering admission rolled back): held for
+        # the next step that actually reaches the workers, exactly like
+        # _held_notices — a spill must still beat any later reuse of
+        # its source page.
+        self._held_tier_ops: tuple[list, list] | None = None
         # Requests finished OUTSIDE update_from_output (deadline sheds,
         # preempt-to-shed): the engine drains this after each schedule
         # and emits their final RequestOutputs (ISSUE 8).
@@ -469,14 +501,29 @@ class Scheduler:
             # longest cached page chain matching its tokens (pure query;
             # state changes only on actual admission below).  Covers
             # preemption-resume too — content addressing makes a
-            # request's own earlier pages an ordinary hit.
-            hit_tokens, hit_pages = 0, []
+            # request's own earlier pages an ordinary hit.  With the
+            # tiered radix index the hit may extend into the host-DRAM
+            # tier: a host run at/above the restore crossover is
+            # streamed back into fresh pages and counted as computed;
+            # below it those tokens are simply recomputed.
+            hit_tokens, hit_pages, plan, restore = 0, [], None, False
             if (
                 self.enable_prefix_caching
                 and req.num_computed_tokens == 0
                 and not req.page_ids
             ):
-                hit_tokens, hit_pages = self.allocator.query_prefix(req)
+                if self._tiered:
+                    plan = self.allocator.plan_prefix(req)
+                    restore = (
+                        plan.host_tokens > 0
+                        and plan.host_tokens
+                        >= self.allocator.restore_min_tokens
+                    )
+                    hit_tokens = plan.resident_tokens + (
+                        plan.host_tokens if restore else 0
+                    )
+                else:
+                    hit_tokens, hit_pages = self.allocator.query_prefix(req)
             remaining_prompt = (
                 req.prefill_target - req.num_computed_tokens - hit_tokens
             )
@@ -488,7 +535,9 @@ class Scheduler:
                     break
                 num_new = remaining_prompt
             # Admission: don't preempt running requests for new ones.
-            if hit_pages:
+            if plan is not None and hit_tokens:
+                ok = self.allocator.can_admit_plan(plan, num_new, restore)
+            elif hit_pages:
                 ok = self.allocator.can_allocate_with_prefix(
                     hit_pages, hit_tokens + num_new
                 )
@@ -497,16 +546,36 @@ class Scheduler:
             if not ok:
                 break
             self._waiting_pop(req, popleft=True)
-            if self.enable_prefix_caching:
-                self.prefix_cache_queries += req.prefill_target
-                self.prefix_cache_hits += hit_tokens
-                req.metrics.cached_tokens = hit_tokens
-                if hit_pages:
-                    self.allocator.attach_prefix(req, hit_pages)
+            host_hit = 0
+            try:
+                if self.enable_prefix_caching and hit_tokens:
+                    if plan is not None:
+                        restored = self.allocator.attach_plan(
+                            req, plan, restore
+                        )
+                        host_hit = restored * self.page_size
+                        self.kv_restore_pages += restored
+                    else:
+                        self.allocator.attach_prefix(req, hit_pages)
                     # The chunked-prefill path resumes from here, so the
                     # model runner gets the partial prefill for free.
                     req.num_computed_tokens = hit_tokens
-            new_pages = self.allocator.allocate(req, num_new)
+                new_pages = self.allocator.allocate(req, num_new)
+            except NoFreePagesError:
+                # The admission estimate can over-count free capacity in
+                # a rare radix corner (an unreffed interior above a
+                # reffed duplicate-content chain).  Roll back cleanly:
+                # the request re-queues untouched and this schedule
+                # stops admitting.
+                self.allocator.free(req)
+                req.num_computed_tokens = 0
+                self._waiting_push(req, left=True)
+                break
+            if self.enable_prefix_caching:
+                self.prefix_cache_queries += req.prefill_target
+                self.prefix_cache_hits += hit_tokens
+                self.prefix_cache_hits_host += host_hit
+                req.metrics.cached_tokens = hit_tokens
             if req.status == RequestStatus.WAITING:
                 req.metrics.first_scheduled_time = time.time()
                 req.metrics.first_scheduled_time_mono = time.monotonic()
@@ -533,6 +602,29 @@ class Scheduler:
                     sampling_params=req.sampling_params,
                 )
             )
+
+        # Tiered KV (ISSUE 14): ship the spill/restore spans this
+        # schedule produced (evictions during allocate, restores during
+        # attach) on this step — ahead of the step's own KV writes.
+        if self._tiered:
+            spill_ops, restore_ops = self.allocator.take_tier_ops()
+            self.kv_spill_pages += len(spill_ops)
+            if self._held_tier_ops is not None:
+                held_s, held_r = self._held_tier_ops
+                self._held_tier_ops = None
+                spill_ops = held_s + spill_ops
+                restore_ops = held_r + restore_ops
+            if spill_ops or restore_ops:
+                if out.is_empty:
+                    # Empty outputs are never dispatched — hold the
+                    # spans for the next step that reaches the workers.
+                    self._held_tier_ops = (spill_ops, restore_ops)
+                else:
+                    out.kv_spill_ops = spill_ops
+                    out.kv_restore_ops = restore_ops
+                    # Slots consumed by shipped restores become
+                    # reusable for FUTURE spill batches only.
+                    self.allocator.release_shipped_slots()
 
         out.preempted_req_ids = sorted(preempted)
         if self._held_notices is not None:

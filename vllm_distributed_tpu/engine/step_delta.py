@@ -77,6 +77,13 @@ class StepFrame:
     # before its cached entries — both sides advance by the same value
     # without a prediction, keeping lockstep without override warnings.
     spec_advance: dict[int, int] = field(default_factory=dict)
+    # ---- tiered KV cache (ISSUE 14) ----
+    # (hbm_page, host_slot) / (host_slot, hbm_page) spans the worker
+    # applies IN FRAME ORDER before executing the step (spills first,
+    # then restores) — page ids and slot ids are already worker-global,
+    # so they ship verbatim.
+    spills: list[tuple[int, int]] = field(default_factory=list)
+    restores: list[tuple[int, int]] = field(default_factory=list)
     trace_ctx: tuple | None = None
     # Escape hatch: a SchedulerOutput the codec cannot synthesize from
     # mirror state (num_scheduled_tokens entries with no matching
@@ -139,6 +146,8 @@ class StepDeltaEncoder:
             decode_steps=so.decode_steps,
             blocking=blocking,
             trace_ctx=so.trace_ctx,
+            spills=list(so.kv_spill_ops),
+            restores=list(so.kv_restore_ops),
         )
         # Order mirrors the worker's apply order (model_runner
         # _apply_scheduler_deltas): finished/preempted drop state before
@@ -232,6 +241,8 @@ class StepStateMirror:
                 if frame.trace_ctx is not None
                 else None
             ),
+            kv_spill_ops=[tuple(s) for s in frame.spills],
+            kv_restore_ops=[tuple(r) for r in frame.restores],
         )
         for idx in frame.finished:
             entry = self._by_index.pop(idx)

@@ -142,6 +142,23 @@ environment_variables: dict[str, Callable[[], Any]] = {
     "VDT_DRAIN_JOURNAL_PATH": lambda: os.environ.get(
         "VDT_DRAIN_JOURNAL_PATH", ""
     ),
+    # --- tiered KV cache (ISSUE 14) ---
+    # Host-DRAM spill tier size in KV pages: pages evicted from the HBM
+    # pool spill to a bounded host pool (worker-side device_get) and
+    # stream back ahead of a prefill resume instead of being
+    # recomputed.  0 = off (the default; evictions discard KV exactly
+    # like the seed prefix cache).  Only meaningful with
+    # --enable-prefix-caching and the radix index.
+    "VDT_KV_SPILL_HOST_PAGES": lambda: int(
+        os.environ.get("VDT_KV_SPILL_HOST_PAGES", "0")
+    ),
+    # Restore-vs-recompute crossover: a host-resident run shorter than
+    # this many tokens is recomputed instead of restored (below the
+    # crossover a DMA round trip costs more than the prefill it saves —
+    # bench the sweep with tools/prefix_cache_ablation.py --tiered).
+    "VDT_KV_SPILL_RESTORE_MIN_TOKENS": lambda: int(
+        os.environ.get("VDT_KV_SPILL_RESTORE_MIN_TOKENS", "32")
+    ),
     # --- speculative decoding (ISSUE 11) ---
     # Max tokens the n-gram prompt-lookup proposer drafts per request
     # per step (--speculative-ngram-k); the model runner verifies all

@@ -72,6 +72,13 @@ _COMPILE_BUCKETS = (
     0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 40.0,
     80.0, 160.0, 320.0,
 )
+# Host-tier restore batches (ISSUE 14): a few pages over PCIe/DMA —
+# sub-millisecond on loopback mocks, milliseconds for real page spans;
+# anything approaching prefill time means the crossover is set wrong.
+_KV_RESTORE_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
 
 # Span name (tracing.py) -> per-stage histogram attribute.  The tracer's
 # metrics sink feeds these, so the Prometheus histograms and the traces
@@ -97,6 +104,11 @@ DOCUMENTED_METRICS = (
     "vllm:num_preemptions_total",
     "vllm:prefix_cache_queries_total",
     "vllm:prefix_cache_hits_total",
+    # ---- tiered KV cache (ISSUE 14) ----
+    "vllm:kv_spill_pages_total",
+    "vllm:kv_restore_pages_total",
+    "vllm:kv_restore_seconds",
+    "vllm:host_kv_bytes",
     "vllm:spec_decode_draft_tokens_total",
     "vllm:spec_decode_accepted_tokens_total",
     "vllm:spec_decode_acceptance_length",
@@ -206,10 +218,45 @@ class EngineMetrics:
             "Tokens looked up in the prefix cache at (re-)admission "
             "(includes preemption-resume lookups)",
         )
-        self.prefix_cache_hits = counter(
+        # Split per tier (ISSUE 14): tier="hbm" counts resident hits,
+        # tier="host" tokens restored from the host-DRAM spill tier.
+        # Sum across tiers for the pre-tiering total.
+        self._prefix_cache_hits = Counter(
             "vllm:prefix_cache_hits",
             "Tokens served from cached KV pages instead of prefill "
-            "(cross-request prefix reuse and preemption-resume recovery)",
+            "(cross-request prefix reuse and preemption-resume "
+            'recovery), per cache tier: "hbm" resident pages, "host" '
+            "pages restored from the host-DRAM spill tier",
+            ["model_name", "tier"],
+            registry=self.registry,
+        )
+        self.prefix_cache_hits_hbm = self._prefix_cache_hits.labels(
+            model_name=model_name, tier="hbm"
+        )
+        self.prefix_cache_hits_host = self._prefix_cache_hits.labels(
+            model_name=model_name, tier="host"
+        )
+        # ---- tiered KV cache (ISSUE 14) ----
+        self.kv_spill_pages = counter(
+            "vllm:kv_spill_pages",
+            "KV pages spilled from the HBM pool to the host-DRAM tier "
+            "on eviction (worker-side device_get batches)",
+        )
+        self.kv_restore_pages = counter(
+            "vllm:kv_restore_pages",
+            "KV pages streamed back from the host-DRAM tier into "
+            "freshly allocated HBM pages ahead of a prefill resume",
+        )
+        self.kv_restore_seconds = histogram(
+            "vllm:kv_restore_seconds",
+            "Worker wall time applying a restore-bearing step's KV-tier "
+            "spans (the restore stall the engine.kv_restore span traces)",
+            _KV_RESTORE_BUCKETS,
+        )
+        self.host_kv_bytes = gauge(
+            "vllm:host_kv_bytes",
+            "Bytes of KV held in the host-DRAM spill tier "
+            "(slots in use x per-page pool bytes)",
         )
         # ---- speculative decoding (ISSUE 11) ----
         self.spec_draft_tokens = counter(
@@ -475,13 +522,37 @@ class EngineMetrics:
         if self.enabled and n:
             self.prompt_tokens.inc(n)
 
-    def record_prefix_cache(self, queries: int, hits: int) -> None:
+    def record_prefix_cache(
+        self, queries: int, hits: int, host_hits: int = 0
+    ) -> None:
+        """``hits`` is the TOTAL across tiers; ``host_hits`` the
+        host-restored share of it (tier="hbm" gets the remainder)."""
         if not self.enabled:
             return
         if queries:
             self.prefix_cache_queries.inc(queries)
-        if hits:
-            self.prefix_cache_hits.inc(hits)
+        if hits - host_hits > 0:
+            self.prefix_cache_hits_hbm.inc(hits - host_hits)
+        if host_hits:
+            self.prefix_cache_hits_host.inc(host_hits)
+
+    def record_kv_tier(
+        self, spilled: int, restored: int, host_bytes: int | None = None
+    ) -> None:
+        """Page deltas from one step's tier spans + the current host
+        occupancy (None leaves the gauge untouched)."""
+        if not self.enabled:
+            return
+        if spilled:
+            self.kv_spill_pages.inc(spilled)
+        if restored:
+            self.kv_restore_pages.inc(restored)
+        if host_bytes is not None:
+            self.host_kv_bytes.set(host_bytes)
+
+    def record_kv_restore_seconds(self, seconds: float) -> None:
+        if self.enabled:
+            self.kv_restore_seconds.observe(max(seconds, 0.0))
 
     def record_kv_cache_usage(self, frac: float) -> None:
         if self.enabled:

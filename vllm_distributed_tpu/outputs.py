@@ -30,6 +30,11 @@ class ModelRunnerOutput:
     # executor-side KVOutputAggregator intersects across the world.
     kv_finished_sending: set[str] = field(default_factory=set)
     kv_finished_recving: set[str] = field(default_factory=set)
+    # Tiered KV cache (ISSUE 14): wall seconds this worker spent
+    # applying the step's spill/restore spans (device_get/device_put
+    # batches) before executing — feeds vllm:kv_restore_seconds and the
+    # engine.kv_restore trace span on restore-bearing steps.
+    kv_tier_seconds: float = 0.0
 
 
 @dataclass

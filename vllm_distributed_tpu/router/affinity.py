@@ -20,15 +20,27 @@ must never collide):
   observe and score sides use the same chunking, so matching stays
   consistent even though the block boundary is approximate.
 
-Bounded: each replica remembers at most ``capacity`` block keys, LRU
-beyond that (a router restart simply starts cold).  Single-threaded:
-every call happens on the router's event loop.
+Since ISSUE 14 the index mirrors the allocator's RADIX structure too,
+not just its keying: per replica, block keys form a radix tree (each
+node = one block, children keyed by the next block's chain digest) with
+**leaf-first LRU eviction**, exactly like
+``block_manager.RadixPrefixCachingAllocator``.  The flat LRU set this
+replaces could evict an interior block while its suffix blocks survived
+— stranded entries that consumed capacity yet could never match again
+(scoring walks from the root and stops at the first gap).  Leaf-first
+eviction keeps every remembered block reachable, so the same capacity
+holds strictly more *matchable* prefix state and steering precision
+rises with the allocator's own hit rate.
+
+Bounded: each replica remembers at most ``capacity`` blocks, evicted
+leaf-first LRU beyond that (a router restart simply starts cold).
+Single-threaded: every call happens on the router's event loop.
 """
 
 from __future__ import annotations
 
 import hashlib
-from collections import OrderedDict
+import heapq
 
 _TEXT_BYTES_PER_TOKEN = 4
 
@@ -64,15 +76,109 @@ def chain_keys(
     return keys
 
 
+class _AffinityNode:
+    """One remembered block in a replica's radix tree (edge label =
+    the block's chain digest, so the tree IS the chain structure)."""
+
+    __slots__ = ("key", "parent", "children", "last_use", "stamp")
+
+    def __init__(self, key: str | None, parent) -> None:
+        self.key = key
+        self.parent = parent
+        self.children: dict[str, _AffinityNode] = {}
+        self.last_use = 0
+        self.stamp = 0
+
+
+class _ReplicaTree:
+    """Radix tree over one replica's remembered block chains, evicted
+    leaf-first LRU (lazy heap, entries validated at pop)."""
+
+    def __init__(self) -> None:
+        self.root = _AffinityNode(None, None)
+        self.count = 0
+        self._heap: list[tuple[int, int, _AffinityNode]] = []
+        self._stamp = 0
+
+    def _push_if_leaf(self, node: _AffinityNode) -> None:
+        self._stamp += 1
+        node.stamp = self._stamp
+        if not node.children and node.parent is not None:
+            heapq.heappush(self._heap, (node.last_use, node.stamp, node))
+            if len(self._heap) > 4 * self.count + 64:
+                # Compact stale entries (touch-heavy, eviction-light
+                # traffic would otherwise grow the lazy heap by one
+                # entry per scored chain, unbounded).
+                live = [
+                    e
+                    for e in self._heap
+                    if e[2].stamp == e[1]
+                    and not e[2].children
+                    and e[2].parent is not None
+                ]
+                self._heap = live
+                heapq.heapify(self._heap)
+
+    def insert(self, keys: list[str], tick: int) -> None:
+        node = self.root
+        for key in keys:
+            child = node.children.get(key)
+            if child is None:
+                child = _AffinityNode(key, node)
+                node.children[key] = child
+                self.count += 1
+                # The parent stopped being a leaf; its stale heap
+                # entries die at validation.
+            child.last_use = tick
+            node = child
+        self._push_if_leaf(node)
+
+    def match(self, keys: list[str], tick: int) -> int:
+        """Consecutive leading blocks held, refreshing the whole
+        matched path (cache-aware LRU, mirroring the allocator)."""
+        node = self.root
+        matched = 0
+        for key in keys:
+            child = node.children.get(key)
+            if child is None:
+                break
+            child.last_use = tick
+            matched += 1
+            node = child
+        if node is not self.root:
+            self._push_if_leaf(node)
+        return matched
+
+    def evict_leaf(self) -> bool:
+        """Remove the least-recently-used LEAF block (never an interior
+        block — suffixes can't be stranded)."""
+        while self._heap:
+            _, stamp, node = heapq.heappop(self._heap)
+            if (
+                node.stamp != stamp
+                or node.children
+                or node.parent is None
+            ):
+                continue
+            del node.parent.children[node.key]
+            parent = node.parent
+            node.parent = None
+            self.count -= 1
+            self._push_if_leaf(parent)  # may have just become a leaf
+            return True
+        return False
+
+
 class PrefixAffinityIndex:
-    """Per-replica LRU sets of prefix-chain block keys + longest-prefix
-    scoring over them."""
+    """Per-replica radix trees of prefix-chain blocks + longest-prefix
+    scoring over them (the router-side mirror of the allocator's radix
+    walk, ISSUE 14)."""
 
     def __init__(self, block_tokens: int = 16, capacity: int = 8192):
         self.block_tokens = max(1, block_tokens)
         self.capacity = max(1, capacity)
-        # replica_id -> OrderedDict[key -> None], most recent last.
-        self._blocks: dict[str, OrderedDict[str, None]] = {}
+        self._trees: dict[str, _ReplicaTree] = {}
+        self._tick = 0
 
     def keys_for(
         self,
@@ -86,27 +192,24 @@ class PrefixAffinityIndex:
         (call when the replica confirms service — first token or
         completed response — so the index tracks caches that exist,
         not placements that failed)."""
-        blocks = self._blocks.setdefault(replica_id, OrderedDict())
-        for key in keys:
-            if key in blocks:
-                blocks.move_to_end(key)
-            else:
-                blocks[key] = None
-        while len(blocks) > self.capacity:
-            blocks.popitem(last=False)
+        if not keys:
+            return
+        tree = self._trees.setdefault(replica_id, _ReplicaTree())
+        self._tick += 1
+        tree.insert(keys, self._tick)
+        while tree.count > self.capacity:
+            if not tree.evict_leaf():
+                break
 
     def score(self, keys: list[str]) -> dict[str, int]:
         """Approximate warm-prefix length per replica, in tokens: the
-        number of consecutive leading chain keys the replica holds,
-        times the block size.  Touches matched keys (LRU refresh)."""
+        number of consecutive leading chain blocks the replica holds,
+        times the block size.  Touches the matched path (LRU refresh
+        down the whole chain, like the allocator's radix walk)."""
         scores: dict[str, int] = {}
-        for replica_id, blocks in self._blocks.items():
-            matched = 0
-            for key in keys:
-                if key not in blocks:
-                    break
-                blocks.move_to_end(key)
-                matched += 1
+        for replica_id, tree in self._trees.items():
+            self._tick += 1
+            matched = tree.match(keys, self._tick)
             if matched:
                 scores[replica_id] = matched * self.block_tokens
         return scores
@@ -114,7 +217,8 @@ class PrefixAffinityIndex:
     def forget(self, replica_id: str) -> None:
         """Drop a replica's chains (its process died or drained: the
         KV cache backing them is gone)."""
-        self._blocks.pop(replica_id, None)
+        self._trees.pop(replica_id, None)
 
     def num_blocks(self, replica_id: str) -> int:
-        return len(self._blocks.get(replica_id, ()))
+        tree = self._trees.get(replica_id)
+        return tree.count if tree is not None else 0

@@ -156,6 +156,14 @@ class ModelRunner:
         self._param_bytes = 0
         self._kv_token_bytes = 0
         self._peak_bw = 0.0
+        # Tiered KV cache (ISSUE 14): host-DRAM copies of spilled pages,
+        # slot id -> per-layer pytree of [2, page, width] host arrays.
+        # Bounded by the driver-side allocator's host pool — slots are
+        # only reused after their restore shipped, so the dict can never
+        # exceed the configured host page count (plus entries whose
+        # slot the driver freed without a restore, until that slot's
+        # next spill overwrites them).
+        self._host_kv: dict[int, Any] = {}
 
     # ---- lifecycle (the collective_rpc verbs, launch.py:290-292) ----
     def load_model(self, load_format: str = "auto") -> None:
@@ -924,6 +932,79 @@ class ModelRunner:
             floor = max(floor, ml_pages)
         return max(next_power_of_2(need), floor)
 
+    # ---- tiered KV cache (ISSUE 14) ----
+    @partial(jax.jit, static_argnames=("self",), donate_argnums=(1,))
+    def _jit_write_kv_pages(self, leaf, idx, data):
+        """Scatter restored page data back into the (donated) pool leaf
+        in place — without donation XLA would copy the whole pool per
+        layer.  ``idx`` is padded with the reserved page 0 (garbage by
+        contract), so every restore batch of a size bucket shares one
+        compiled program."""
+        return leaf.at[:, idx].set(data)
+
+    def _apply_kv_tier_ops(self, so: SchedulerOutput) -> float:
+        """Apply a step's KV-tier spans BEFORE executing it: spills
+        first (``jax.device_get`` the evicted pages to host DRAM before
+        any step may overwrite them), then restores (``device_put`` the
+        streamed-back pages into their freshly allocated homes before
+        the step reads them).  Batched: one gather / one scatter per
+        layer leaf per batch, off the jitted step itself.  Returns the
+        wall seconds spent (the restore-stall observable)."""
+        spills = getattr(so, "kv_spill_ops", None) or []
+        restores = getattr(so, "kv_restore_ops", None) or []
+        if (not spills and not restores) or self.kv_caches is None:
+            return 0.0
+        t0 = time.perf_counter()
+        tree = jax.tree_util
+        if spills:
+            idx = jnp.asarray([p for p, _ in spills], jnp.int32)
+            # device_get blocks until any in-flight step producing the
+            # current pool has resolved — the page content captured is
+            # exactly what the allocator registered.
+            gathered = tree.tree_map(
+                lambda leaf: np.asarray(jax.device_get(leaf[:, idx])),
+                self.kv_caches,
+            )
+            for i, (_, slot) in enumerate(spills):
+                self._host_kv[slot] = tree.tree_map(
+                    lambda a: np.ascontiguousarray(a[:, i]), gathered
+                )
+        if restores:
+            n = len(restores)
+            npad = max(next_power_of_2(n), 1)
+            pages = np.zeros(npad, np.int32)  # pad -> reserved page 0
+            pages[:n] = [p for _, p in restores]
+            idx = jnp.asarray(pages)
+            # A missing slot is a protocol violation (the driver only
+            # restores slots it spilled and never reuses one before its
+            # restore shipped) — fail loudly, never serve garbage KV.
+            datas = [self._host_kv.pop(s) for s, _ in restores]
+            stacked = tree.tree_map(
+                lambda *xs: np.stack(xs, axis=1), datas[0], *datas[1:]
+            )
+            kv_leaves, treedef = tree.tree_flatten(self.kv_caches)
+            data_leaves, _ = tree.tree_flatten(stacked)
+            new_leaves = []
+            for leaf, dat in zip(kv_leaves, data_leaves):
+                if npad > n:
+                    pad = np.zeros(
+                        (dat.shape[0], npad - n) + dat.shape[2:], dat.dtype
+                    )
+                    dat = np.concatenate([dat, pad], axis=1)
+                new_leaves.append(
+                    self._jit_write_kv_pages(leaf, idx, jnp.asarray(dat))
+                )
+            self.kv_caches = tree.tree_unflatten(treedef, new_leaves)
+        return time.perf_counter() - t0
+
+    def host_kv_stats(self) -> dict:
+        """Host-tier occupancy (driver telemetry + leak assertions)."""
+        total = 0
+        for entry in self._host_kv.values():
+            for leaf in jax.tree_util.tree_leaves(entry):
+                total += leaf.nbytes
+        return {"host_slots": len(self._host_kv), "host_bytes": total}
+
     # ---- per-step state mirroring ----
     def _apply_scheduler_deltas(self, so: SchedulerOutput) -> None:
         for req_id in so.finished_req_ids:
@@ -948,6 +1029,10 @@ class ModelRunner:
     # ---- the step ----
     def execute_model(self, so: SchedulerOutput) -> ModelRunnerOutput:
         self._apply_scheduler_deltas(so)
+        # KV-tier spans land before ANY path may touch their pages
+        # (spills before the evicted page is rewritten, restores before
+        # the attached chain is read).
+        tier_s = self._apply_kv_tier_ops(so)
         if so.is_empty:
             return ModelRunnerOutput()
         if so.draft_token_ids:
@@ -1091,6 +1176,9 @@ class ModelRunner:
         )
 
         out = ModelRunnerOutput()
+        # Restore-bearing steps are always admission (blocking) steps,
+        # so the stall lands on the output the engine actually reads.
+        out.kv_tier_seconds = tier_s
         for s, (state, n) in enumerate(zip(states, num_new)):
             state.num_computed += n
             if not needs_sample[s]:

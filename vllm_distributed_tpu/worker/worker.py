@@ -181,6 +181,16 @@ class Worker:
     def check_health(self) -> bool:
         return True
 
+    def get_kv_tier_info(self) -> dict | None:
+        """Tiered-KV telemetry RPC (ISSUE 14): per-page pool bytes (the
+        driver's host_kv_bytes gauge scale) and this worker's live
+        host-tier occupancy (leak assertions in the chaos harness)."""
+        if self.runner is None or not self.is_driver_worker:
+            return None
+        info = {"page_bytes": self.runner.kv_cache_bytes_per_page()}
+        info.update(self.runner.host_kv_stats())
+        return info
+
     def get_device_telemetry(self) -> dict | None:
         """XLA compile / HBM / roofline snapshot (ISSUE 12): the driver
         pulls this on /metrics scrapes and folds it into the engine's
