@@ -27,3 +27,12 @@ class RouterResumeFanIn:
     # growth instead of backpressure on the upstream reads.
     def __init__(self):
         self.frames = asyncio.Queue()  # EXPECT
+
+
+class KVTransferInbox:
+    # The ISSUE 15 transfer pattern gone wrong: buffering inbound KV
+    # chunk frames in an unbounded queue turns one slow scatter into
+    # unbounded host memory instead of backpressure on the sender.
+    def __init__(self):
+        self.chunks = asyncio.Queue()  # EXPECT
+        self.pending_imports = deque()  # EXPECT

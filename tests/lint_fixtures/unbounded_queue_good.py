@@ -26,3 +26,11 @@ class RouterResumeFanIn:
     # slowly.
     def __init__(self):
         self.frames = asyncio.Queue(maxsize=64)
+
+
+class KVTransferInbox:
+    # The ISSUE 15 transfer pattern done right: a bounded chunk buffer
+    # backpressures the sending replica when the local scatter lags.
+    def __init__(self):
+        self.chunks = asyncio.Queue(maxsize=8)
+        self.pending_imports = deque(maxlen=64)

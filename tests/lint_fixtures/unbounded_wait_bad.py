@@ -39,3 +39,18 @@ def reap_child(proc):
     # wait wedges the router's scale-down/shutdown on one stuck
     # replica instead of escalating TERM -> KILL.
     return proc.wait()  # EXPECT
+
+
+def kv_export_collective(executor, pages):
+    # The ISSUE 15 hand-off pattern gone wrong: an unbounded export
+    # collective parks the engine thread (and every stream on the
+    # replica) behind one wedged device gather.
+    fut = executor.collective_rpc("export_kv_pages", (pages, 0, 4))
+    return fut.result()  # EXPECT
+
+
+async def kv_handoff_transfer(session, decode_url):
+    # ...and the unbounded import read on the router side of the hop.
+    resp = await session.post(decode_url, json={"op": "chunk"})
+    body = await resp.read()  # EXPECT
+    return body

@@ -74,3 +74,16 @@ def reap_child(proc):
     except Exception:
         proc.kill()
         return proc.wait(timeout=5)
+
+
+async def kv_handoff_transfer(executor, session, pages, decode_url):
+    # The ISSUE 15 hand-off patterns done right: the export collective
+    # and the import read both carry deadlines, so a wedged transfer
+    # fails the hand-off (router falls back to recompute) instead of
+    # parking the engine thread.
+    chunk = executor.collective_rpc(
+        "export_kv_pages", (pages, 0, 4), timeout=60.0
+    )
+    resp = await session.post(decode_url, json={"op": "chunk"})
+    body = await asyncio.wait_for(resp.read(), timeout=30)
+    return chunk, body

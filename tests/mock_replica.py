@@ -32,6 +32,7 @@ def _child_main(
     model_dir: str,
     extra_env: dict[str, str] | None = None,
     max_num_seqs: int = 2,
+    enable_prefix_caching: bool = False,
 ) -> None:
     for k, v in (extra_env or {}).items():
         os.environ[k] = v
@@ -57,6 +58,9 @@ def _child_main(
                 max_model_len=256,
                 num_decode_steps=1,
                 max_num_seqs=max_num_seqs,
+                # Radix index on demand (ISSUE 15): the decode side of
+                # a KV hand-off needs it to adopt imported pages.
+                enable_prefix_caching=enable_prefix_caching,
                 distributed_executor_backend=MockUniProcExecutor,
             )
         )
@@ -194,6 +198,13 @@ def main() -> None:
         help="llama config dir; written fresh to a tempdir when empty",
     )
     parser.add_argument("--max-num-seqs", type=int, default=2)
+    parser.add_argument(
+        "--enable-prefix-caching",
+        action="store_true",
+        default=False,
+        help="boot with the radix prefix index (required on the decode "
+        "side of an ISSUE 15 KV hand-off)",
+    )
     args = parser.parse_args()
     model_dir = args.model_dir
     if not model_dir:
@@ -212,7 +223,11 @@ def main() -> None:
         or f"mock-{args.port}"
     )
     _child_main(
-        args.port, replica_id, model_dir, max_num_seqs=args.max_num_seqs
+        args.port,
+        replica_id,
+        model_dir,
+        max_num_seqs=args.max_num_seqs,
+        enable_prefix_caching=args.enable_prefix_caching,
     )
 
 

@@ -293,6 +293,67 @@ class MockWorker:
                 row[pos % ps] = st["tokens"][pos]
             st["computed"] += adv
 
+    # ---- KV-page export/import (disaggregated prefill, ISSUE 15) ----
+    # The mock's "KV" is the simulated page-content store (token ids per
+    # page row), so a handed-off page carries exactly the content the
+    # decode-side admission verification checks against the prompt — a
+    # transfer that reorders, corrupts, or half-applies pages fails
+    # loudly.  Two synthetic "layers" (identical rows) exercise the
+    # per-layer chunking + completeness contract without chips.
+    MOCK_KV_LAYERS = 2
+
+    def export_kv_pages(
+        self, page_ids: list[int], layer_start: int, layer_count: int
+    ) -> dict | None:
+        if not self.is_driver_worker:
+            return None
+        import hashlib
+        import json
+
+        ps = self._kv_page_size
+        rows = [
+            list(self._kv_pages.get(p, [None] * ps)) for p in page_ids
+        ]
+        data = json.dumps(rows).encode()
+        checksum = hashlib.sha256(data).hexdigest()
+        start = max(int(layer_start), 0)
+        end = min(start + max(int(layer_count), 0), self.MOCK_KV_LAYERS)
+        return {
+            "num_layers": self.MOCK_KV_LAYERS,
+            "layers": [
+                {
+                    "index": i,
+                    "num_layers": self.MOCK_KV_LAYERS,
+                    "data": data,
+                    "checksum": checksum,
+                }
+                for i in range(start, end)
+            ],
+        }
+
+    def import_kv_pages(
+        self, page_ids: list[int], layers: list[dict]
+    ) -> dict | None:
+        if not self.is_driver_worker:
+            return None
+        import hashlib
+        import json
+
+        for layer in layers:
+            data = layer["data"]
+            if hashlib.sha256(data).hexdigest() != layer["checksum"]:
+                return {
+                    "ok": False,
+                    "error": (
+                        f"kv transfer checksum mismatch on layer "
+                        f"{layer.get('index')}"
+                    ),
+                }
+            rows = json.loads(data)
+            for page, row in zip(page_ids, rows):
+                self._kv_pages[page] = list(row)
+        return {"ok": True}
+
     def get_kv_tier_info(self) -> dict | None:
         if not self.is_driver_worker:
             return None

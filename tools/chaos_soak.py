@@ -24,6 +24,7 @@ import argparse
 import json
 import multiprocessing
 import os
+import shutil
 import statistics
 import sys
 import tempfile
@@ -916,6 +917,339 @@ def run_router_soak(
 
 
 # ---------------------------------------------------------------------
+# Disaggregation chaos (ISSUE 15): a prefill-role + decode-role mock
+# pool behind the router, SIGKILLing the prefill replica mid-hand-off
+# and mid-export — the router must fall back to recompute-resume on the
+# decode pool with zero lost admitted work, bit-identical greedy
+# output, and no leaked pages on either surviving replica.
+# ---------------------------------------------------------------------
+def run_disagg_soak(
+    cycles: int = 4,
+    *,
+    max_tokens: int = 10,
+    prompt_pages: int = 3,
+    stall_bound_s: float = 20.0,
+) -> dict:
+    """Each cycle streams one long (page-aligned) prompt through a
+    disaggregated 2-replica pool.  Cycle 0 is the happy path (planned
+    hand-off, KV pages adopted decode-side); odd cycles kill the
+    prefill replica BEFORE the transfer starts (mid-hand-off), even
+    cycles > 0 kill it after the first export chunk (mid-export) — via
+    the deterministic disagg test seams.  Every stream must finish with
+    the exact position-token sequence, and after each cycle the decode
+    replica's allocator must account for every page (imports/holds
+    empty, free count restored modulo cached-free chains).
+
+    Mutates (and restores) os.environ; call from a dedicated process or
+    a test that tolerates env churn."""
+    import asyncio
+
+    from tests.mock_worker import MockUniProcExecutor
+    from vllm_distributed_tpu.config import EngineArgs
+    from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+    from vllm_distributed_tpu.entrypoints.openai.api_server import (
+        build_app,
+        init_app_state,
+        serve_http,
+    )
+    from vllm_distributed_tpu.router import disagg
+    from vllm_distributed_tpu.router.app import (
+        RouterState,
+        build_router_app,
+    )
+    from vllm_distributed_tpu.testing import write_llama_config
+    from vllm_distributed_tpu.utils import get_open_port
+
+    page_size = 16
+    prompt = [(i % 900) + 1 for i in range(prompt_pages * page_size)]
+    env = {
+        **ROUTER_AGENT_ENV,
+        # Position-token mode + a low crossover so every cycle's prompt
+        # plans a hand-off; small pools keep accounting checks tight.
+        "VDT_DISAGG_MIN_PROMPT_TOKENS": str(len(prompt) - 1),
+        "VDT_DISAGG_EXPORT_TTL_SECONDS": "10",
+        # One layer per chunk: the mock's 2 synthetic layers then need
+        # 2 round trips, so the mid-export kill really lands between
+        # chunks of one transfer.
+        "VDT_DISAGG_CHUNK_LAYERS": "1",
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    tmpdir = tempfile.mkdtemp(prefix="vdt_disagg_soak_")
+    model_dir = write_llama_config(os.path.join(tmpdir, "m"))
+    expected = list(range(len(prompt), len(prompt) + max_tokens))
+
+    def mk_engine() -> AsyncLLM:
+        return AsyncLLM.from_engine_args(
+            EngineArgs(
+                model=model_dir,
+                skip_tokenizer_init=True,
+                load_format="dummy",
+                num_kv_pages=96,
+                page_size=page_size,
+                max_model_len=2 * len(prompt),
+                num_decode_steps=1,
+                enable_prefix_caching=True,
+                distributed_executor_backend=MockUniProcExecutor,
+            )
+        )
+
+    stats = {
+        "admitted": 0,
+        "completed": 0,
+        "mismatches": 0,
+        "lost": 0,
+        "leaks": 0,
+    }
+    stalls: list[float] = []
+
+    async def go() -> dict:
+        import aiohttp
+
+        roles = ["prefill", "decode"]
+        engines: list = [mk_engine() for _ in roles]
+        ports = [get_open_port() for _ in roles]
+        runners: list = [None] * len(roles)
+
+        async def start_replica(i: int) -> None:
+            state = init_app_state(
+                engines[i],
+                served_model_name="disagg-soak",
+                replica_id=f"replica-{roles[i]}",
+                role=roles[i],
+            )
+            for _ in range(50):
+                try:
+                    runners[i] = await serve_http(
+                        build_app(state),
+                        host="127.0.0.1",
+                        port=ports[i],
+                        shutdown_timeout=0.05,
+                    )
+                    return
+                except OSError:
+                    await asyncio.sleep(0.1)
+            raise RuntimeError(f"could not rebind replica {i}")
+
+        for i in range(len(roles)):
+            await start_replica(i)
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+        router_state = RouterState(
+            urls,
+            policy="least_loaded",
+            health_interval=0.3,
+            connect_timeout=2,
+            read_timeout=30,
+        )
+        router_port = get_open_port()
+        router_runner = await serve_http(
+            build_router_app(router_state),
+            host="127.0.0.1",
+            port=router_port,
+        )
+        router_url = f"http://127.0.0.1:{router_port}"
+        timeout = aiohttp.ClientTimeout(total=None, sock_read=60)
+
+        async def kill_prefill() -> None:
+            runner, runners[0] = runners[0], None
+            if runner is not None:
+                await runner.cleanup()
+            engines[0].shutdown()
+
+        async def revive_prefill() -> None:
+            try:
+                engines[0].shutdown()
+            except Exception:  # noqa: BLE001 — already dead
+                pass
+            engines[0] = mk_engine()
+            await start_replica(0)
+            # Let the health poll re-learn the replica and its role.
+            await asyncio.sleep(0.6)
+
+        async def one_stream(session, tag: str) -> None:
+            body = {
+                "prompt": list(prompt),
+                "max_tokens": max_tokens,
+                "temperature": 0.0,
+                "ignore_eos": True,
+                "stream": True,
+            }
+            try:
+                async with session.post(
+                    f"{router_url}/v1/completions",
+                    json=body,
+                    headers={"X-VDT-Router": "1"},
+                    timeout=timeout,
+                ) as resp:
+                    if resp.status != 200:
+                        stats["lost"] += 1
+                        return
+                    stats["admitted"] += 1
+                    toks: list[int] = []
+                    finished = False
+                    last = time.monotonic()
+                    worst_gap = 0.0
+                    async for raw in resp.content:
+                        line = raw.decode().strip()
+                        if not line.startswith("data:"):
+                            continue
+                        payload = line[5:].strip()
+                        if payload == "[DONE]":
+                            finished = True
+                            break
+                        obj = json.loads(payload)
+                        if "error" in obj and not obj.get("choices"):
+                            break
+                        now = time.monotonic()
+                        worst_gap = max(worst_gap, now - last)
+                        last = now
+                        for ch in obj.get("choices") or ():
+                            toks += ch.get("vdt_token_ids") or []
+                    stalls.append(worst_gap)
+                    if not finished:
+                        stats["lost"] += 1
+                    elif toks != expected:
+                        stats["mismatches"] += 1
+                        print(
+                            f"{tag}: TOKEN MISMATCH {toks} != {expected}",
+                            file=sys.stderr,
+                        )
+                    else:
+                        stats["completed"] += 1
+            except Exception as e:  # noqa: BLE001 — an admitted stream erroring out IS lost work
+                stats["lost"] += 1
+                print(f"{tag}: stream error {e}", file=sys.stderr)
+
+        def check_decode_accounting(tag: str) -> None:
+            """No leaked pages on the surviving decode replica: every
+            transfer settled (imports/holds empty) and every page either
+            free or cached-free (live requests all finished)."""
+            engine = engines[1].engine
+            kvt = engine.kv_transfer
+            allocator = engine.scheduler.allocator
+            usable = allocator.num_pages - 1
+            ok = (
+                not kvt.imports
+                and not kvt.holds
+                and allocator.num_free_pages == usable
+            )
+            if not ok:
+                stats["leaks"] += 1
+                print(
+                    f"{tag}: PAGE LEAK imports={len(kvt.imports)} "
+                    f"holds={len(kvt.holds)} "
+                    f"free={allocator.num_free_pages}/{usable}",
+                    file=sys.stderr,
+                )
+
+        async def cycle(session, n: int) -> None:
+            mode = (
+                "planned"
+                if n == 0
+                else ("mid_handoff" if n % 2 else "mid_export")
+            )
+            fired = asyncio.Event()
+
+            async def seam_kill() -> None:
+                if fired.is_set():
+                    return
+                fired.set()
+                await kill_prefill()
+
+            disagg._test_before_transfer = (
+                seam_kill if mode == "mid_handoff" else None
+            )
+
+            async def after_chunk(idx: int) -> None:
+                if idx == 1:
+                    await seam_kill()
+
+            disagg._test_after_chunk = (
+                after_chunk if mode == "mid_export" else None
+            )
+            try:
+                await asyncio.wait_for(
+                    one_stream(session, f"cycle{n}-{mode}"), timeout=90
+                )
+            finally:
+                disagg._test_before_transfer = None
+                disagg._test_after_chunk = None
+            # Let aborts/releases settle, then audit the decode pool.
+            await asyncio.sleep(0.3)
+            check_decode_accounting(f"cycle{n}-{mode}")
+            if mode != "planned":
+                await revive_prefill()
+
+        async with aiohttp.ClientSession() as session:
+            for n in range(cycles):
+                await cycle(session, n)
+            async with session.get(
+                f"{router_url}/router/state",
+                timeout=aiohttp.ClientTimeout(total=10),
+            ) as resp:
+                router_counters = (await resp.json())["counters"]
+        await router_runner.cleanup()
+        for runner in runners:
+            if runner is not None:
+                await runner.cleanup()
+        for engine in engines:
+            try:
+                engine.shutdown()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        return router_counters
+
+    try:
+        router_counters = (
+            asyncio.new_event_loop().run_until_complete(go())
+        )
+        handoffs = {
+            k: v
+            for k, v in router_counters.items()
+            if k.startswith("handoffs.")
+        }
+        migrations = sum(
+            v
+            for k, v in router_counters.items()
+            if k.startswith("migrations.")
+        )
+        report = {
+            "mode": "disagg",
+            "cycles": cycles,
+            **stats,
+            "handoffs": handoffs,
+            "migrations": migrations,
+            "router_counters": router_counters,
+            "stall_seconds": {
+                "p50": round(_percentile(stalls, 0.5), 3),
+                "max": round(max(stalls), 3) if stalls else 0.0,
+            },
+            # The acceptance contract: zero lost admitted work, greedy
+            # bit-identity across every fallback, a real planned
+            # hand-off observed, fallbacks engaged on the kills, no
+            # leaked pages, and the happy path never burned migration
+            # budget.
+            "bounded": (
+                stats["lost"] == 0
+                and stats["mismatches"] == 0
+                and stats["leaks"] == 0
+                and handoffs.get("handoffs.planned", 0) >= 1
+                and handoffs.get("handoffs.fallback", 0)
+                >= max(cycles - 1, 0)
+                and (not stalls or max(stalls) <= stall_bound_s)
+            ),
+        }
+        return report
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------
 # Resize-chaos ramp (ISSUE 13): an autoscaled fleet of managed mock
 # replicas under a Poisson rate sweep, with a SIGKILL mid-resize —
 # asserting zero lost admitted work, zero token mismatches, every
@@ -1366,6 +1700,17 @@ def main() -> None:
         "autoscale acceptance run)",
     )
     parser.add_argument(
+        "--disagg",
+        action="store_true",
+        help="ISSUE 15 disaggregation phase: a prefill-role + "
+        "decode-role mock pool behind the router, SIGKILLing the "
+        "prefill replica mid-hand-off and mid-export — asserts "
+        "recompute fallback engages with zero lost admitted work, "
+        "bit-identical greedy output, at least one planned hand-off, "
+        "no leaked pages, and no migration budget burned by the "
+        "happy path",
+    )
+    parser.add_argument(
         "--kv-spill",
         action="store_true",
         help="ISSUE 14 spill phase: kill-recover cycles with an ACTIVE "
@@ -1374,6 +1719,14 @@ def main() -> None:
         "recoveries, and RSS plateaus (no host-memory leak)",
     )
     args = parser.parse_args()
+    if args.disagg:
+        report = run_disagg_soak(
+            cycles=args.cycles, max_tokens=args.max_tokens
+        )
+        print(json.dumps(report))
+        if not report["bounded"]:
+            sys.exit(1)
+        return
     if args.kv_spill:
         report = run_kv_spill_soak(
             cycles=args.cycles, max_tokens=args.max_tokens

@@ -1,0 +1,319 @@
+"""Transfer-vs-recompute crossover sweep for disaggregated prefill
+(ISSUE 15) — the bench that picks VDT_DISAGG_MIN_PROMPT_TOKENS.
+
+For each prompt length L the harness measures, on a 2-replica mock (or
+real-CPU) pair over real loopback HTTP:
+
+- **recompute**: time-to-first-frame of an ``/internal/resume`` on the
+  decode replica with NO transferred pages — the decode side re-prefills
+  all L tokens (the PR 8 fallback path).
+- **transfer**: the full hand-off — prefill-only request on the prefill
+  replica, per-layer KV export→import streaming, commit, then
+  time-to-first-frame of the resume that attaches the imported pages as
+  computed.  Reported as the transfer wall plus the resume TTFT.
+
+The crossover is the smallest L where the hand-off beats recompute;
+below it the router should serve the prompt on the decode pool like
+today.  ``VDT_MOCK_TOKEN_SECONDS`` makes mock prefill cost proportional
+to L so the sweep has a real slope without chips (the default here);
+on hardware, run against real replicas with ``--no-mock-env``.
+
+Usage::
+
+    python -m tools.disagg_crossover [--lengths 64,128,...] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+_MOCK_ENV = {
+    "VDT_MOCK_TOKEN_SEQ": "1",
+    # Prefill cost proportional to scheduled tokens: the recompute arm
+    # scales with L, the transfer arm with page bytes.
+    "VDT_MOCK_TOKEN_SECONDS": "0.002",
+}
+
+
+async def _sweep(args, model_dir: str) -> dict:
+    import aiohttp
+
+    from tests.mock_worker import MockUniProcExecutor
+    from vllm_distributed_tpu.config import EngineArgs
+    from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+    from vllm_distributed_tpu.entrypoints.openai.api_server import (
+        build_app,
+        init_app_state,
+        serve_http,
+    )
+    from vllm_distributed_tpu.utils import get_open_port
+
+    page_size = 16
+    max_len = 2 * (max(args.lengths) + 8)
+
+    def mk_engine() -> AsyncLLM:
+        return AsyncLLM.from_engine_args(
+            EngineArgs(
+                model=model_dir,
+                skip_tokenizer_init=True,
+                load_format="dummy",
+                num_kv_pages=4 * (max_len // page_size),
+                page_size=page_size,
+                max_model_len=max_len,
+                num_decode_steps=1,
+                enable_prefix_caching=True,
+                distributed_executor_backend=MockUniProcExecutor,
+            )
+        )
+
+    engines = [mk_engine(), mk_engine()]
+    runners = []
+    urls = []
+    for i, role in enumerate(("prefill", "decode")):
+        state = init_app_state(
+            engines[i],
+            served_model_name="crossover",
+            replica_id=f"xo-{role}",
+            role=role,
+        )
+        port = get_open_port()
+        runners.append(
+            await serve_http(build_app(state), host="127.0.0.1", port=port)
+        )
+        urls.append(f"http://127.0.0.1:{port}")
+    prefill_url, decode_url = urls
+
+    timeout = aiohttp.ClientTimeout(total=120)
+    rows = []
+    try:
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+
+            async def post(url, payload):
+                async with session.post(url, json=payload) as resp:
+                    body = await resp.json()
+                    if resp.status != 200:
+                        raise RuntimeError(f"{url}: HTTP {resp.status} {body}")
+                    return body
+
+            async def resume_ttft(
+                rid: str, prompt: list[int], emitted: list[int]
+            ) -> float:
+                """Time to the first token frame of an /internal/resume
+                on the decode replica."""
+                t0 = time.perf_counter()
+                first = None
+                async with session.post(
+                    f"{decode_url}/internal/resume",
+                    json={
+                        "request_id": rid,
+                        "kind": "completions",
+                        "body": {
+                            "prompt": prompt,
+                            "max_tokens": 4,
+                            "temperature": 0.0,
+                            "ignore_eos": True,
+                            "stream": True,
+                        },
+                        "prompt_token_ids": prompt,
+                        "emitted_token_ids": emitted,
+                    },
+                ) as resp:
+                    resp.raise_for_status()
+                    # Drain fully (clean server-side close); the stamp
+                    # is the FIRST token frame.
+                    async for raw in resp.content:
+                        line = raw.decode().strip()
+                        if line.startswith("data:") and line[5:].strip() not in (
+                            "",
+                            "[DONE]",
+                        ):
+                            obj = json.loads(line[5:].strip())
+                            if first is None and obj.get("token_ids"):
+                                first = time.perf_counter() - t0
+                return first if first is not None else time.perf_counter() - t0
+
+            async def prefill_only(
+                prompt: list[int], tag: str
+            ) -> tuple[str, list[int]]:
+                """Run the prefill-only hop directly; returns
+                (kv_handle, emitted_token_ids)."""
+                handle = None
+                emitted: list[int] = []
+                async with session.post(
+                    f"{prefill_url}/v1/completions",
+                    json={
+                        "prompt": prompt,
+                        "max_tokens": 8,
+                        "temperature": 0.0,
+                        "ignore_eos": True,
+                        "stream": True,
+                    },
+                    headers={
+                        "X-VDT-Router": "1",
+                        "X-VDT-Disagg": "prefill",
+                    },
+                ) as resp:
+                    resp.raise_for_status()
+                    async for raw in resp.content:
+                        line = raw.decode().strip()
+                        if not line.startswith("data:"):
+                            continue
+                        payload = line[5:].strip()
+                        if payload == "[DONE]":
+                            break
+                        obj = json.loads(payload)
+                        for ch in obj.get("choices") or ():
+                            emitted += ch.get("vdt_token_ids") or []
+                            if ch.get("vdt_kv_handle"):
+                                handle = ch["vdt_kv_handle"]
+                if handle is None:
+                    raise RuntimeError(f"{tag}: no kv handle")
+                return handle, emitted
+
+            for length in args.lengths:
+                # Distinct token alphabets per arm so the decode
+                # replica's prefix cache can't cross-contaminate arms.
+                p_rec = [(3 * length + j) % 700 + 1 for j in range(length)]
+                p_xfer = [(5 * length + j) % 700 + 100 for j in range(length)]
+
+                # Arm 1: recompute-resume (prefill happened elsewhere;
+                # decode re-prefills everything).
+                t_rec = await resume_ttft(f"rec-{length}", p_rec, [])
+
+                # Arm 2: the real hand-off.
+                handle, emitted = await prefill_only(p_xfer, f"x-{length}")
+                t0 = time.perf_counter()
+                begin = await post(
+                    f"{decode_url}/internal/kv",
+                    {"op": "begin", "prompt_token_ids": p_xfer},
+                )
+                tid = begin.get("transfer_id")
+                layer = 0
+                num_layers = None
+                while tid and (num_layers is None or layer < num_layers):
+                    chunk = await post(
+                        f"{prefill_url}/internal/kv/export",
+                        {
+                            "handle": handle,
+                            "layer_start": layer,
+                            "layer_count": args.chunk_layers,
+                        },
+                    )
+                    num_layers = chunk["num_layers"]
+                    await post(
+                        f"{decode_url}/internal/kv",
+                        {
+                            "op": "chunk",
+                            "transfer_id": tid,
+                            "layers": chunk["layers"],
+                        },
+                    )
+                    layer += len(chunk["layers"])
+                adopted = 0
+                if tid:
+                    commit = await post(
+                        f"{decode_url}/internal/kv",
+                        {"op": "commit", "transfer_id": tid},
+                    )
+                    adopted = commit.get("adopted_tokens", 0)
+                await post(
+                    f"{prefill_url}/internal/kv/release",
+                    {"handle": handle},
+                )
+                transfer_s = time.perf_counter() - t0
+                t_resume = await resume_ttft(
+                    f"xfer-{length}", p_xfer, emitted[:1]
+                )
+                rows.append(
+                    {
+                        "prompt_tokens": length,
+                        "recompute_ttft_s": round(t_rec, 4),
+                        "transfer_s": round(transfer_s, 4),
+                        "handoff_ttft_s": round(transfer_s + t_resume, 4),
+                        "adopted_tokens": adopted,
+                    }
+                )
+    finally:
+        for runner in runners:
+            await runner.cleanup()
+        for engine in engines:
+            engine.shutdown()
+
+    crossover = next(
+        (
+            r["prompt_tokens"]
+            for r in rows
+            if r["handoff_ttft_s"] < r["recompute_ttft_s"]
+        ),
+        None,
+    )
+    return {
+        "mode": "disagg_crossover",
+        "rows": rows,
+        "recommended_min_prompt_tokens": crossover,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--lengths",
+        type=lambda s: [int(x) for x in s.split(",")],
+        default=[64, 128, 256, 512, 1024],
+        help="comma-separated prompt lengths to sweep",
+    )
+    parser.add_argument("--chunk-layers", type=int, default=4)
+    parser.add_argument(
+        "--no-mock-env",
+        action="store_true",
+        help="do not install the deterministic mock cost model env "
+        "(real-hardware runs)",
+    )
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    args = parser.parse_args()
+
+    saved = {k: os.environ.get(k) for k in _MOCK_ENV}
+    if not args.no_mock_env:
+        os.environ.update(_MOCK_ENV)
+    tmpdir = tempfile.mkdtemp(prefix="vdt_disagg_xo_")
+    try:
+        from vllm_distributed_tpu.testing import write_llama_config
+
+        model_dir = write_llama_config(os.path.join(tmpdir, "m"))
+        report = asyncio.new_event_loop().run_until_complete(
+            _sweep(args, model_dir)
+        )
+    finally:
+        if not args.no_mock_env:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    if args.as_json:
+        print(json.dumps(report))
+        return
+    print(f"{'tokens':>8} {'recompute_s':>12} {'handoff_s':>10} {'adopted':>8}")
+    for r in report["rows"]:
+        print(
+            f"{r['prompt_tokens']:>8} {r['recompute_ttft_s']:>12.4f} "
+            f"{r['handoff_ttft_s']:>10.4f} {r['adopted_tokens']:>8}"
+        )
+    rec = report["recommended_min_prompt_tokens"]
+    print(
+        f"recommended VDT_DISAGG_MIN_PROMPT_TOKENS: "
+        f"{rec if rec is not None else 'no crossover in sweep'}"
+    )
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -218,6 +218,11 @@ class UnboundedWaitChecker(Checker):
         # client stream (Llumnix-style migration is only safe on a
         # deadline-disciplined control plane).
         "router/",
+        # ISSUE 15: the KV hand-off module drives device collectives
+        # and cross-replica transfers from the engine thread — an
+        # unbounded export/import wait would park token generation for
+        # the whole replica behind one wedged transfer.
+        "engine/kv_transfer.py",
     )
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:

@@ -456,6 +456,12 @@ class RouterArgs:
     # `vdt serve` replicas from the --fleet-cmd template.
     fleet_size: int = 0
     fleet_cmd: str | None = None  # None -> $VDT_FLEET_CMD
+    # Disaggregated pools (ISSUE 15): fixed per-role replica counts
+    # spawned alongside the mixed fleet from the same --fleet-cmd
+    # template (the launcher sets VDT_ROUTER_ROLE and substitutes a
+    # {role} placeholder when present).  0 = no role-separated pools.
+    fleet_prefill: int = 0
+    fleet_decode: int = 0
     # Arm the autoscaler control loop over the managed fleet
     # (min/max None -> $VDT_AUTOSCALE_MIN/MAX_REPLICAS).
     autoscale: bool = False
@@ -534,6 +540,19 @@ class RouterArgs:
             "(and optional {replica_id}) placeholders, e.g. "
             "'vdt serve MODEL --host 127.0.0.1 --port {port}' "
             "(default: $VDT_FLEET_CMD)",
+        )
+        parser.add_argument(
+            "--fleet-prefill", type=int, default=0,
+            help="spawn this many PREFILL-role managed replicas "
+            "(disaggregated prefill/decode, ISSUE 15): long prompts "
+            "prefill here and hand their KV pages off to the "
+            "decode/mixed pool at first token; 0 = no prefill pool",
+        )
+        parser.add_argument(
+            "--fleet-decode", type=int, default=0,
+            help="spawn this many DECODE-role managed replicas "
+            "alongside the mixed fleet; 0 = none (mixed replicas "
+            "already decode)",
         )
         parser.add_argument(
             "--autoscale", action="store_true", default=False,

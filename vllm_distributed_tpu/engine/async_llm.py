@@ -734,6 +734,48 @@ class AsyncLLM:
             lambda ids: self.engine.score(ids), prompt_token_ids
         )
 
+    # ---- KV-page hand-off (disaggregated prefill, ISSUE 15) ----
+    # All ride the aux path: allocator mutation happens on the engine
+    # thread (serialized with the scheduler) and the export/import
+    # collectives stay ordered with step dispatches mesh-wide.
+    async def kv_export(
+        self, handle: str, layer_start: int, layer_count: int
+    ) -> dict:
+        return await self._run_aux(
+            lambda: self.engine.kv_transfer.export(
+                handle, layer_start, layer_count
+            )
+        )
+
+    async def kv_release(self, handle: str) -> bool:
+        return await self._run_aux(
+            lambda: self.engine.kv_transfer.release(handle)
+        )
+
+    async def kv_import_begin(self, token_ids: list[int]) -> dict:
+        return await self._run_aux(
+            lambda: self.engine.kv_transfer.begin_import(token_ids)
+        )
+
+    async def kv_import_chunk(
+        self, transfer_id: str, layers: list[dict]
+    ) -> dict:
+        return await self._run_aux(
+            lambda: self.engine.kv_transfer.apply_chunk(
+                transfer_id, layers
+            )
+        )
+
+    async def kv_import_commit(self, transfer_id: str) -> dict:
+        return await self._run_aux(
+            lambda: self.engine.kv_transfer.commit_import(transfer_id)
+        )
+
+    async def kv_import_abort(self, transfer_id: str) -> bool:
+        return await self._run_aux(
+            lambda: self.engine.kv_transfer.abort_import(transfer_id)
+        )
+
     # Introspection for the API layer.
     @property
     def metrics(self):

@@ -617,10 +617,15 @@ class RadixPrefixCachingAllocator(PageAllocator):
                 child.host_slot = None
             self._detach(child)
 
-    def _evict_one(self) -> int:
+    def _evict_one(self, allow_spill: bool = True) -> int:
         """Reclaim one HBM page: pop the least-recently-used resident
         leaf, spilling its KV to the host tier when there is (or can be
-        made) room, discarding it otherwise."""
+        made) room, discarding it otherwise.  ``allow_spill=False``
+        forces the discard path — used when the reclaimed page will be
+        written OUTSIDE the step stream (KV-import scatter over the aux
+        path, ISSUE 15): a queued spill span would capture the imported
+        content instead of the evicted page's, because the aux write
+        lands before the next dispatched step applies the span."""
         while self._hbm_heap:
             _, stamp, node = heapq.heappop(self._hbm_heap)
             if node.stamp != stamp or not self._hbm_candidate(node):
@@ -631,7 +636,7 @@ class RadixPrefixCachingAllocator(PageAllocator):
             self._cached_free -= 1
             parent = node.parent
             parent.resident_children -= 1
-            slot = self._take_host_slot()
+            slot = self._take_host_slot() if allow_spill else None
             if slot is not None:
                 self._pending_spills.append((page, slot))
                 node.host_slot = slot
@@ -931,6 +936,83 @@ class RadixPrefixCachingAllocator(PageAllocator):
             n_reg += 1
         self._reg[rid] = n_reg
         self._reg_node[rid] = cursor
+
+    # ---- KV-page import (disaggregated prefill hand-off, ISSUE 15) ----
+    def take_pages(self, n: int) -> list[int]:
+        """Reserve ``n`` pages for an out-of-band KV import.  The pages
+        leave every index (free list, radix nodes, request ownership)
+        until ``adopt_chain`` registers or ``return_pages`` releases
+        them — invisible to eviction, so the import scatter (which runs
+        on the aux path, not the step stream) can never race a spill or
+        a reuse.  Eviction to make room never spills (see _evict_one).
+        Atomic: on exhaustion everything is rolled back."""
+        pages: list[int] = []
+        try:
+            for _ in range(n):
+                if self._free:
+                    pages.append(self._free.pop())
+                else:
+                    pages.append(self._evict_one(allow_spill=False))
+        except NoFreePagesError:
+            self._free.extend(reversed(pages))
+            raise
+        return pages
+
+    def return_pages(self, pages: list[int]) -> None:
+        """Release pages reserved by ``take_pages`` (aborted/expired
+        import) back to the plain free list."""
+        self._free.extend(reversed(pages))
+
+    def adopt_chain(
+        self, token_ids: list[int], pages: list[int]
+    ) -> tuple[int, list[int]]:
+        """Index imported KV pages as a cached chain over ``token_ids``
+        (one FULL page per entry, root-anchored) so the next prompt that
+        walks the same tokens attaches them as computed — the decode
+        side of the prefill/decode hand-off is exactly a prefix-cache
+        warm-up with remote content.  Returns (adopted_pages,
+        leftover_pages): a node that already exists resident keeps its
+        page (first writer wins; ours is surplus), and the walk stops at
+        a host-resident node (its DRAM copy is authoritative and a
+        resident child under a host node would corrupt the residency
+        invariant).  Leftover pages are returned to the free list here;
+        callers must have scattered page CONTENT before adopting."""
+        ps = self.page_size
+        adopted = 0
+        leftovers: list[int] = []
+        cursor = self._root
+        for i, page in enumerate(pages):
+            key = tuple(token_ids[i * ps : (i + 1) * ps])
+            if len(key) < ps:
+                leftovers.append(page)
+                continue
+            child = cursor.children.get(key)
+            if child is None:
+                child = _RadixNode(key=key, parent=cursor, page=page)
+                cursor.children[key] = child
+                self._page_node[page] = child
+                cursor.resident_children += 1
+                # Born cached-free: no live request refs the import.
+                self._cached_free += 1
+                self._touch(child)
+                adopted += 1
+            elif child.page is not None:
+                # Resident duplicate: keep the existing page, ours is
+                # surplus (content identical by the checksummed wire
+                # contract).
+                self._touch(child)
+                leftovers.append(page)
+            else:
+                # Host-resident (or detached-mid-walk) node: stop —
+                # hanging a resident child under it would strand the
+                # chain contract; the rest of the import is a cache
+                # sliver, never correctness.
+                leftovers.extend(pages[i:])
+                break
+            cursor = child
+        if leftovers:
+            self.return_pages(leftovers)
+        return adopted, leftovers
 
     # ---- KV-tier op spans (drained by the scheduler per step) ----
     def take_tier_ops(

@@ -1,0 +1,337 @@
+"""KV-page export/import for disaggregated prefill/decode (ISSUE 15).
+
+DistServe (Zhong et al., OSDI 2024) and Splitwise (Patel et al., ISCA
+2024) separate the compute-bound prefill phase from the memory-bound
+decode phase onto different pools and hand the KV cache across at the
+phase boundary.  This module is the replica-side half of that hand-off:
+
+- **Export** (prefill replica): a request admitted with
+  ``SamplingParams.prefill_only`` runs prefill plus its first sampled
+  token and then finishes with its KV pages **held** instead of freed
+  (the scheduler routes the release here).  The router then pulls the
+  pages in per-layer chunks — each chunk is one ``export_kv_pages``
+  worker RPC reusing the PR 14 ``jax.device_get`` gather — and finally
+  releases the hold.  Holds carry a TTL so a router that dies
+  mid-hand-off can never leak pool pages.
+
+- **Import** (decode replica): ``begin_import`` reserves fresh pages
+  out of every index (``allocator.take_pages`` — invisible to eviction
+  and reuse until commit), ``apply_chunk`` scatters each received layer
+  chunk into them via the ``import_kv_pages`` worker RPC (the PR 14
+  donated in-place scatter), and ``commit`` registers the now-complete
+  pages as a cached radix chain over the prompt tokens
+  (``allocator.adopt_chain``).  The subsequent ``/internal/resume``
+  admission then finds the chain through the ordinary PR 14
+  ``plan_prefix``/``attach_plan`` path and counts the transferred
+  tokens as computed — decode continues bit-identically, with only the
+  tail page recomputed (the same at-least-one-token contract every
+  prefix-cache hit obeys).
+
+Every byte on the wire is checksummed per layer chunk (sha256, verified
+worker-side before any scatter): a corrupt or mis-ordered transfer
+aborts the import and the router falls back to the PR 8
+recompute-resume — never garbage KV.
+
+All methods run on the engine thread (AsyncLLM routes them over the aux
+path), so allocator mutation is serialized with the scheduler and the
+worker RPCs stay ordered with step dispatches on a multihost mesh.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from vllm_distributed_tpu import envs
+from vllm_distributed_tpu.engine.request import Request, RequestStatus
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+# Deadline on each export/import worker RPC: a wedged device must fail
+# the hand-off (router falls back to recompute) rather than park the
+# engine thread.
+_RPC_TIMEOUT_SECONDS = 60.0
+
+
+class KVTransferError(RuntimeError):
+    """Typed hand-off failure (unknown handle, incomplete transfer,
+    checksum mismatch surfaced by the worker, unsupported allocator).
+    The API layer maps it to a 4xx/5xx the router treats as 'abort the
+    transfer and fall back to recompute-resume'."""
+
+
+@dataclass
+class _Hold:
+    """One finished prefill-only request whose pages await export."""
+
+    req: Request
+    pages: list[int]
+    token_ids: list[int]  # full-page prompt prefix the pages cover
+    created_mono: float
+    deadline_mono: float
+
+
+@dataclass
+class _Import:
+    """One in-progress inbound transfer (begin .. commit/abort)."""
+
+    transfer_id: str
+    pages: list[int]
+    token_ids: list[int]
+    created_mono: float
+    deadline_mono: float
+    num_layers: int | None = None  # learned from the first chunk
+    received: set[int] = field(default_factory=set)
+    bytes_in: int = 0
+
+
+class KVTransferManager:
+    """Owns export holds and import transfers for one engine.  Engine
+    thread only; the ``active`` flag keeps the scheduler's sweep hook at
+    one attribute read per step while disaggregation is idle."""
+
+    def __init__(self, scheduler, executor, metrics, tracer=None) -> None:
+        self.scheduler = scheduler
+        self.executor = executor
+        self.metrics = metrics
+        self.tracer = tracer
+        self.ttl = envs.VDT_DISAGG_EXPORT_TTL_SECONDS
+        self.holds: dict[str, _Hold] = {}
+        self.imports: dict[str, _Import] = {}
+        self._seq = 0
+
+    # ---- scheduler-facing (finish-time hook + TTL sweep) ----
+    @property
+    def active(self) -> bool:
+        return bool(self.holds or self.imports)
+
+    def wants_hold(self, req: Request) -> bool:
+        """True when this finishing request's pages should be held for
+        export instead of freed: a prefill-only request that ran to its
+        one-token budget (an abort means the router is gone, a stop/EOS
+        means the request REALLY finished and there is nothing to hand
+        off) and owns at least one full prompt page."""
+        if not req.sampling_params.prefill_only:
+            return False
+        if req.status is not RequestStatus.FINISHED_LENGTH:
+            return False
+        ps = self.scheduler.page_size
+        return req.num_prompt_tokens >= ps and bool(req.page_ids)
+
+    def hold(self, req: Request) -> None:
+        """Adopt a finishing prefill-only request's pages (called by the
+        scheduler INSTEAD of freeing them).  Only the full pages covering
+        the prompt are exportable — the partial tail page (and the first
+        sampled token's row) is recomputed decode-side, the same
+        page-boundary contract every prefix-cache hit obeys."""
+        ps = self.scheduler.page_size
+        full = req.num_prompt_tokens // ps
+        now = time.monotonic()
+        self.holds[req.request_id] = _Hold(
+            req=req,
+            pages=list(req.page_ids[:full]),
+            token_ids=list(req.prompt_token_ids[: full * ps]),
+            created_mono=now,
+            deadline_mono=now + self.ttl,
+        )
+
+    def sweep(self, now_mono: float) -> None:
+        """Free expired holds and abort expired imports (TTL guard: a
+        dead router must never leak pool pages)."""
+        for rid in [
+            r for r, h in self.holds.items() if now_mono >= h.deadline_mono
+        ]:
+            logger.warning(
+                "kv export hold %s expired after %.0fs; freeing pages",
+                rid,
+                self.ttl,
+            )
+            self.release(rid)
+        for tid in [
+            t
+            for t, imp in self.imports.items()
+            if now_mono >= imp.deadline_mono
+        ]:
+            logger.warning(
+                "kv import %s expired after %.0fs; returning pages",
+                tid,
+                self.ttl,
+            )
+            self.abort_import(tid)
+
+    # ---- export (prefill replica) ----
+    def export(
+        self, handle: str, layer_start: int, layer_count: int
+    ) -> dict:
+        """One per-layer chunk of the held pages' KV, gathered from the
+        reply-rank worker, plus the chain metadata the decode side needs
+        (token ids, page count, total layer count).  Chunks are pure
+        reads — the hold stays live until ``release``."""
+        hold = self.holds.get(handle)
+        if hold is None:
+            raise KVTransferError(f"unknown export handle {handle!r}")
+        out = self.executor.collective_rpc(
+            "export_kv_pages",
+            (hold.pages, int(layer_start), int(layer_count)),
+            unique_reply_rank=self.executor.output_rank,
+            timeout=_RPC_TIMEOUT_SECONDS,
+        )
+        if not isinstance(out, dict):
+            raise KVTransferError("worker export returned no payload")
+        layers = out.get("layers") or []
+        nbytes = sum(len(layer.get("data") or b"") for layer in layers)
+        if self.metrics is not None:
+            self.metrics.record_kv_transfer(
+                "out", pages=len(hold.pages) * len(layers), nbytes=nbytes
+            )
+        return {
+            "num_layers": int(out.get("num_layers", 0)),
+            "layers": layers,
+            "num_pages": len(hold.pages),
+            "token_ids": list(hold.token_ids),
+            "page_size": self.scheduler.page_size,
+        }
+
+    def release(self, handle: str) -> bool:
+        """Free a hold's pages (export finished, failed, or expired).
+        Idempotent; records the export wall on a real release."""
+        hold = self.holds.pop(handle, None)
+        if hold is None:
+            return False
+        if self.metrics is not None:
+            self.metrics.record_kv_transfer_seconds(
+                time.monotonic() - hold.created_mono
+            )
+        self.scheduler.release_hold_pages(hold.req)
+        return True
+
+    # ---- import (decode replica) ----
+    def _allocator(self):
+        allocator = self.scheduler.allocator
+        if not getattr(allocator, "supports_tiered", False):
+            raise KVTransferError(
+                "KV import needs the radix prefix index "
+                "(--enable-prefix-caching with --prefix-cache-index radix)"
+            )
+        return allocator
+
+    def begin_import(self, token_ids: list[int]) -> dict:
+        """Reserve pages for an inbound chain.  Returns transfer_id=None
+        when there is nothing importable (sub-page prompt) or the pool
+        cannot spare the pages — the router then skips the transfer and
+        resumes with recompute, which is always correct."""
+        allocator = self._allocator()
+        ps = self.scheduler.page_size
+        full = len(token_ids) // ps
+        if full <= 0:
+            return {"transfer_id": None, "num_pages": 0}
+        from vllm_distributed_tpu.engine.block_manager import (
+            NoFreePagesError,
+        )
+
+        try:
+            pages = allocator.take_pages(full)
+        except NoFreePagesError:
+            return {"transfer_id": None, "num_pages": 0}
+        self._seq += 1
+        tid = f"kvimp-{self._seq}"
+        now = time.monotonic()
+        self.imports[tid] = _Import(
+            transfer_id=tid,
+            pages=pages,
+            token_ids=list(token_ids[: full * ps]),
+            created_mono=now,
+            deadline_mono=now + self.ttl,
+        )
+        return {"transfer_id": tid, "num_pages": full}
+
+    def apply_chunk(self, transfer_id: str, layers: list[dict]) -> dict:
+        """Scatter one received layer chunk into the reserved pages.
+        The worker verifies each layer's checksum BEFORE writing; a
+        mismatch raises and the caller aborts the transfer."""
+        imp = self.imports.get(transfer_id)
+        if imp is None:
+            raise KVTransferError(
+                f"unknown import transfer {transfer_id!r}"
+            )
+        if not layers:
+            return {"received_layers": len(imp.received)}
+        try:
+            out = self.executor.collective_rpc(
+                "import_kv_pages",
+                (imp.pages, layers),
+                unique_reply_rank=self.executor.output_rank,
+                timeout=_RPC_TIMEOUT_SECONDS,
+            )
+        except Exception:
+            # A failed scatter leaves page content indeterminate: the
+            # transfer is unusable, free the reservation immediately.
+            self.abort_import(transfer_id)
+            raise
+        if out is not None and not out.get("ok", True):
+            self.abort_import(transfer_id)
+            raise KVTransferError(
+                str(out.get("error") or "worker rejected kv chunk")
+            )
+        for layer in layers:
+            imp.received.add(int(layer["index"]))
+            imp.bytes_in += len(layer.get("data") or b"")
+            nl = layer.get("num_layers")
+            if nl is not None:
+                imp.num_layers = int(nl)
+        return {"received_layers": len(imp.received)}
+
+    def commit_import(self, transfer_id: str) -> dict:
+        """Register a COMPLETE transfer's pages as a cached radix chain
+        (the decode-side admission finds them via plan_prefix).  An
+        incomplete transfer (missing layers) aborts instead — serving a
+        half-scattered page as a prefix hit would be garbage KV."""
+        imp = self.imports.get(transfer_id)
+        if imp is None:
+            raise KVTransferError(
+                f"unknown import transfer {transfer_id!r}"
+            )
+        if imp.num_layers is None or len(imp.received) < imp.num_layers:
+            got = sorted(imp.received)
+            self.abort_import(transfer_id)
+            raise KVTransferError(
+                f"incomplete kv transfer: received layers {got} of "
+                f"{imp.num_layers}"
+            )
+        del self.imports[transfer_id]
+        allocator = self._allocator()
+        adopted, _ = allocator.adopt_chain(imp.token_ids, imp.pages)
+        ps = self.scheduler.page_size
+        dur = time.monotonic() - imp.created_mono
+        if self.metrics is not None:
+            self.metrics.record_kv_transfer(
+                "in",
+                pages=len(imp.pages) * imp.num_layers,
+                nbytes=imp.bytes_in,
+            )
+            self.metrics.record_kv_transfer_seconds(dur)
+        if self.tracer is not None and self.tracer.enabled:
+            self.tracer.record_span(
+                "engine.kv_handoff",
+                time.time() - dur,
+                dur,
+                transfer_id=transfer_id,
+                pages=len(imp.pages),
+                adopted_pages=adopted,
+                bytes=imp.bytes_in,
+            )
+        return {
+            "adopted_pages": adopted,
+            "adopted_tokens": adopted * ps,
+        }
+
+    def abort_import(self, transfer_id: str) -> bool:
+        """Return an unfinished transfer's reserved pages to the free
+        list.  Idempotent.  Safe even after partial scatters: the pages
+        were never indexed, so nothing can ever read them as a hit."""
+        imp = self.imports.pop(transfer_id, None)
+        if imp is None:
+            return False
+        self._allocator().return_pages(imp.pages)
+        return True

@@ -126,6 +126,19 @@ class LLMEngine:
         self._telemetry_seq = 0
         self._telemetry_compiles_seen: dict[str, int] = {}
 
+        # Disaggregated prefill/decode hand-off (ISSUE 15): export holds
+        # + inbound transfers.  Always constructed (cheap, idle costs
+        # one attribute read per schedule); the scheduler hook makes the
+        # finish path hold pages only for prefill_only requests.
+        from vllm_distributed_tpu.engine.kv_transfer import (
+            KVTransferManager,
+        )
+
+        self.kv_transfer = KVTransferManager(
+            self.scheduler, self.executor, self.metrics, self.tracer
+        )
+        self.scheduler.kv_transfer = self.kv_transfer
+
         self.tokenizer = None
         if not config.model_config.skip_tokenizer_init:
             self.tokenizer = get_tokenizer(

@@ -221,6 +221,11 @@ class Scheduler:
         # Pipelining hysteresis state (see spec_wants_sync).
         self._spec_dry_streak = 0
         self._spec_pipeline_steps = 0
+        # Disaggregated prefill (ISSUE 15): installed by LLMEngine when
+        # the engine builds its KVTransferManager.  None = the finish
+        # path below never holds pages (seed behavior); while idle the
+        # manager costs one attribute read per schedule.
+        self.kv_transfer = None
 
     # ---- waiting-queue mutation (ALL of it goes through these three
     # helpers so num_waiting_tokens can never drift from the deque) ----
@@ -295,7 +300,27 @@ class Scheduler:
         """Free a finished request's pages — unless a later in-flight
         step still references them (pipelined scheduling ran ahead of
         this finish), in which case the free waits for those steps to
-        drain (``update_from_output`` settles the debt)."""
+        drain (``update_from_output`` settles the debt).  A finishing
+        prefill-only request (ISSUE 15) instead HOLDS its pages for the
+        router's KV-page export; the hold's release (or TTL expiry)
+        comes back through ``release_hold_pages``."""
+        if (
+            self.kv_transfer is not None
+            and req.num_inflight_tokens == 0
+            and self.kv_transfer.wants_hold(req)
+        ):
+            self.kv_transfer.hold(req)
+            return
+        if req.num_inflight_tokens > 0:
+            self._deferred_frees[req.request_id] = req
+        else:
+            self.allocator.free(req)
+
+    def release_hold_pages(self, req: Request) -> None:
+        """Free a KV-export hold's pages (engine/kv_transfer.py calls
+        this on release/expiry — the deferred path mirrors
+        _release_or_defer for safety, though a held prefill never has
+        steps in flight)."""
         if req.num_inflight_tokens > 0:
             self._deferred_frees[req.request_id] = req
         else:
@@ -372,6 +397,11 @@ class Scheduler:
                 self._shed_expired(time.monotonic())
             else:
                 self._has_deadlines = False
+        if self.kv_transfer is not None and self.kv_transfer.active:
+            # Disagg TTL guard (ISSUE 15): expired export holds and
+            # orphaned imports free their pages here — a router that
+            # died mid-hand-off can never leak pool capacity.
+            self.kv_transfer.sweep(time.monotonic())
         out = SchedulerOutput(step_id=self._step_id)
         self._step_id += 1
         out.finished_req_ids = self._finished_since_last

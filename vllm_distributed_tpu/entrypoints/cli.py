@@ -150,6 +150,32 @@ def make_parser() -> argparse.ArgumentParser:
         "are judged on SLO attainment, not just tokens/s",
     )
     bench.add_argument(
+        "--disagg",
+        action="store_true",
+        default=False,
+        help="serve mode: run the prefill/decode INTERFERENCE scenario "
+        "instead of the uniform load — a steady batch of decode "
+        "streams with one long-prompt stream injected mid-run, "
+        "reporting the decode ITL p99 before vs during the long "
+        "prefill and the long prompt's TTFT.  Run it once against a "
+        "mixed-pool router and once against a role-separated one "
+        "(--fleet-prefill/--fleet-decode or VDT_ROUTER_ROLE "
+        "replicas): role separation should hold the decode p99 flat "
+        "(the ISSUE 15 A/B)",
+    )
+    bench.add_argument(
+        "--disagg-prompt-len",
+        type=int,
+        default=1024,
+        help="interference scenario: long-prompt length in tokens",
+    )
+    bench.add_argument(
+        "--disagg-decode-streams",
+        type=int,
+        default=4,
+        help="interference scenario: steady decode streams",
+    )
+    bench.add_argument(
         "--shared-prefix-len",
         type=int,
         default=0,
@@ -314,7 +340,12 @@ async def _router_async(args: argparse.Namespace) -> None:
     urls = router_args.resolved_replicas()
     from vllm_distributed_tpu import envs
 
-    fleet_on = router_args.fleet_size > 0 or router_args.autoscale
+    fleet_on = (
+        router_args.fleet_size > 0
+        or router_args.fleet_prefill > 0
+        or router_args.fleet_decode > 0
+        or router_args.autoscale
+    )
     if not urls and not fleet_on:
         raise SystemExit(
             "router needs replicas: pass --replica URL (repeatable), "
@@ -369,6 +400,12 @@ async def _router_async(args: argparse.Namespace) -> None:
             state.metrics,
             CommandLauncher(template),
             target=target,
+            # Disaggregated pools (ISSUE 15): fixed per-role counts
+            # spawned from the same template with VDT_ROUTER_ROLE set.
+            role_targets={
+                "prefill": router_args.fleet_prefill,
+                "decode": router_args.fleet_decode,
+            },
         )
         if cfg is not None:
 
@@ -494,6 +531,144 @@ def _percentiles(xs: list[float]) -> dict:
     return {"p50": pct(0.5), "p90": pct(0.9), "p99": pct(0.99)}
 
 
+async def _bench_disagg_interference(args: argparse.Namespace) -> dict:
+    """The ISSUE 15 interference scenario: steady decode streams with
+    one long-prompt stream injected once they are warm.  Reports the
+    decode streams' client ITL p99 split into before-vs-during the long
+    prefill, plus the long prompt's TTFT — the numbers that judge
+    mixed vs role-separated pools.  The deployment under test is
+    whatever --url fronts; the A/B is two runs against two routers."""
+    import aiohttp
+
+    url = args.url.rstrip("/")
+    long_len = args.disagg_prompt_len
+    n_decode = args.disagg_decode_streams
+    arrivals: list[list[float]] = [[] for _ in range(n_decode)]
+    long_marks: dict[str, float] = {}
+    errors = {"decode": 0, "long": 0}
+
+    async def stream(session, body, on_chunk) -> None:
+        async with session.post(
+            f"{url}/v1/completions", json=body
+        ) as resp:
+            resp.raise_for_status()
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == "[DONE]":
+                    break
+                chunk = json.loads(payload)
+                if chunk.get("choices"):
+                    on_chunk()
+
+    async def decode_stream(session, i: int) -> None:
+        body = {
+            "model": args.model or "bench",
+            "prompt": [(13 * i + j) % 900 + 1 for j in range(args.input_len)],
+            "max_tokens": args.output_len,
+            "temperature": 0.0,
+            "ignore_eos": True,
+            "stream": True,
+        }
+        try:
+            await stream(
+                session, body,
+                lambda: arrivals[i].append(time.perf_counter()),
+            )
+        except Exception:  # noqa: BLE001 — bench client: count, move on
+            errors["decode"] += 1
+
+    async def long_stream(session) -> None:
+        body = {
+            "model": args.model or "bench",
+            "prompt": [(17 + j) % 900 + 1 for j in range(long_len)],
+            "max_tokens": 8,
+            "temperature": 0.0,
+            "ignore_eos": True,
+            "stream": True,
+        }
+
+        def first() -> None:
+            long_marks.setdefault("first", time.perf_counter())
+
+        long_marks["start"] = time.perf_counter()
+        try:
+            await stream(session, body, first)
+        except Exception:  # noqa: BLE001 — bench client: count, move on
+            errors["long"] += 1
+        long_marks["end"] = time.perf_counter()
+
+    timeout = aiohttp.ClientTimeout(total=None, sock_read=600)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        decode_tasks = [
+            asyncio.create_task(decode_stream(session, i))
+            for i in range(n_decode)
+        ]
+        # Warm: every decode stream steadily producing before the long
+        # prompt lands (bounded wait; slow deployments just inject).
+        deadline = time.perf_counter() + 30
+        while time.perf_counter() < deadline:
+            if all(len(a) >= 4 for a in arrivals):
+                break
+            await asyncio.sleep(0.02)
+        await long_stream(session)
+        await asyncio.gather(*decode_tasks)
+
+    start = long_marks.get("start", 0.0)
+    first = long_marks.get("first")
+    end = long_marks.get("end", start)
+    window_end = first if first is not None else end
+    before: list[float] = []
+    during: list[float] = []
+    for a in arrivals:
+        for prev, cur in zip(a, a[1:]):
+            itl = cur - prev
+            if cur <= start:
+                before.append(itl)
+            elif prev >= start and cur <= window_end:
+                during.append(itl)
+    if len(during) < 3:
+        # The prefill window was too short to straddle samples (the
+        # role-separated happy case): widen to the whole long stream.
+        during = [
+            cur - prev
+            for a in arrivals
+            for prev, cur in zip(a, a[1:])
+            if prev >= start and cur <= end
+        ] or during
+    return {
+        "mode": "serve",
+        "scenario": "disagg_interference",
+        "url": url,
+        "decode_streams": n_decode,
+        "long_prompt_len": long_len,
+        "long_ttft_s": (
+            round(first - start, 4) if first is not None else None
+        ),
+        "decode_itl_ms": {
+            "before": (
+                {
+                    k: round(v * 1e3, 3)
+                    for k, v in _percentiles(before).items()
+                }
+                if before
+                else None
+            ),
+            "during_long_prefill": (
+                {
+                    k: round(v * 1e3, 3)
+                    for k, v in _percentiles(during).items()
+                }
+                if during
+                else None
+            ),
+        },
+        "errors": dict(errors),
+    }
+
+
 async def _bench_serve_async(args: argparse.Namespace) -> dict:
     """Drive a LIVE server with concurrent streaming completions and
     measure TTFT/ITL/throughput as the CLIENT sees them over SSE, then
@@ -501,6 +676,9 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
     serving metrics BASELINE.md tracks are HTTP-path numbers, not
     engine-loop numbers)."""
     import aiohttp
+
+    if getattr(args, "disagg", False):
+        return await _bench_disagg_interference(args)
 
     url = args.url.rstrip("/")
     sem = asyncio.Semaphore(args.concurrency)

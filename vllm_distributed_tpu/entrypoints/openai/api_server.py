@@ -90,6 +90,14 @@ REPLICA_HEADER = "X-VDT-Replica-Id"
 # The router strips these fields before the client sees them.
 ROUTER_HEADER = "X-VDT-Router"
 
+# Disaggregated prefill/decode (ISSUE 15): the router marks the
+# prefill-pool hop with ``X-VDT-Disagg: prefill``, and this replica runs
+# the request as prefill-only — prefill plus the first sampled token,
+# then finish with the KV pages HELD for export (engine/kv_transfer.py).
+# Streaming chunks then carry ``vdt_kv_handle`` (the engine request id)
+# so the router can drive /internal/kv/export and /internal/kv/release.
+DISAGG_HEADER = "X-VDT-Disagg"
+
 
 @dataclass
 class ServerState:
@@ -101,6 +109,11 @@ class ServerState:
     chat_template: str | None = None
     api_key: str | None = None
     replica_id: str = ""
+    # Disaggregation role this replica announces in /health (ISSUE 15):
+    # "prefill" | "decode" | "mixed".  Pure advertisement — the router
+    # reads it from the health probe and places accordingly; the
+    # replica itself serves whatever arrives.
+    role: str = "mixed"
     request_counter: Counter = field(default_factory=Counter)
     metrics: Any = None
 
@@ -268,6 +281,22 @@ def _apply_slo_class(request: web.Request, req_model, params) -> None:
         params.slo_class = header
 
 
+def _apply_disagg_prefill(
+    request: web.Request, params, req_model, num_prompts: int = 1
+) -> None:
+    """Fold the router's ``X-VDT-Disagg: prefill`` hop marker into the
+    sampling params (ISSUE 15): the request runs prefill plus ONE
+    sampled token, then finishes with its pages held for export.  Only
+    single-choice streaming requests qualify (the router never plans a
+    hand-off for anything else); everything else ignores the header."""
+    if request.headers.get(DISAGG_HEADER) != "prefill":
+        return
+    if not req_model.stream or req_model.n != 1 or num_prompts != 1:
+        return
+    params.prefill_only = True
+    params.max_tokens = 1
+
+
 def _apply_chat_template(state: ServerState, req: ChatCompletionRequest) -> str:
     tokenizer = state.engine.tokenizer
     conversation = [
@@ -395,6 +424,8 @@ async def health(request: web.Request) -> web.Response:
     body = {"status": "ok"}
     if state.replica_id:
         body["replica_id"] = state.replica_id
+    if state.role and state.role != "mixed":
+        body["role"] = state.role
     return web.json_response(body)
 
 
@@ -483,6 +514,7 @@ async def chat_completions(request: web.Request) -> web.Response:
     if err is not None:
         return err
     _apply_slo_class(request, req, params)
+    _apply_disagg_prefill(request, params, req)
 
     # Admission pre-check (no reservation): overload rejects become
     # proper 429s HERE, before any SSE stream opens; generate() runs
@@ -656,6 +688,10 @@ async def _stream_chat(
                         meta["vdt_prompt_token_ids"] = list(
                             out.prompt_token_ids
                         )
+                    if params.prefill_only:
+                        # The export handle the router drives
+                        # /internal/kv/export with (ISSUE 15).
+                        meta["vdt_kv_handle"] = f"{request_id}-{i}"
                 first = False
                 await emit(
                     delta, finish if comp.finished else None, meta
@@ -748,6 +784,7 @@ async def completions(request: web.Request) -> web.Response:
     if err is not None:
         return err
     _apply_slo_class(request, req, params)
+    _apply_disagg_prefill(request, params, req, num_prompts=len(resolved))
 
     try:
         state.engine.check_admission(
@@ -903,6 +940,12 @@ async def _stream_completion(
                     if first:
                         chunk["choices"][0]["vdt_prompt_token_ids"] = list(
                             out.prompt_token_ids
+                        )
+                    if params.prefill_only:
+                        # The export handle the router drives
+                        # /internal/kv/export with (ISSUE 15).
+                        chunk["choices"][0]["vdt_kv_handle"] = (
+                            f"{request_id}-{choice_idx}"
                         )
                 first = False
                 await send_json(json.dumps(chunk))
@@ -1314,6 +1357,146 @@ async def internal_resume(request: web.Request) -> web.Response:
     return response
 
 
+def _kv_transfer_error(e: Exception) -> web.Response | None:
+    """Map typed hand-off failures to responses the router treats as
+    'abort and fall back to recompute-resume' (ISSUE 15)."""
+    from vllm_distributed_tpu.engine.kv_transfer import KVTransferError
+
+    if isinstance(e, KVTransferError):
+        return _error(str(e), 409)
+    if isinstance(e, EngineDeadError):
+        return _engine_dead_response(e)
+    return None
+
+
+async def internal_kv_export(request: web.Request) -> web.Response:
+    """One per-layer chunk of a held prefill's KV pages (ISSUE 15).
+    Body: ``{"handle", "layer_start", "layer_count"}``; the handle is
+    the ``vdt_kv_handle`` the prefill-only stream carried.  The reply
+    carries base64 layer payloads with sha256 checksums plus the chain
+    metadata (token ids, page/layer counts) the decode side needs."""
+    import base64
+
+    state: ServerState = request.app["state"]
+    try:
+        d = await request.json()
+        handle = str(d["handle"])
+        layer_start = int(d.get("layer_start", 0))
+        layer_count = int(d.get("layer_count", 1))
+    except Exception as e:  # noqa: BLE001
+        return _error(f"invalid export payload: {e}")
+    try:
+        out = await state.engine.kv_export(handle, layer_start, layer_count)
+    except Exception as e:  # noqa: BLE001 — typed mapping below; anything else is a 500-worthy bug
+        resp = _kv_transfer_error(e)
+        if resp is not None:
+            return resp
+        raise
+    for layer in out.get("layers") or ():
+        layer["data"] = base64.b64encode(layer["data"]).decode("ascii")
+    return web.json_response(out)
+
+
+async def internal_kv_release(request: web.Request) -> web.Response:
+    """Release a prefill export hold's pages (hand-off finished or
+    abandoned).  Idempotent — the TTL sweep covers a router that never
+    calls this."""
+    state: ServerState = request.app["state"]
+    try:
+        d = await request.json()
+        handle = str(d["handle"])
+    except Exception as e:  # noqa: BLE001
+        return _error(f"invalid release payload: {e}")
+    try:
+        released = await state.engine.kv_release(handle)
+    except EngineDeadError as e:
+        return _engine_dead_response(e)
+    return web.json_response({"released": bool(released)})
+
+
+async def internal_kv(request: web.Request) -> web.Response:
+    """KV-page import surface of the decode replica (ISSUE 15): the
+    router streams a prefill replica's exported pages here in per-layer
+    chunks, then commits, and the next ``/internal/resume`` admission
+    attaches them as computed through the PR 14 plan/attach path.
+
+    Frames (one POST each):
+    - ``{"op": "begin", "prompt_token_ids": [...]}`` →
+      ``{"transfer_id", "num_pages"}`` (transfer_id null = nothing
+      importable here; skip to resume, recompute is always correct)
+    - ``{"op": "chunk", "transfer_id", "layers": [{index, num_layers,
+      shape?, data (base64), checksum}, ...]}``
+    - ``{"op": "commit", "transfer_id"}`` → ``{"adopted_tokens"}``
+    - ``{"op": "abort", "transfer_id"}``
+
+    A checksum mismatch or incomplete transfer answers 409 and the
+    reserved pages are freed — garbage KV can never be indexed."""
+    import base64
+
+    state: ServerState = request.app["state"]
+    engine = state.engine
+    try:
+        d = await request.json()
+        op = str(d.get("op") or "")
+    except Exception as e:  # noqa: BLE001
+        return _error(f"invalid kv frame: {e}")
+    try:
+        if op == "begin":
+            if engine.draining:
+                # A draining replica is leaving rotation: importing KV
+                # it will never decode just burns the transfer.
+                return web.json_response(
+                    ErrorResponse(
+                        message="replica is draining; not accepting "
+                        "kv transfers",
+                        code=503,
+                    ).model_dump(),
+                    status=503,
+                    headers={
+                        "Retry-After": str(envs.VDT_RETRY_AFTER_SECONDS)
+                    },
+                )
+            token_ids = [int(t) for t in d.get("prompt_token_ids") or ()]
+            return web.json_response(
+                await engine.kv_import_begin(token_ids)
+            )
+        if op == "chunk":
+            tid = str(d["transfer_id"])
+            layers = []
+            for layer in d.get("layers") or ():
+                layers.append(
+                    {
+                        **layer,
+                        "data": base64.b64decode(layer["data"]),
+                    }
+                )
+            return web.json_response(
+                await engine.kv_import_chunk(tid, layers)
+            )
+        if op == "commit":
+            return web.json_response(
+                await engine.kv_import_commit(str(d["transfer_id"]))
+            )
+        if op == "abort":
+            return web.json_response(
+                {
+                    "aborted": bool(
+                        await engine.kv_import_abort(
+                            str(d["transfer_id"])
+                        )
+                    )
+                }
+            )
+    except KeyError as e:
+        return _error(f"kv frame missing field: {e}")
+    except Exception as e:  # noqa: BLE001 — typed mapping below; anything else is a 500-worthy bug
+        resp = _kv_transfer_error(e)
+        if resp is not None:
+            return resp
+        raise
+    return _error(f"unknown kv frame op {op!r}")
+
+
 # ---- app assembly ----
 def build_app(state: ServerState) -> web.Application:
     app = web.Application(
@@ -1338,6 +1521,9 @@ def build_app(state: ServerState) -> web.Application:
     app.router.add_get("/debug/flightrecorder", debug_flightrecorder)
     app.router.add_post("/debug/profile", debug_profile)
     app.router.add_post("/internal/resume", internal_resume)
+    app.router.add_post("/internal/kv", internal_kv)
+    app.router.add_post("/internal/kv/export", internal_kv_export)
+    app.router.add_post("/internal/kv/release", internal_kv_release)
     return app
 
 
@@ -1350,12 +1536,19 @@ def init_app_state(
     chat_template: str | None = None,
     api_key: str | None = None,
     replica_id: str | None = None,
+    role: str | None = None,
 ) -> ServerState:
     model_config = engine.get_model_config()
     if replica_id is None:
         replica_id = envs.VDT_REPLICA_ID
     if replica_id:
         engine.metrics.record_replica_info(replica_id)
+    if role is None:
+        role = envs.VDT_ROUTER_ROLE
+    if role not in ("prefill", "decode", "mixed"):
+        raise ValueError(
+            f"unknown replica role {role!r}; want prefill | decode | mixed"
+        )
     return ServerState(
         engine=engine,
         model_name=served_model_name or model_config.model,
@@ -1365,6 +1558,7 @@ def init_app_state(
         chat_template=chat_template,
         api_key=api_key,
         replica_id=replica_id,
+        role=role,
     )
 
 

@@ -228,6 +228,37 @@ environment_variables: dict[str, Callable[[], Any]] = {
     "VDT_ROUTER_READ_TIMEOUT_SECONDS": lambda: float(
         os.environ.get("VDT_ROUTER_READ_TIMEOUT_SECONDS", "600")
     ),
+    # --- disaggregated prefill/decode (ISSUE 15) ---
+    # Role this serving replica announces in /health ("prefill" |
+    # "decode" | "mixed").  The router places long prompts on the
+    # prefill pool and hands their KV pages off to a decode-pool
+    # replica at first token; "mixed" (the default) serves both phases
+    # exactly as before — a fleet with no prefill-role replica never
+    # takes the disagg path.
+    "VDT_ROUTER_ROLE": lambda: os.environ.get(
+        "VDT_ROUTER_ROLE", "mixed"
+    ),
+    # Prompt-length crossover (router-side): only prompts at/above this
+    # many (estimated) tokens are prefilled on the prefill pool and
+    # handed off; below it the transfer costs more than the prefill it
+    # isolates, so the request is served on the decode/mixed pool like
+    # today (tools/disagg_crossover.py benches the sweep).
+    "VDT_DISAGG_MIN_PROMPT_TOKENS": lambda: int(
+        os.environ.get("VDT_DISAGG_MIN_PROMPT_TOKENS", "512")
+    ),
+    # KV-page streaming granularity: layers per /internal/kv chunk on
+    # the prefill->decode hop (bounds per-frame memory on both sides of
+    # the DCN transfer).
+    "VDT_DISAGG_CHUNK_LAYERS": lambda: int(
+        os.environ.get("VDT_DISAGG_CHUNK_LAYERS", "4")
+    ),
+    # How long a prefill-only request's KV pages stay held for export
+    # after it finishes.  A router that dies mid-hand-off must never
+    # leak pool pages forever: expired holds are swept at schedule
+    # time and freed like a normal finish.
+    "VDT_DISAGG_EXPORT_TTL_SECONDS": lambda: float(
+        os.environ.get("VDT_DISAGG_EXPORT_TTL_SECONDS", "30")
+    ),
     # --- elastic fleet (ISSUE 13) ---
     # Command template the router's ReplicaManager launches managed
     # replicas with ({port} and {replica_id} placeholders, e.g.
@@ -429,6 +460,14 @@ NON_REPLICATED_ENV_VARS = {
     "VDT_ROUTER_MAX_MIGRATIONS",
     "VDT_ROUTER_CONNECT_TIMEOUT_SECONDS",
     "VDT_ROUTER_READ_TIMEOUT_SECONDS",
+    # Disaggregation (ISSUE 15): the role is per-replica identity like
+    # VDT_REPLICA_ID; the crossover/chunking knobs configure the ROUTER
+    # process's hand-off orchestration; export holds are driver-engine
+    # state (workers hold no pages of their own to expire).
+    "VDT_ROUTER_ROLE",
+    "VDT_DISAGG_MIN_PROMPT_TOKENS",
+    "VDT_DISAGG_CHUNK_LAYERS",
+    "VDT_DISAGG_EXPORT_TTL_SECONDS",
     # Fleet lifecycle + autoscaler knobs configure the ROUTER process's
     # control loops; replicating them to engine workers (or to the
     # managed replicas themselves) would be meaningless.

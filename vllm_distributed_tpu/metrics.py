@@ -109,6 +109,10 @@ DOCUMENTED_METRICS = (
     "vllm:kv_restore_pages_total",
     "vllm:kv_restore_seconds",
     "vllm:host_kv_bytes",
+    # ---- disaggregated prefill/decode hand-off (ISSUE 15) ----
+    "vllm:kv_transfer_pages_total",
+    "vllm:kv_transfer_bytes_total",
+    "vllm:kv_transfer_seconds",
     "vllm:spec_decode_draft_tokens_total",
     "vllm:spec_decode_accepted_tokens_total",
     "vllm:spec_decode_acceptance_length",
@@ -257,6 +261,44 @@ class EngineMetrics:
             "vllm:host_kv_bytes",
             "Bytes of KV held in the host-DRAM spill tier "
             "(slots in use x per-page pool bytes)",
+        )
+        # ---- disaggregated prefill/decode hand-off (ISSUE 15) ----
+        # direction="out": page-layer chunks exported to another
+        # replica; direction="in": chunks imported and committed here.
+        self._kv_transfer_pages = Counter(
+            "vllm:kv_transfer_pages",
+            "KV page-layer chunks moved over DCN for prefill/decode "
+            'hand-offs (pages x layers), by direction: "out" exported '
+            'from this replica\'s held prefills, "in" imported and '
+            "committed into the local prefix index",
+            ["model_name", "direction"],
+            registry=self.registry,
+        )
+        self._kv_transfer_bytes = Counter(
+            "vllm:kv_transfer_bytes",
+            "KV bytes moved over DCN for prefill/decode hand-offs, by "
+            "direction (pre-base64 wire payload)",
+            ["model_name", "direction"],
+            registry=self.registry,
+        )
+        self.kv_transfer_pages_out = self._kv_transfer_pages.labels(
+            model_name=model_name, direction="out"
+        )
+        self.kv_transfer_pages_in = self._kv_transfer_pages.labels(
+            model_name=model_name, direction="in"
+        )
+        self.kv_transfer_bytes_out = self._kv_transfer_bytes.labels(
+            model_name=model_name, direction="out"
+        )
+        self.kv_transfer_bytes_in = self._kv_transfer_bytes.labels(
+            model_name=model_name, direction="in"
+        )
+        self.kv_transfer_seconds = histogram(
+            "vllm:kv_transfer_seconds",
+            "Wall seconds per KV hand-off transfer on this replica "
+            "(export: hold creation to release; import: begin to "
+            "commit)",
+            _KV_RESTORE_BUCKETS,
         )
         # ---- speculative decoding (ISSUE 11) ----
         self.spec_draft_tokens = counter(
@@ -549,6 +591,24 @@ class EngineMetrics:
             self.kv_restore_pages.inc(restored)
         if host_bytes is not None:
             self.host_kv_bytes.set(host_bytes)
+
+    def record_kv_transfer(
+        self, direction: str, pages: int, nbytes: int
+    ) -> None:
+        """One hand-off chunk batch (ISSUE 15): page-layer count and
+        wire bytes, by direction ("out" export / "in" import)."""
+        if not self.enabled:
+            return
+        if direction == "out":
+            self.kv_transfer_pages_out.inc(pages)
+            self.kv_transfer_bytes_out.inc(nbytes)
+        else:
+            self.kv_transfer_pages_in.inc(pages)
+            self.kv_transfer_bytes_in.inc(nbytes)
+
+    def record_kv_transfer_seconds(self, seconds: float) -> None:
+        if self.enabled:
+            self.kv_transfer_seconds.observe(max(seconds, 0.0))
 
     def record_kv_restore_seconds(self, seconds: float) -> None:
         if self.enabled:

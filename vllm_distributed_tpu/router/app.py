@@ -59,6 +59,9 @@ DEADLINE_HEADER = "X-VDT-Deadline-Ms"
 SLO_CLASS_HEADER = "X-VDT-SLO-Class"
 REPLICA_HEADER = "X-VDT-Replica-Id"
 ROUTER_HEADER = "X-VDT-Router"
+# Disaggregated prefill (ISSUE 15): marks the prefill-pool hop; the
+# replica runs the request prefill-only and holds its KV for export.
+DISAGG_HEADER = "X-VDT-Disagg"
 
 _PATHS = {"completions": "/v1/completions", "chat": "/v1/chat/completions"}
 
@@ -140,6 +143,12 @@ class RouterState:
         )
         self.metrics = RouterMetrics()
         self.request_counter = Counter()
+        # Disaggregated prefill/decode (ISSUE 15): the hand-off engages
+        # only for prompts at/above the crossover AND when the pool
+        # actually contains both a prefill-role and a decode-capable
+        # replica — an all-mixed pool never takes the path.
+        self.disagg_min_prompt_tokens = envs.VDT_DISAGG_MIN_PROMPT_TOKENS
+        self.disagg_chunk_layers = envs.VDT_DISAGG_CHUNK_LAYERS
         self._rr = 0
         self.session = None  # aiohttp.ClientSession, set on startup
         # Elastic fleet (ISSUE 13): set by attach_fleet() before the
@@ -166,11 +175,23 @@ class RouterState:
 
     # ---- placement ----
     def place(
-        self, keys: list[str], exclude: set[str]
+        self, keys: list[str], exclude: set[str], pool: str = "serve"
     ) -> tuple[Replica | None, str]:
         """Pick a replica for a prompt with affinity chain ``keys``.
-        Returns (replica, deciding_policy)."""
+        Returns (replica, deciding_policy).  Role-aware (ISSUE 15):
+        ``pool="prefill"`` picks only prefill-role replicas (the
+        hand-off hop); ``pool="serve"`` keeps prefill-role replicas out
+        of normal placement whenever any decode-capable candidate
+        exists (they must stay free for prefill bursts), falling back
+        to them only when nothing else is routable — availability over
+        purity."""
         cands = self.pool.candidates(exclude)
+        if pool == "prefill":
+            cands = [r for r in cands if r.role == "prefill"]
+        else:
+            non_prefill = [r for r in cands if r.role != "prefill"]
+            if non_prefill:
+                cands = non_prefill
         if not cands:
             return None, "none"
         if self.policy == "round_robin":
@@ -312,9 +333,13 @@ def _soonest_backoff_expiry(
 
 
 def _place_or_none(
-    state: RouterState, keys: list[str], exclude: set[str], span
+    state: RouterState,
+    keys: list[str],
+    exclude: set[str],
+    span,
+    pool: str = "serve",
 ) -> Replica | None:
-    replica, how = state.place(keys, exclude)
+    replica, how = state.place(keys, exclude, pool)
     if replica is not None:
         state.metrics.record_placement(how)
         get_tracer().event(
@@ -442,12 +467,29 @@ async def _proxy_stream(
     # assert exact token sequences end-to-end with it).
     client_debug = request.headers.get(ROUTER_HEADER) == "1"
 
+    # Disaggregated prefill (ISSUE 15): long single-choice prompts
+    # prefill on the prefill pool and hand their KV off at first token.
+    from vllm_distributed_tpu.router import disagg
+
+    plan = disagg.plan_handoff(state, journal, keys)
+
     # ---- engage the first replica before committing client headers ----
     resp = None
     replica = None
     last_429: tuple[bytes, str] | None = None
     while resp is None:
-        replica = _place_or_none(state, keys, exclude, span)
+        replica = _place_or_none(
+            state,
+            keys,
+            exclude,
+            span,
+            pool="prefill" if plan is not None else "serve",
+        )
+        if replica is None and plan is not None:
+            # Prefill pool gone (excluded/backed off mid-loop): give up
+            # on the hand-off and serve normally on the decode pool.
+            plan = None
+            continue
         if replica is None:
             if last_429 is not None:
                 raw, retry_after = last_429
@@ -466,7 +508,11 @@ async def _proxy_stream(
             candidate = await state.session.post(
                 f"{replica.url}{path}",
                 json=journal.body,
-                headers=fwd,
+                headers=(
+                    {**fwd, DISAGG_HEADER: "prefill"}
+                    if plan is not None
+                    else fwd
+                ),
                 timeout=_upstream_timeout(state, streaming=True),
             )
         except asyncio.CancelledError:
@@ -525,9 +571,18 @@ async def _proxy_stream(
     try:
         try:
             try:
-                completed = await _forward_primary(
-                    state, journal, replica, resp, write, client_debug
-                )
+                if plan is not None:
+                    # Hand-off path: internal failure handling (prefill
+                    # death -> recompute fallback, decode death -> the
+                    # migration loop) lives in disagg.py.
+                    completed = await disagg.forward_prefill_handoff(
+                        state, journal, keys, exclude, replica, resp,
+                        fwd, write, client_debug, span,
+                    )
+                else:
+                    completed = await _forward_primary(
+                        state, journal, replica, resp, write, client_debug
+                    )
             except MigrationNeeded as m:
                 completed = await _migrate_loop(
                     state, journal, keys, exclude, replica, m,

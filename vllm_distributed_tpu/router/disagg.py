@@ -1,0 +1,359 @@
+"""Disaggregated prefill/decode hand-off orchestration (ISSUE 15).
+
+DistServe/Splitwise role separation at the router: long prompts are
+prefetched on a **prefill-pool** replica (``X-VDT-Disagg: prefill`` hop
+→ the replica runs prefill plus the first sampled token and HOLDS its
+KV pages for export), then the router streams the pages in per-layer
+chunks from the prefill replica's ``/internal/kv/export`` to a
+decode-pool replica's ``/internal/kv`` and resumes the request there
+over the PR 8 ``/internal/resume`` path — the imported pages attach as
+computed, so decode continues bit-identically while the decode pool's
+ITL never shares a mesh with the compute-bound prefill.
+
+Failure semantics (the chaos_soak ``--disagg`` contract): any failure
+on the prefill side — replica SIGKILLed mid-export, checksum mismatch,
+transfer aborted — falls back to the PR 8 recompute-resume on the
+decode pool with whatever the journal already holds.  Planned hand-offs
+AND their fallbacks are the happy path of role separation: they count
+in ``vdt_router:handoffs``, never in ``vdt_router:migrations``, and
+never burn ``VDT_ROUTER_MAX_MIGRATIONS`` budget.  Only a failure of the
+decode-side continuation itself enters the normal migration loop.
+
+Below the ``VDT_DISAGG_MIN_PROMPT_TOKENS`` crossover (benched by
+``tools/disagg_crossover.py``) the hand-off is not planned at all and
+the request serves on the decode/mixed pool exactly as today; a fleet
+with no prefill-role replica never takes this path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass
+
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.tracing import get_tracer
+
+logger = init_logger(__name__)
+
+# Test seams for the chaos harness (tools/chaos_soak.py --disagg):
+# `_test_before_transfer` is awaited after the prefill stream yields
+# its first token but before any export chunk moves;
+# `_test_after_chunk` after each export→import chunk round trip (chunk
+# index passed).  Together they make "SIGKILL the prefill replica
+# mid-hand-off / mid-export" deterministic scenarios instead of races.
+_test_before_transfer = None
+_test_after_chunk = None
+
+
+@dataclass
+class HandoffPlan:
+    est_prompt_tokens: int
+
+
+def estimate_prompt_tokens(journal) -> int:
+    """Crossover estimate from what the router can see pre-placement:
+    exact for token-id prompts, ~4 chars/token for text/chat."""
+    text, ids = journal.affinity_source()
+    if ids:
+        return len(ids)
+    return len(text or "") // 4
+
+
+def plan_handoff(state, journal, keys) -> HandoffPlan | None:
+    """Decide whether this stream takes the prefill→decode hand-off
+    path: single-choice streaming request whose (estimated) prompt is
+    at/above the crossover, with BOTH pools routable.  Everything else
+    places exactly as before."""
+    if not journal.stream or len(journal.choices) != 1:
+        return None
+    mt = journal.body.get("max_tokens")
+    try:
+        if mt is not None and int(mt) <= 1:
+            return None  # finishes at the first token either way
+    except (TypeError, ValueError):
+        return None
+    have_prefill = any(
+        r.routable and r.role == "prefill" for r in state.pool.replicas
+    )
+    have_decode = any(
+        r.routable and r.role != "prefill" for r in state.pool.replicas
+    )
+    if not (have_prefill and have_decode):
+        return None
+    est = estimate_prompt_tokens(journal)
+    if est < state.disagg_min_prompt_tokens:
+        return None
+    return HandoffPlan(est_prompt_tokens=est)
+
+
+async def _post_json(state, url: str, payload: dict) -> tuple[int, dict]:
+    """One bounded router→replica control POST; returns (status, body)."""
+    import aiohttp
+
+    timeout = aiohttp.ClientTimeout(
+        total=state.read_timeout, connect=state.connect_timeout
+    )
+    async with state.session.post(
+        url, json=payload, timeout=timeout
+    ) as resp:
+        try:
+            body = await resp.json()
+        except Exception:  # noqa: BLE001 — a non-JSON error body still carries the status
+            body = {}
+        return resp.status, body or {}
+
+
+async def _transfer_pages(
+    state, prefill_url: str, decode_url: str, kv_handle: str,
+    prompt_token_ids: list[int],
+) -> int:
+    """Stream the held pages prefill→decode in per-layer chunks.
+    Returns the adopted token count (0 = nothing transferred, e.g. the
+    decode pool declined).  Raises on any wire/checksum/commit failure
+    — the caller aborts and falls back to recompute."""
+    status, begin = await _post_json(
+        state,
+        f"{decode_url}/internal/kv",
+        {"op": "begin", "prompt_token_ids": prompt_token_ids},
+    )
+    if status != 200:
+        raise RuntimeError(f"kv import begin failed: HTTP {status}")
+    transfer_id = begin.get("transfer_id")
+    if not transfer_id:
+        return 0  # nothing importable decode-side; recompute is correct
+    chunk_layers = max(int(state.disagg_chunk_layers), 1)
+    try:
+        layer = 0
+        num_layers = None
+        chunk_idx = 0
+        while num_layers is None or layer < num_layers:
+            status, chunk = await _post_json(
+                state,
+                f"{prefill_url}/internal/kv/export",
+                {
+                    "handle": kv_handle,
+                    "layer_start": layer,
+                    "layer_count": chunk_layers,
+                },
+            )
+            if status != 200:
+                raise RuntimeError(
+                    f"kv export chunk failed: HTTP {status}"
+                )
+            num_layers = int(chunk.get("num_layers") or 0)
+            layers = chunk.get("layers") or []
+            if not layers:
+                raise RuntimeError(
+                    f"kv export returned no layers at {layer}/{num_layers}"
+                )
+            status, _ = await _post_json(
+                state,
+                f"{decode_url}/internal/kv",
+                {
+                    "op": "chunk",
+                    "transfer_id": transfer_id,
+                    "layers": layers,
+                },
+            )
+            if status != 200:
+                raise RuntimeError(
+                    f"kv import chunk failed: HTTP {status}"
+                )
+            layer += len(layers)
+            chunk_idx += 1
+            if _test_after_chunk is not None:
+                await _test_after_chunk(chunk_idx)
+        status, commit = await _post_json(
+            state,
+            f"{decode_url}/internal/kv",
+            {"op": "commit", "transfer_id": transfer_id},
+        )
+        if status != 200:
+            raise RuntimeError(f"kv import commit failed: HTTP {status}")
+        return int(commit.get("adopted_tokens") or 0)
+    except BaseException:
+        # Free the decode-side reservation; the TTL sweep is only the
+        # backstop.  Best-effort: the abort itself may be unreachable.
+        try:
+            await _post_json(
+                state,
+                f"{decode_url}/internal/kv",
+                {"op": "abort", "transfer_id": transfer_id},
+            )
+        except Exception:  # noqa: BLE001 — fallback proceeds regardless
+            logger.debug("kv import abort failed", exc_info=True)
+        raise
+
+
+async def _release_hold(state, prefill_url: str, kv_handle: str) -> None:
+    """Best-effort release of the prefill replica's export hold (the
+    TTL sweep covers a replica we can no longer reach)."""
+    try:
+        await _post_json(
+            state,
+            f"{prefill_url}/internal/kv/release",
+            {"handle": kv_handle},
+        )
+    except Exception:  # noqa: BLE001 — TTL backstop frees the hold
+        logger.debug("kv hold release failed", exc_info=True)
+
+
+async def forward_prefill_handoff(
+    state, journal, keys, exclude, prefill, resp, fwd, write,
+    client_debug, span,
+) -> bool:
+    """Pump the prefill-only stream to the client (journaling the first
+    token), then hand the KV pages off and continue on a decode-pool
+    replica.  Returns True when the client stream completed.  All
+    prefill-side failures degrade to recompute-resume on the decode
+    pool without touching the migration budget; only decode-side
+    continuation failures enter the normal migration loop."""
+    # Local import: app.py imports this module lazily per stream, so a
+    # top-level back-import would be circular at module load.
+    from vllm_distributed_tpu.router.app import (
+        MigrationNeeded,
+        _forward_resumed,
+        _migrate_loop,
+        _place_or_none,
+        _sse_payloads,
+    )
+
+    tracer = get_tracer()
+    kv_handle: str | None = None
+    handoff_now = False
+    prefill_ok = True
+    try:
+        async for payload in _sse_payloads(resp, state.read_timeout):
+            if payload == "[DONE]":
+                break
+            try:
+                obj = json.loads(payload)
+            except ValueError:
+                continue
+            if "error" in obj and not obj.get("choices"):
+                # Any typed error on the prefill hop — drain, shed,
+                # death — is recoverable: fall back to recompute.
+                prefill_ok = False
+                break
+            if journal.upstream_id is None and obj.get("id"):
+                journal.upstream_id = obj["id"]
+                journal.model = obj.get("model")
+            genuine_finish = False
+            for choice in obj.get("choices") or []:
+                # Internal-only: the export handle must never reach
+                # the client (even debug ones) — it names live pages.
+                handle = choice.pop("vdt_kv_handle", None)
+                if handle:
+                    kv_handle = str(handle)
+                finish = choice.get("finish_reason")
+                if finish == "length":
+                    # The synthetic prefill-only budget (max_tokens=1
+                    # forced on the disagg hop): the request is NOT
+                    # done — strip the finish and hand off.  A client
+                    # asking for max_tokens<=1 is never planned here.
+                    choice["finish_reason"] = None
+                    handoff_now = True
+                elif finish is not None:
+                    genuine_finish = True  # EOS/stop at token one
+                kept = dict(choice) if client_debug else None
+                journal.observe_choice(choice)
+                if kept is not None:
+                    choice.update(
+                        {
+                            k: v
+                            for k, v in kept.items()
+                            if k.startswith("vdt_")
+                        }
+                    )
+            await write(json.dumps(obj))
+            if genuine_finish:
+                await write("[DONE]")
+                if kv_handle:
+                    await _release_hold(state, prefill.url, kv_handle)
+                state.metrics.record_handoff("finished_at_prefill")
+                return True
+            if handoff_now:
+                break
+        else:
+            prefill_ok = False  # stream closed without a finish
+    except (ConnectionResetError, asyncio.CancelledError):
+        # CLIENT-side disconnect mid-forward: the prefill replica is
+        # healthy — free its hold now instead of at the TTL, and never
+        # misattribute the hangup to the replica (parity with
+        # _forward_primary, which re-raises for the same reason).
+        if kv_handle:
+            await _release_hold(state, prefill.url, kv_handle)
+        raise
+    except Exception as e:  # noqa: BLE001 — prefill-side failure = recompute fallback
+        prefill_ok = False
+        state.pool.note_unreachable(prefill, f"{type(e).__name__}: {e}")
+        state.index.forget(prefill.replica_id)
+        exclude.add(prefill.url)
+
+    # ---- pick the decode-side continuation target ----
+    target = _place_or_none(state, keys, exclude, span)
+    if target is None:
+        await write(
+            json.dumps(
+                {"error": "no decode replica for hand-off", "code": 503}
+            )
+        )
+        state.metrics.record_handoff("fallback")
+        return False
+
+    # ---- stream the KV pages across (best-effort) ----
+    adopted = 0
+    choice = journal.choices.get(0)
+    prompt_ids = choice.prompt_token_ids if choice is not None else None
+    if prefill_ok and handoff_now and kv_handle and prompt_ids:
+        try:
+            if _test_before_transfer is not None:
+                await _test_before_transfer()
+            adopted = await _transfer_pages(
+                state,
+                prefill.url,
+                target.url,
+                kv_handle,
+                list(prompt_ids),
+            )
+        except Exception as e:  # noqa: BLE001 — transfer failure = recompute fallback
+            logger.warning(
+                "kv hand-off transfer %s -> %s failed (%s); falling "
+                "back to recompute-resume",
+                prefill.replica_id,
+                target.replica_id,
+                e,
+            )
+            adopted = 0
+    if kv_handle:
+        # Release on EVERY fallback path too (gate failed, prefill
+        # stream broke after the handle arrived): a reachable prefill
+        # replica frees its pages now, not at the TTL; an unreachable
+        # one fails the best-effort call and the TTL backstops.
+        await _release_hold(state, prefill.url, kv_handle)
+    outcome = "planned" if adopted > 0 else "fallback"
+    state.metrics.record_handoff(outcome)
+    tracer.event(
+        span.ctx,
+        "router.handoff",
+        outcome=outcome,
+        from_replica=prefill.replica_id,
+        to_replica=target.replica_id,
+        adopted_tokens=adopted,
+    )
+
+    # ---- continue decoding on the target ----
+    try:
+        await _forward_resumed(
+            state, journal, target, fwd, write, client_debug
+        )
+        journal.served_by = target.replica_id
+        return True
+    except MigrationNeeded as m:
+        # The DECODE side failed: this is genuine failure recovery and
+        # takes the normal migration loop (budget applies).
+        return await _migrate_loop(
+            state, journal, keys, exclude, target, m,
+            fwd, write, client_debug, span,
+        )

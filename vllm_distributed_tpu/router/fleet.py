@@ -76,15 +76,16 @@ class PopenHandle:
 
 class CommandLauncher:
     """Launches managed replicas from a shell-style command template
-    with ``{port}`` / ``{replica_id}`` placeholders (``--fleet-cmd`` /
-    ``VDT_FLEET_CMD``), e.g.::
+    with ``{port}`` / ``{replica_id}`` / ``{role}`` placeholders
+    (``--fleet-cmd`` / ``VDT_FLEET_CMD``), e.g.::
 
         vdt serve meta-llama/Llama-3.2-1B --host 127.0.0.1 --port {port}
 
-    The child gets VDT_REPLICA_ID in its environment (so ``/health``
-    and ``X-VDT-Replica-Id`` carry the manager's identity even if the
-    template forgets the placeholder) and its own session id, keeping
-    signal delivery scoped to the one replica."""
+    The child gets VDT_REPLICA_ID and VDT_ROUTER_ROLE in its
+    environment (so ``/health`` and ``X-VDT-Replica-Id`` carry the
+    manager's identity and disaggregation role even if the template
+    forgets the placeholders) and its own session id, keeping signal
+    delivery scoped to the one replica."""
 
     def __init__(
         self, template: str, extra_env: dict[str, str] | None = None
@@ -96,14 +97,19 @@ class CommandLauncher:
         self.template = template
         self.extra_env = dict(extra_env or {})
 
-    def spawn(self, replica_id: str, port: int) -> PopenHandle:
+    def spawn(
+        self, replica_id: str, port: int, role: str = "mixed"
+    ) -> PopenHandle:
         argv = shlex.split(
-            self.template.format(port=port, replica_id=replica_id)
+            self.template.format(
+                port=port, replica_id=replica_id, role=role
+            )
         )
         env = {
             **os.environ,
             **self.extra_env,
             "VDT_REPLICA_ID": replica_id,
+            "VDT_ROUTER_ROLE": role,
         }
         proc = subprocess.Popen(  # vdt-lint: disable=thread-leak — reaped by ReplicaManager._reap on every exit path
             argv, env=env, start_new_session=True
@@ -126,6 +132,8 @@ class ManagedReplica:
     port: int
     handle: object  # ChildHandle duck type
     state: str = "starting"
+    # Disaggregation role this replica was spawned under (ISSUE 15).
+    role: str = "mixed"
     spawned_mono: float = 0.0
     ready_mono: float = 0.0
     exit_code: int | None = None
@@ -140,6 +148,7 @@ class ManagedReplica:
             "replica_id": self.replica_id,
             "url": self.url,
             "state": self.state,
+            "role": self.role,
             "pid": getattr(self.handle, "pid", None),
             "exit_code": self.exit_code,
         }
@@ -167,6 +176,7 @@ class ReplicaManager:
         health_check=None,
         drainer=None,
         port_factory=get_open_port,
+        role_targets: dict[str, int] | None = None,
     ) -> None:
         def _env(value, name):
             return getattr(envs, name) if value is None else value
@@ -175,6 +185,15 @@ class ReplicaManager:
         self.metrics = metrics
         self.launcher = launcher
         self.target = max(int(target), 0)
+        # Disaggregated pools (ISSUE 15): fixed per-role counts spawned
+        # alongside the (autoscalable) mixed target — e.g.
+        # {"prefill": 1, "decode": 2}.  Empty = all-mixed, the exact
+        # pre-disagg behavior.
+        self.role_targets = {
+            role: max(int(n), 0)
+            for role, n in (role_targets or {}).items()
+            if role in ("prefill", "decode") and int(n) > 0
+        }
         self.warmup_timeout = _env(
             warmup_timeout, "VDT_FLEET_WARMUP_TIMEOUT_SECONDS"
         )
@@ -222,9 +241,15 @@ class ReplicaManager:
             }
         )
 
-    def active(self) -> list[ManagedReplica]:
-        """Replicas counting toward the target (starting or serving)."""
-        return [r for r in self.replicas if r.state in _ACTIVE_STATES]
+    def active(self, role: str | None = None) -> list[ManagedReplica]:
+        """Replicas counting toward the target (starting or serving),
+        optionally filtered to one disaggregation role."""
+        return [
+            r
+            for r in self.replicas
+            if r.state in _ACTIVE_STATES
+            and (role is None or r.role == role)
+        ]
 
     def ready_count(self) -> int:
         return sum(1 for r in self.replicas if r.state == "ready")
@@ -232,6 +257,7 @@ class ReplicaManager:
     def snapshot(self) -> dict:
         return {
             "target": self.target,
+            "role_targets": dict(self.role_targets),
             "ready": self.ready_count(),
             "active": len(self.active()),
             "exhausted": self.exhausted,
@@ -286,23 +312,37 @@ class ReplicaManager:
             except asyncio.TimeoutError:
                 continue
 
+    def _targets(self) -> dict[str, int]:
+        """Per-role convergence targets: the (resizable) mixed target
+        plus the fixed disagg role counts (ISSUE 15)."""
+        targets = {"mixed": self.target}
+        targets.update(self.role_targets)
+        return targets
+
     async def _reconcile(self) -> None:
         self._sweep_exits()
-        active = self.active()
         now = time.monotonic()
-        if (
-            len(active) < self.target
-            and not self.exhausted
-            and now >= self._spawn_gate_mono
-        ):
-            # One spawn per tick: converging a big jump gradually keeps
-            # the warmups (and their compile storms) from stampeding.
-            self._spawn_one()
-        elif len(active) > self.target:
-            for victim in self._pick_victims(len(active) - self.target):
-                victim.task = asyncio.get_running_loop().create_task(
-                    self._retire(victim)
-                )
+        spawned = False
+        for role, target in self._targets().items():
+            active = self.active(role)
+            if (
+                len(active) < target
+                and not spawned
+                and not self.exhausted
+                and now >= self._spawn_gate_mono
+            ):
+                # One spawn per tick ACROSS roles: converging a big jump
+                # gradually keeps the warmups (and their compile storms)
+                # from stampeding.
+                self._spawn_one(role)
+                spawned = True
+            elif len(active) > target:
+                for victim in self._pick_victims(
+                    len(active) - target, role
+                ):
+                    victim.task = asyncio.get_running_loop().create_task(
+                        self._retire(victim)
+                    )
         if self.metrics is not None:
             self.metrics.update_fleet(self)
 
@@ -366,20 +406,34 @@ class ReplicaManager:
         self._backoff = min(self._backoff * 2, self.backoff_cap)
 
     # ---- spawn + health-gated warmup ----
-    def _spawn_one(self) -> ManagedReplica:
+    def _spawn_one(self, role: str = "mixed") -> ManagedReplica:
         self._seq += 1
-        replica_id = f"fleet-{self._seq}"
+        replica_id = (
+            f"fleet-{self._seq}"
+            if role == "mixed"
+            else f"fleet-{role}-{self._seq}"
+        )
         port = self._port_factory()
-        handle = self.launcher.spawn(replica_id, port)
+        try:
+            handle = self.launcher.spawn(replica_id, port, role=role)
+        except TypeError:
+            # Legacy launcher surface (tests/chaos harness fakes that
+            # predate roles): only the mixed pool can use it.
+            handle = self.launcher.spawn(replica_id, port)
         mr = ManagedReplica(
             replica_id=replica_id,
             port=port,
             handle=handle,
+            role=role,
             spawned_mono=time.monotonic(),
         )
         self.replicas.append(mr)
         self.record_event(
-            "spawn", replica_id, port=port, pid=getattr(handle, "pid", None)
+            "spawn",
+            replica_id,
+            port=port,
+            role=role,
+            pid=getattr(handle, "pid", None),
         )
         mr.task = asyncio.get_running_loop().create_task(
             self._warmup_gate(mr)
@@ -420,6 +474,7 @@ class ReplicaManager:
                         mr.url,
                         replica_id=mr.replica_id,
                         state="healthy",
+                        role=mr.role,
                     )
                     self.record_event("ready", mr.replica_id)
                     logger.info(
@@ -454,22 +509,29 @@ class ReplicaManager:
         self._note_crash()
 
     # ---- scale-down: drain, then terminate, then reap ----
-    def _pick_victims(self, n: int) -> list[ManagedReplica]:
-        """Newest-first: the youngest replica has the coldest caches
-        (prefix affinity steers repeat traffic at the old-timers), so
-        retiring it loses the least steering precision."""
+    def _pick_victims(
+        self, n: int, role: str = "mixed"
+    ) -> list[ManagedReplica]:
+        """Newest-first within the role: the youngest replica has the
+        coldest caches (prefix affinity steers repeat traffic at the
+        old-timers), so retiring it loses the least steering
+        precision."""
         victims: list[ManagedReplica] = []
         # Prefer replicas still warming (no work to drain), then the
         # most recently spawned ready ones.
         for mr in reversed(self.replicas):
             if len(victims) == n:
                 break
-            if mr.state == "starting":
+            if mr.state == "starting" and mr.role == role:
                 victims.append(mr)
         for mr in reversed(self.replicas):
             if len(victims) == n:
                 break
-            if mr.state == "ready" and mr not in victims:
+            if (
+                mr.state == "ready"
+                and mr.role == role
+                and mr not in victims
+            ):
                 victims.append(mr)
         return victims
 

@@ -107,6 +107,20 @@ class RouterMetrics:
             ["reason"],
             registry=self.registry,
         )
+        # ---- disaggregated prefill/decode (ISSUE 15) ----
+        # Planned KV hand-offs are the HAPPY path of role separation,
+        # deliberately distinct from vdt_router:migrations (failure
+        # recovery) — a hand-off never burns the migration budget.
+        self._handoffs = Counter(
+            "vdt_router:handoffs",
+            "Prefill->decode hand-offs by outcome (planned = KV pages "
+            "streamed and adopted; fallback = transfer failed/skipped, "
+            "continued via recompute-resume on the decode pool; "
+            "finished_at_prefill = the request legitimately finished "
+            "on its first token)",
+            ["outcome"],
+            registry=self.registry,
+        )
         self._placements = Counter(
             "vdt_router:placements",
             "Placement decisions by deciding policy (affinity | "
@@ -201,6 +215,11 @@ class RouterMetrics:
         self.counts[f"placements.{policy}"] += 1
         if self.enabled:
             self._placements.labels(policy=policy).inc()
+
+    def record_handoff(self, outcome: str) -> None:
+        self.counts[f"handoffs.{outcome}"] += 1
+        if self.enabled:
+            self._handoffs.labels(outcome=outcome).inc()
 
     # ---- elastic fleet (ISSUE 13) ----
     def record_scale(self, direction: str, reason: str) -> None:

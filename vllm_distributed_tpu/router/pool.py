@@ -42,6 +42,11 @@ class Replica:
     url: str  # base URL, no trailing slash
     replica_id: str = ""  # learned from /health; url until then
     state: str = "unknown"  # healthy|recovering|draining|drained|dead|unreachable|unknown
+    # Disaggregation role (ISSUE 15), learned from the /health body (or
+    # pinned by the fleet manager at spawn): "prefill" replicas only
+    # take the router's prefill-only hand-off hops; "decode"/"mixed"
+    # serve normal traffic.  All-mixed pools behave exactly as before.
+    role: str = "mixed"
     waiting: float = 0.0  # vllm:num_requests_waiting
     queued_tokens: float = 0.0  # vllm:admission_queued_tokens
     running: float = 0.0  # vllm:num_requests_running
@@ -74,6 +79,7 @@ class Replica:
             "url": self.url,
             "replica_id": self.replica_id,
             "state": self.state,
+            "role": self.role,
             "waiting": self.waiting,
             "queued_tokens": self.queued_tokens,
             "running": self.running,
@@ -139,10 +145,12 @@ class ReplicaPool:
         *,
         replica_id: str = "",
         state: str = "unknown",
+        role: str = "mixed",
     ) -> Replica | None:
         """Add a replica URL (idempotent).  The fleet manager passes
         ``state="healthy"`` after its health-gated warmup so a fresh
-        replica is routable immediately instead of waiting a poll tick.
+        replica is routable immediately instead of waiting a poll tick,
+        and pins the role it spawned the replica with.
         """
         url = url.rstrip("/")
         if not url:
@@ -150,7 +158,9 @@ class ReplicaPool:
         existing = self.by_url(url)
         if existing is not None:
             return existing
-        replica = Replica(url=url, replica_id=replica_id, state=state)
+        replica = Replica(
+            url=url, replica_id=replica_id, state=state, role=role
+        )
         self.replicas.append(replica)
         return replica
 
@@ -234,6 +244,9 @@ class ReplicaPool:
                     rid = (body or {}).get("replica_id")
                     if rid:
                         replica.replica_id = str(rid)
+                    role = (body or {}).get("role")
+                    if role in ("prefill", "decode", "mixed"):
+                        replica.role = role
                 else:
                     try:
                         body = await resp.json()

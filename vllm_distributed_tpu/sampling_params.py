@@ -45,6 +45,13 @@ class SamplingParams:
     # VDT_SLO_ITL_MS targets; sanitized and cardinality-bounded by
     # engine/slo.py before it becomes a metric label.
     slo_class: str = "default"
+    # Disaggregated prefill (ISSUE 15, internal — set by the replica's
+    # API layer on the router's X-VDT-Disagg hop, never by clients):
+    # the request runs prefill plus its first sampled token, then
+    # finishes with its KV pages HELD for export (engine/kv_transfer.py)
+    # instead of freed, so the router can stream them to a decode-pool
+    # replica and resume there.
+    prefill_only: bool = False
 
     def __post_init__(self) -> None:
         if self.temperature < 0.0:
@@ -89,4 +96,5 @@ class SamplingParams:
             include_stop_str_in_output=self.include_stop_str_in_output,
             deadline_ms=self.deadline_ms,
             slo_class=self.slo_class,
+            prefill_only=self.prefill_only,
         )

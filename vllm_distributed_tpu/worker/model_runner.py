@@ -997,6 +997,90 @@ class ModelRunner:
             self.kv_caches = tree.tree_unflatten(treedef, new_leaves)
         return time.perf_counter() - t0
 
+    # ---- KV-page export/import (disaggregated prefill, ISSUE 15) ----
+    def export_kv_pages(
+        self, page_ids: list[int], layer_start: int, layer_count: int
+    ) -> dict:
+        """Gather the KV content of ``page_ids`` for one per-layer chunk
+        of the prefill→decode hand-off: the same batched
+        ``jax.device_get`` the spill path uses (one gather per layer
+        leaf, blocking until in-flight writes resolve, so content is
+        exact), serialized with a per-layer sha256 so the receiving
+        replica can verify every chunk before scattering it.
+
+        Layer indexing is the flattened ``kv_caches`` leaf order — the
+        exact inverse of ``import_kv_pages``.  Validated on single-host
+        replicas (the standard disagg topology: one replica per
+        host/slice, where ``device_get`` materializes the full logical
+        array across local devices); multi-process meshes would need
+        shard-aware reassembly.
+        """
+        import hashlib
+
+        tree = jax.tree_util
+        leaves, _ = tree.tree_flatten(self.kv_caches)
+        num_layers = len(leaves)
+        start = max(int(layer_start), 0)
+        end = min(start + max(int(layer_count), 0), num_layers)
+        idx = jnp.asarray(page_ids, jnp.int32)
+        layers: list[dict] = []
+        for i in range(start, end):
+            arr = np.ascontiguousarray(
+                np.asarray(jax.device_get(leaves[i][:, idx]))
+            )
+            data = arr.tobytes()
+            layers.append(
+                {
+                    "index": i,
+                    "num_layers": num_layers,
+                    "shape": list(arr.shape),
+                    "data": data,
+                    "checksum": hashlib.sha256(data).hexdigest(),
+                }
+            )
+        return {"num_layers": num_layers, "layers": layers}
+
+    def import_kv_pages(self, page_ids: list[int], layers: list[dict]) -> dict:
+        """Scatter received layer chunks into freshly reserved pages —
+        the donated in-place write the restore path uses, with the
+        page-content checksum verified BEFORE any byte lands.  The
+        target pages are outside every index until the driver commits
+        the transfer, so no step can be reading (or writing) them."""
+        import hashlib
+
+        tree = jax.tree_util
+        leaves, treedef = tree.tree_flatten(self.kv_caches)
+        n = len(page_ids)
+        npad = max(next_power_of_2(n), 1)
+        pages = np.zeros(npad, np.int32)  # pad -> reserved page 0
+        pages[:n] = page_ids
+        idx = jnp.asarray(pages)
+        for layer in layers:
+            data = layer["data"]
+            if hashlib.sha256(data).hexdigest() != layer["checksum"]:
+                return {
+                    "ok": False,
+                    "error": (
+                        f"kv transfer checksum mismatch on layer "
+                        f"{layer.get('index')}"
+                    ),
+                }
+            i = int(layer["index"])
+            leaf = leaves[i]
+            arr = np.frombuffer(data, dtype=np.dtype(leaf.dtype)).reshape(
+                tuple(layer["shape"])
+            )
+            if npad > n:
+                pad = np.zeros(
+                    (arr.shape[0], npad - n) + arr.shape[2:], arr.dtype
+                )
+                arr = np.concatenate([arr, pad], axis=1)
+            leaves[i] = self._jit_write_kv_pages(
+                leaf, idx, jnp.asarray(arr)
+            )
+        self.kv_caches = tree.tree_unflatten(treedef, leaves)
+        return {"ok": True}
+
     def host_kv_stats(self) -> dict:
         """Host-tier occupancy (driver telemetry + leak assertions)."""
         total = 0

@@ -191,6 +191,27 @@ class Worker:
         info.update(self.runner.host_kv_stats())
         return info
 
+    def export_kv_pages(
+        self, page_ids: list[int], layer_start: int, layer_count: int
+    ) -> dict | None:
+        """Disaggregated-prefill hand-off (ISSUE 15): gather one
+        per-layer chunk of held pages' KV with content checksums.  Only
+        the reply rank answers (single-host replica topology)."""
+        if self.runner is None or not self.is_driver_worker:
+            return None
+        return self.runner.export_kv_pages(
+            page_ids, layer_start, layer_count
+        )
+
+    def import_kv_pages(
+        self, page_ids: list[int], layers: list[dict]
+    ) -> dict | None:
+        """Hand-off import: checksum-verify and scatter received layer
+        chunks into reserved pages (ISSUE 15)."""
+        if self.runner is None or not self.is_driver_worker:
+            return None
+        return self.runner.import_kv_pages(page_ids, layers)
+
     def get_device_telemetry(self) -> dict | None:
         """XLA compile / HBM / roofline snapshot (ISSUE 12): the driver
         pulls this on /metrics scrapes and folds it into the engine's
