@@ -21,3 +21,10 @@ class Metrics:
         self.counter.labels(request_id).inc()  # EXPECT
         # Splatted label dicts name their sources too.
         self.counter.labels(**{"request_id": request_id}).inc()  # EXPECT
+
+    def record_qos(self, req, victim):
+        # QoS control loops (ISSUE 16) emit per-class series — keyed
+        # by the registry-resolved class name, never per-request
+        # identity, however tempting "which request was preempted" is.
+        self.counter.labels(qos_class=req.request_id).inc()  # EXPECT
+        self.counter.labels(victim=victim.request_id).inc()  # EXPECT

@@ -21,6 +21,13 @@ class Metrics:
         label = {"model_name": self._model_name}
         self.counter.labels(**label).inc()
 
+    def record_qos(self, registry, slo_class, direction):
+        # ISSUE 16: QoS series key on the REGISTRY-RESOLVED class name
+        # — bounded by MAX_CLASSES whatever strings requests carry.
+        name = registry.resolve(slo_class).name
+        self.counter.labels(qos_class=name).inc()
+        self.gauge.labels(direction=direction, reason="goodput").set(1)
+
     def not_a_metric(self, request_id):
         # .labels() is the only surface the rule watches; other calls
         # may mention request ids freely (logs, journals, traces).

@@ -155,21 +155,29 @@ class MockReplicaLauncher:
         model_dir: str,
         extra_env: dict[str, str] | None = None,
         max_num_seqs: int = 2,
+        enable_prefix_caching: bool = False,
     ) -> None:
         self.model_dir = model_dir
         self.extra_env = dict(extra_env or {})
         self.max_num_seqs = max_num_seqs
+        self.enable_prefix_caching = enable_prefix_caching
         self.spawned: list[tuple[str, ForkHandle]] = []
 
-    def spawn(self, replica_id: str, port: int) -> ForkHandle:
+    def spawn(
+        self, replica_id: str, port: int, role: str = "mixed"
+    ) -> ForkHandle:
+        # Role rides the child env exactly like CommandLauncher's
+        # subprocess path: init_app_state falls back to VDT_ROUTER_ROLE,
+        # so /health advertises the disaggregation role to the pool.
         proc = multiprocessing.Process(
             target=_child_main,
             args=(
                 port,
                 replica_id,
                 self.model_dir,
-                self.extra_env,
+                {**self.extra_env, "VDT_ROUTER_ROLE": role},
                 self.max_num_seqs,
+                self.enable_prefix_caching,
             ),
             daemon=True,
         )

@@ -13,7 +13,11 @@ from aiohttp.test_utils import TestServer
 from tests.utils import add_tiny_tokenizer, make_tiny_llama
 from vllm_distributed_tpu.config import EngineArgs
 from vllm_distributed_tpu.engine.async_llm import AsyncLLM
-from vllm_distributed_tpu.entrypoints.cli import _bench_serve_async
+from vllm_distributed_tpu.entrypoints.cli import (
+    _bench_serve_async,
+    parse_len_range,
+    parse_tenants,
+)
 from vllm_distributed_tpu.entrypoints.openai.api_server import (
     build_app,
     init_app_state,
@@ -79,6 +83,102 @@ def test_bench_serve_per_class_slo_mix(live_server):
     for cls in ("interactive", "batch"):
         assert per_class[cls]["server_goodput_ratio"] == 1.0
         assert per_class[cls]["server_ttft_attain_ratio"] == 1.0
+
+
+def test_parse_tenants_units():
+    """ISSUE 16 multi-tenant load generator: profile parsing with
+    defaults, class-defaults-to-name, and loud rejection of malformed
+    specs."""
+    chat, batch = parse_tenants(
+        [
+            "chat:arrival=bursty,rate=8,burst=2,input=8-16,output=4",
+            "batch:class=bulk,arrival=closed,concurrency=3",
+        ]
+    )
+    assert chat["name"] == chat["slo_class"] == "chat"
+    assert chat["arrival"] == "bursty"
+    assert (chat["rate"], chat["burst"]) == (8.0, 2)
+    assert chat["input"] == (8, 16) and chat["output"] == (4, 4)
+    assert batch["slo_class"] == "bulk"  # class= overrides the default
+    assert batch["arrival"] == "closed" and batch["concurrency"] == 3
+    assert batch["input"] == (32, 32)  # untouched defaults
+
+    assert parse_len_range("8", "input") == (8, 8)
+    assert parse_len_range("32-128", "input") == (32, 128)
+    for bad in ("0", "8-4", "x", "-3"):
+        with pytest.raises(SystemExit):
+            parse_len_range(bad, "input")
+    for bad_spec in (
+        ["noseparator"],
+        ["dup:rate=1", "dup:rate=2"],
+        ["t:arrival=sinusoid"],
+        ["t:rate=0"],
+        ["t:burst=0"],
+        ["t:concurrency=0"],
+        ["t:wat=1"],
+        ["t:rate"],
+    ):
+        with pytest.raises(SystemExit):
+            parse_tenants(bad_spec)
+
+
+def test_bench_serve_multi_tenant(live_server):
+    """The ISSUE 16 judging instrument end to end: two named tenant
+    profiles (closed-loop interactive + Poisson batch) drive the live
+    server concurrently; the report carries the seed, per-tenant
+    accounting, and the per-class rollup both tenants feed."""
+    loop, url = live_server
+    args = argparse.Namespace(
+        url=url,
+        model="tiny",
+        num_prompts=1,  # ignored by the tenant path
+        seed=7,
+        tenant_seconds=1.5,
+        tenants=[
+            "interactive:arrival=closed,concurrency=2,input=8,output=8",
+            "batch:arrival=poisson,rate=6,input=8-16,output=4",
+        ],
+    )
+    result = loop.run_until_complete(_bench_serve_async(args))
+    assert result["arrival_process"] == "multi_tenant"
+    assert result["seed"] == 7
+    assert result["tenant_seconds"] == 1.5
+    tenants = result["tenants"]
+    assert set(tenants) == {"interactive", "batch"}
+    it = tenants["interactive"]
+    assert it["class"] == "interactive"
+    assert it["arrival"] == "closed" and it["concurrency"] == 2
+    assert it["completed"] > 0
+    assert it["ttft_s"]["p50"] > 0
+    bt = tenants["batch"]
+    assert bt["arrival"] == "poisson" and bt["rate_rps"] == 6
+    assert bt["input"] == [8, 16]
+    assert bt["offered"] >= bt["completed"] >= 0
+    # Both tenants also land in the per-class SLO rollup.
+    assert set(result["per_class"]) == {"interactive", "batch"}
+    assert (
+        result["per_class"]["interactive"]["completed"] == it["completed"]
+    )
+    # The tenant path reports offered totals, not a fixed num_prompts.
+    assert result["num_prompts"] == sum(
+        t["offered"] for t in tenants.values()
+    )
+    assert result["concurrency"] is None
+    assert result["input_len"] is None
+
+
+def test_bench_serve_tenant_flag_conflicts_with_rate():
+    args = argparse.Namespace(
+        url="http://localhost:1",
+        model="tiny",
+        num_prompts=1,
+        request_rate=4.0,
+        tenants=["t:rate=1"],
+    )
+    with pytest.raises(SystemExit):
+        asyncio.new_event_loop().run_until_complete(
+            _bench_serve_async(args)
+        )
 
 
 def test_bench_serve_reports_http_path_metrics(live_server):

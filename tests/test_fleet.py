@@ -131,6 +131,98 @@ def test_decide_secondary_triggers_and_bounds():
     assert decide(2, _sig(routable=0), cfg, 100.0, 0.0, 0.0) == (2, None)
 
 
+def test_decide_goodput_trigger():
+    """ISSUE 16: a class sagging below the goodput floor is a scale-up
+    trigger of its own (DistServe's goodput-chasing argument), with a
+    class-named reason; floor 0 keeps the trigger off even when the
+    tracker reports a sag."""
+    cfg = AutoscalerConfig(
+        min_replicas=1,
+        max_replicas=4,
+        up_waiting=4.0,
+        down_waiting=1.0,
+        up_cooldown=10.0,
+        down_cooldown=30.0,
+        goodput_floor=0.9,
+    )
+    sag = _sig(waiting=0.0)
+    sag.goodput_sag = "interactive"
+    assert decide(2, sag, cfg, 100.0, 0.0, 0.0) == (
+        3,
+        "goodput:interactive",
+    )
+    # A sagging class also vetoes the idle scale-down.
+    assert decide(2, sag, cfg, 100.0, 0.0, 0.0)[0] >= 2
+    off = AutoscalerConfig(
+        min_replicas=1, max_replicas=4, goodput_floor=0.0
+    )
+    assert decide(2, sag, off, 100.0, 0.0, 0.0)[1] != "goodput:interactive"
+
+
+def test_tick_prefill_sizes_role_to_demand():
+    """ISSUE 16 per-role autoscaling: the prefill pool target tracks
+    ceil(EWMA long-prompt rate / benched per-replica rps), clamped to
+    [prefill_min, prefill_max]; rps 0 keeps the loop off."""
+    from vllm_distributed_tpu.router.qos import PrefillDemand
+
+    class RoleManager:
+        def __init__(self):
+            self.target = 2
+            self.role_targets = {"prefill": 1}
+            self.calls = []
+
+        def scale_role_to(self, role, n, reason=""):
+            self.calls.append((role, n, reason))
+            self.role_targets[role] = n
+
+    def scaler_for(mgr, **cfg_kw):
+        cfg_kw.setdefault("min_replicas", 1)
+        cfg_kw.setdefault("max_replicas", 4)
+        return Autoscaler(
+            mgr,
+            ReplicaPool([], allow_empty=True),
+            RouterMetrics(enabled=False),
+            AutoscalerConfig(**cfg_kw),
+            prefill_demand=PrefillDemand(),
+        )
+
+    mgr = RoleManager()
+    scaler = scaler_for(
+        mgr, prefill_rps=2.0, prefill_min=1, prefill_max=3
+    )
+    sig = _sig()
+    sig.prefill_rate = 5.0  # ceil(5 / 2) = 3
+    scaler._tick_prefill(sig, now=100.0)
+    assert mgr.calls == [("prefill", 3, "autoscale:prefill_demand")]
+    # Demand gone: shrink back to the floor (never to zero here).
+    sig.prefill_rate = 0.0
+    scaler._tick_prefill(sig, now=110.0)
+    assert mgr.role_targets["prefill"] == 1
+    # Ceiling clamp.
+    sig.prefill_rate = 100.0
+    scaler._tick_prefill(sig, now=120.0)
+    assert mgr.role_targets["prefill"] == 3
+    # No change → no call (one-spawn-per-tick churn control).
+    n_calls = len(mgr.calls)
+    scaler._tick_prefill(sig, now=130.0)
+    assert len(mgr.calls) == n_calls
+    # rps 0 = off: the role target is whatever --fleet-prefill set.
+    mgr2 = RoleManager()
+    off = scaler_for(mgr2, prefill_rps=0.0)
+    sig.prefill_rate = 50.0
+    off._tick_prefill(sig, now=100.0)
+    assert mgr2.calls == []
+
+
+def test_scale_role_to_validates_and_sets_target():
+    manager, _ = _manager(FakeLauncher())
+    assert manager.scale_role_to("prefill", 2) == 2
+    assert manager.role_targets["prefill"] == 2
+    assert manager.scale_role_to("prefill", 0) == 0
+    with pytest.raises(ValueError):
+        manager.scale_role_to("embedding", 1)
+
+
 def test_autoscaler_tick_trace_up_then_hold_then_down():
     """Drive Autoscaler.tick over a synthetic gauge trace: a burst
     scales up once per cooldown window, the idle tail scales back
@@ -812,5 +904,40 @@ def test_fleet_ramp_smoke(model_dir):
     assert report["lost"] == 0 and report["mismatches"] == 0
     assert report["scaled_up"] and report["scaled_down"]
     assert report["max_ready_observed"] <= 3
+    assert report["drained_before_stop"]
+    assert report["leaked_children"] == []
+
+
+def test_disagg_autoscale_ramp_smoke(model_dir):
+    """Short per-role autoscale ramp (tools/chaos_soak.py
+    --disagg-autoscale, the ISSUE 16 acceptance): a rising long-prompt
+    sweep grows the prefill pool off the demand EWMA and the idle tail
+    shrinks it back to the floor — no manual resize anywhere, zero lost
+    admitted work and zero token mismatches through every per-role
+    resize, drain-before-stop on every retire, and at least one planned
+    KV hand-off served by the prefill pool."""
+    from tools.chaos_soak import run_disagg_autoscale_ramp
+
+    report = run_disagg_autoscale_ramp(
+        ramp="0.5:2,5:6,0.5:3,0:8",
+        short_rps=1.0,
+        max_tokens=8,
+        prefill_min=1,
+        prefill_max=2,
+        prefill_rps=2.5,
+        ewma_seconds=1.5,
+        autoscale_interval=0.4,
+        settle_bound_s=20.0,
+    )
+    assert report["bounded"], report
+    assert report["lost"] == 0 and report["mismatches"] == 0
+    # The pool grew past its floor (target AND serving replicas), never
+    # past its ceiling, and came back down to the floor — all of it the
+    # autoscaler's doing.
+    assert report["max_prefill_ready"] == 2
+    assert report["demand_ups"] >= 1 and report["demand_downs"] >= 1
+    assert report["manual_resizes"] == 0
+    assert report["final"]["prefill_target"] == 1
+    assert report["handoffs"].get("handoffs.planned", 0) >= 1
     assert report["drained_before_stop"]
     assert report["leaked_children"] == []

@@ -205,6 +205,22 @@ def test_journal_strips_metadata_and_accumulates():
     assert payload != j.resume_payload(c)
 
 
+def test_journal_resume_payload_carries_slo_class():
+    """ISSUE 16 satellite: a migrated request keeps its QoS standing.
+    The journal records the class the router observed (header or body)
+    and the resume payload carries it top-level, so the destination
+    replica bills the same bucket even when the replayed body never
+    named it."""
+    j = RouterJournal(
+        "rtr-q", "completions", {"prompt": [1, 2], "n": 1, "stream": True}
+    )
+    c = j.choices[0]
+    assert j.slo_class is None
+    assert j.resume_payload(c)["slo_class"] is None
+    j.slo_class = "interactive"
+    assert j.resume_payload(c)["slo_class"] == "interactive"
+
+
 def test_journal_multi_prompt_choice_indexing():
     j = RouterJournal(
         "rtr-2",
@@ -429,6 +445,7 @@ def test_internal_resume_bit_identical(model_dir, monkeypatch):
                     "body": body,
                     "prompt_token_ids": [1, 2, 3],
                     "emitted_token_ids": expected[:2],
+                    "slo_class": "interactive",
                 },
             )
             assert r.status == 200
@@ -441,6 +458,13 @@ def test_internal_resume_bit_identical(model_dir, monkeypatch):
             final = frames[-1]
             assert final["finish_reason"] == "length"
             assert final["usage"]["completion_tokens"] == 6
+            # The migrated request kept its QoS standing (ISSUE 16):
+            # the destination replica billed the journaled class even
+            # though the replayed body never named it.
+            r = await client.get("/slo")
+            assert r.status == 200
+            classes = (await r.json())["classes"]
+            assert classes["interactive"]["requests"] >= 1
             # A draining replica refuses migrations (503).
             await engine.drain(0.0)
             r = await client.post(

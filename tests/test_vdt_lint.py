@@ -248,6 +248,26 @@ def test_seeded_positive_in_real_distributed_fails_gate(tmp_path):
     assert {f.path for f in report.new} == {hits[0].path}
 
 
+def test_vdt003_scope_covers_qos_modules(tmp_path):
+    """ISSUE 16: the QoS subsystem sits inside the deadline discipline
+    — engine/qos.py via its own scope entry, router/qos.py via the
+    router/ scope — while the rest of engine/ stays out of VDT003."""
+    text = (FIXTURES / "unbounded_wait_bad.py").read_text()
+    pkg = tmp_path / "pkg"
+    for rel in ("engine/qos.py", "router/qos.py", "engine/not_qos.py"):
+        dest = pkg / rel
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        dest.write_text(text)
+    report = run_lint([pkg], baseline=None)
+    hits = [f for f in report.new if f.rule == "unbounded-wait"]
+    flagged = {f.path for f in hits}
+    assert any(p.endswith("engine/qos.py") for p in flagged)
+    assert any(p.endswith("router/qos.py") for p in flagged)
+    # The scope entry is the one file, not all of engine/.
+    assert not any(p.endswith("not_qos.py") for p in flagged)
+    assert len(hits) == 2 * N_UNBOUNDED
+
+
 # ---- CLI ----
 def _run_cli(*argv: str):
     return subprocess.run(

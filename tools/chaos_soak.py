@@ -1637,6 +1637,496 @@ def run_fleet_ramp(
                 os.environ[k] = v
 
 
+# ---------------------------------------------------------------------
+# Disagg per-role autoscale ramp (ISSUE 16): a mixed decode-capable
+# replica plus an AUTOSCALED prefill pool, under a rising-then-falling
+# long-prompt Poisson sweep with a steady short-prompt floor — the
+# prefill pool must grow from the long-prompt demand EWMA and shrink
+# back after the ramp with NO manual resize, zero lost admitted work
+# through every per-role resize, and at least one planned KV hand-off
+# landing on an autoscaler-spawned prefill replica.
+# ---------------------------------------------------------------------
+def run_disagg_autoscale_ramp(
+    *,
+    ramp: str = "1:3,6:10,0.5:4,0:12",
+    short_rps: float = 1.5,
+    max_tokens: int = 8,
+    prefill_min: int = 1,
+    prefill_max: int = 3,
+    prefill_rps: float = 2.0,
+    ewma_seconds: float = 2.0,
+    autoscale_interval: float = 0.5,
+    stall_bound_s: float = 45.0,
+    settle_bound_s: float = 30.0,
+) -> dict:
+    """Run the per-role autoscale ramp; returns the report dict.
+    Mutates (and restores) os.environ — call from a dedicated process
+    or a test that tolerates env churn.
+
+    ``ramp`` is the piecewise LONG-prompt arrival sweep (rate:seconds
+    segments); a constant ``short_rps`` Poisson floor of short prompts
+    rides underneath for the whole window, so the serve path and the
+    hand-off path contend the way a mixed tenant load does.  The mixed
+    target is pinned (min=max=1): the ONLY scaling in the run is the
+    autoscaler's prefill-demand loop sizing the prefill role, which is
+    exactly what the acceptance judges."""
+    import asyncio
+    import random
+
+    from tests.mock_replica import MockReplicaLauncher
+    from vllm_distributed_tpu.entrypoints.cli import parse_ramp
+    from vllm_distributed_tpu.router.app import (
+        RouterState,
+        build_router_app,
+    )
+    from vllm_distributed_tpu.router.fleet import (
+        Autoscaler,
+        AutoscalerConfig,
+        ReplicaManager,
+    )
+    from vllm_distributed_tpu.entrypoints.openai.api_server import (
+        serve_http,
+    )
+    from vllm_distributed_tpu.testing import write_llama_config
+    from vllm_distributed_tpu.utils import get_open_port
+
+    segments = parse_ramp(ramp)
+    total_seconds = sum(dur for _, dur in segments)
+    page_size = 16
+    long_len = 3 * page_size
+
+    def long_prompt_for(idx: int) -> list[int]:
+        # Content-unique per request (length fixed): a repeated prompt
+        # would be fully prefix-cached decode-side after the first
+        # hand-off, so every later transfer would decline adoption and
+        # count as a fallback — unique prefixes keep the KV stream
+        # genuinely exercised for the whole ramp.  Output tokens are
+        # position-indexed (VDT_MOCK_TOKEN_SEQ), so the expected
+        # sequence depends only on the length.
+        return [(idx * 37 + i) % 900 + 1 for i in range(long_len)]
+
+    short_prompt = [1, 2, 3]
+    env = {
+        **ROUTER_AGENT_ENV,
+        # Every long prompt crosses the hand-off threshold AND feeds
+        # the prefill-demand EWMA; short prompts do neither.
+        "VDT_DISAGG_MIN_PROMPT_TOKENS": str(long_len - 1),
+        "VDT_AUTOSCALE_PREFILL_EWMA_SECONDS": str(ewma_seconds),
+        "VDT_DISAGG_EXPORT_TTL_SECONDS": "10",
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    tmpdir = tempfile.mkdtemp(prefix="vdt_disagg_autoscale_")
+    model_dir = write_llama_config(os.path.join(tmpdir, "m"))
+    expected_long = list(range(long_len, long_len + max_tokens))
+    expected_short = list(
+        range(len(short_prompt), len(short_prompt) + max_tokens)
+    )
+
+    stats = {
+        "offered_long": 0,
+        "offered_short": 0,
+        "admitted": 0,
+        "completed": 0,
+        "mismatches": 0,
+        "lost": 0,
+        "rejected": 0,
+    }
+    stalls: list[float] = []
+    ttfts: list[float] = []
+    timeline: list[dict] = []
+
+    async def go() -> dict:
+        import aiohttp
+
+        launcher = MockReplicaLauncher(
+            model_dir,
+            extra_env=dict(env),
+            max_num_seqs=4,
+            # The decode-capable side of a KV hand-off adopts imported
+            # pages through the radix index; without it every hand-off
+            # degrades to the recompute fallback.
+            enable_prefix_caching=True,
+        )
+        state = RouterState(
+            [],
+            policy="least_loaded",
+            health_interval=0.25,
+            connect_timeout=2,
+            read_timeout=60,
+            allow_empty_pool=True,
+        )
+        manager = ReplicaManager(
+            state.pool,
+            state.metrics,
+            launcher,
+            # One pinned mixed (decode-capable) replica; the prefill
+            # pool starts at its floor and is resized ONLY by the
+            # autoscaler's demand loop from here on.
+            target=1,
+            role_targets={"prefill": prefill_min},
+            warmup_timeout=60,
+            drain_timeout=10,
+            check_interval=0.2,
+            max_restarts=10,
+            restart_window=3600,
+            backoff_base=0.2,
+            backoff_cap=1.0,
+        )
+        autoscaler = Autoscaler(
+            manager,
+            state.pool,
+            state.metrics,
+            AutoscalerConfig(
+                # Pin the mixed target: min == max == current, so the
+                # queue-depth loop can never act and every scale event
+                # in the run is attributable to prefill demand.
+                min_replicas=1,
+                max_replicas=1,
+                interval=autoscale_interval,
+                up_waiting=1e9,
+                down_waiting=0.0,
+                prefill_rps=prefill_rps,
+                prefill_min=prefill_min,
+                prefill_max=prefill_max,
+            ),
+            prefill_demand=state.prefill_demand,
+        )
+        state.attach_fleet(manager, autoscaler)
+        router_port = get_open_port()
+        router_runner = await serve_http(
+            build_router_app(state), host="127.0.0.1", port=router_port
+        )
+        router_url = f"http://127.0.0.1:{router_port}"
+        timeout = aiohttp.ClientTimeout(total=None, sock_read=150)
+
+        def prefill_ready() -> int:
+            return sum(
+                1
+                for r in manager.replicas
+                if r.role == "prefill" and r.state == "ready"
+            )
+
+        async def one_stream(
+            session, tag: str, prompt: list[int], expected: list[int]
+        ) -> None:
+            body = {
+                "prompt": list(prompt),
+                "max_tokens": max_tokens,
+                "temperature": 0.0,
+                "ignore_eos": True,
+                "stream": True,
+            }
+            try:
+                async with session.post(
+                    f"{router_url}/v1/completions",
+                    json=body,
+                    headers={"X-VDT-Router": "1"},
+                    timeout=timeout,
+                ) as resp:
+                    if resp.status == 429:
+                        stats["rejected"] += 1
+                        return
+                    if resp.status != 200:
+                        stats["lost"] += 1
+                        return
+                    stats["admitted"] += 1
+                    toks: list[int] = []
+                    finished = False
+                    req_t0 = time.monotonic()
+                    last = None
+                    worst_gap = 0.0
+                    async for raw in resp.content:
+                        line = raw.decode().strip()
+                        if not line.startswith("data:"):
+                            continue
+                        payload = line[5:].strip()
+                        if payload == "[DONE]":
+                            finished = True
+                            break
+                        obj = json.loads(payload)
+                        if "error" in obj and not obj.get("choices"):
+                            break  # router gave up: lost work
+                        now = time.monotonic()
+                        if last is None:
+                            ttfts.append(now - req_t0)
+                        else:
+                            worst_gap = max(worst_gap, now - last)
+                        last = now
+                        for ch in obj.get("choices") or ():
+                            toks += ch.get("vdt_token_ids") or []
+                    stalls.append(worst_gap)
+                    if not finished:
+                        stats["lost"] += 1
+                    elif toks != expected:
+                        stats["mismatches"] += 1
+                        print(
+                            f"{tag}: TOKEN MISMATCH {toks} != {expected}",
+                            file=sys.stderr,
+                        )
+                    else:
+                        stats["completed"] += 1
+            except Exception as e:  # noqa: BLE001 — an admitted stream erroring out IS lost work
+                stats["lost"] += 1
+                print(f"{tag}: stream error {e}", file=sys.stderr)
+
+        async def sampler(stop: "asyncio.Event") -> None:
+            while not stop.is_set():
+                timeline.append(
+                    {
+                        "mono": round(time.monotonic(), 2),
+                        "prefill_target": manager.role_targets.get(
+                            "prefill", 0
+                        ),
+                        "prefill_ready": prefill_ready(),
+                        "prefill_rate": round(
+                            state.prefill_demand.rate, 3
+                        ),
+                    }
+                )
+                await asyncio.sleep(0.2)
+
+        async def offer_long(session, tasks: list) -> None:
+            rng = random.Random(20816)
+            idx = 0
+            for rate, dur in segments:
+                seg_t0 = time.monotonic()
+                while True:
+                    remaining = dur - (time.monotonic() - seg_t0)
+                    if remaining <= 0:
+                        break
+                    if rate <= 0:
+                        await asyncio.sleep(remaining)
+                        break
+                    stats["offered_long"] += 1
+                    tasks.append(
+                        asyncio.ensure_future(
+                            one_stream(
+                                session,
+                                f"long-{idx}",
+                                long_prompt_for(idx),
+                                expected_long,
+                            )
+                        )
+                    )
+                    idx += 1
+                    await asyncio.sleep(
+                        min(rng.expovariate(rate), remaining)
+                    )
+
+        async def offer_short(session, tasks: list) -> None:
+            if short_rps <= 0:
+                return
+            rng = random.Random(40816)
+            deadline = time.monotonic() + total_seconds
+            idx = 0
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                stats["offered_short"] += 1
+                tasks.append(
+                    asyncio.ensure_future(
+                        one_stream(
+                            session,
+                            f"short-{idx}",
+                            short_prompt,
+                            expected_short,
+                        )
+                    )
+                )
+                idx += 1
+                await asyncio.sleep(
+                    min(rng.expovariate(short_rps), remaining)
+                )
+
+        async with aiohttp.ClientSession() as session:
+            # Wait out the boot: the ramp judges demand-driven resize
+            # behavior, not cold start.  Both the mixed replica and the
+            # prefill floor must be serving before load is offered.
+            deadline = time.monotonic() + 90
+            while (
+                manager.ready_count() < 1 + prefill_min
+                or prefill_ready() < prefill_min
+            ):
+                if time.monotonic() > deadline:
+                    raise RuntimeError("initial fleet never became ready")
+                await asyncio.sleep(0.1)
+            stop = asyncio.Event()
+            aux = [asyncio.ensure_future(sampler(stop))]
+            tasks: list = []
+            try:
+                await asyncio.gather(
+                    offer_long(session, tasks),
+                    offer_short(session, tasks),
+                )
+                if tasks:
+                    await asyncio.wait_for(
+                        asyncio.gather(*tasks), timeout=240
+                    )
+                # Let the demand EWMA decay and the autoscaler walk the
+                # prefill pool back to its floor (bounded).
+                settle_deadline = time.monotonic() + settle_bound_s
+                while (
+                    manager.role_targets.get("prefill", 0) > prefill_min
+                    or len(manager.active("prefill")) > prefill_min
+                ):
+                    if time.monotonic() > settle_deadline:
+                        break
+                    await asyncio.sleep(0.2)
+                timeline.append(
+                    {
+                        "mono": round(time.monotonic(), 2),
+                        "prefill_target": manager.role_targets.get(
+                            "prefill", 0
+                        ),
+                        "prefill_ready": prefill_ready(),
+                        "prefill_rate": round(
+                            state.prefill_demand.rate, 3
+                        ),
+                    }
+                )
+            finally:
+                stop.set()
+                for t in aux:
+                    t.cancel()
+            async with session.get(
+                f"{router_url}/router/state",
+                timeout=aiohttp.ClientTimeout(total=10),
+            ) as resp:
+                router_counters = (await resp.json())["counters"]
+            events = list(manager.events)
+            decisions = list(autoscaler.decisions)
+            final = {
+                "prefill_target": manager.role_targets.get("prefill", 0),
+                "prefill_active": len(manager.active("prefill")),
+                "mixed_target": manager.target,
+            }
+        await router_runner.cleanup()  # drains + reaps the fleet
+        return {
+            "events": events,
+            "decisions": decisions,
+            "final": final,
+            "counters": router_counters,
+            "leaked": launcher.leaked(),
+        }
+
+    try:
+        out = asyncio.new_event_loop().run_until_complete(go())
+        events = out["events"]
+        # Drain-before-stop (same invariant as the mixed-fleet ramp):
+        # every replica that ever served and was stopped by the manager
+        # drained first — per-role retires included.
+        ready_ids = {
+            e["replica_id"] for e in events if e["kind"] == "ready"
+        }
+        drained_before_stop = True
+        drained_ids = set()
+        for e in events:
+            if e["kind"] == "drain":
+                drained_ids.add(e["replica_id"])
+            elif e["kind"] == "stopped" and e["replica_id"] in ready_ids:
+                if e["replica_id"] not in drained_ids:
+                    drained_before_stop = False
+        role_scales = [
+            e
+            for e in events
+            if e["kind"] == "scale_role" and e["role"] == "prefill"
+        ]
+        demand_ups = [
+            e
+            for e in role_scales
+            if e["to"] > e["from_target"]
+            and e["reason"] == "autoscale:prefill_demand"
+        ]
+        demand_downs = [
+            e
+            for e in role_scales
+            if e["to"] < e["from_target"]
+            and e["reason"] == "autoscale:prefill_demand"
+        ]
+        # "Without manual resize": every scale event in the run — role
+        # or mixed — must be the autoscaler's.
+        manual_resizes = [
+            e
+            for e in events
+            if e["kind"] in ("scale", "scale_role")
+            and not str(e.get("reason", "")).startswith("autoscale:")
+        ]
+        max_prefill_target = max(
+            (s["prefill_target"] for s in timeline), default=0
+        )
+        max_prefill_ready = max(
+            (s["prefill_ready"] for s in timeline), default=0
+        )
+        handoffs = {
+            k: v
+            for k, v in out["counters"].items()
+            if k.startswith("handoffs.")
+        }
+        report = {
+            "mode": "disagg_autoscale_ramp",
+            "ramp": ramp,
+            "short_rps": short_rps,
+            "prefill_min": prefill_min,
+            "prefill_max": prefill_max,
+            "prefill_rps": prefill_rps,
+            **stats,
+            "handoffs": handoffs,
+            "max_prefill_target": max_prefill_target,
+            "max_prefill_ready": max_prefill_ready,
+            "final": out["final"],
+            "demand_ups": len(demand_ups),
+            "demand_downs": len(demand_downs),
+            "manual_resizes": len(manual_resizes),
+            "drained_before_stop": drained_before_stop,
+            "decisions": out["decisions"],
+            "leaked_children": out["leaked"],
+            "stall_seconds": {
+                "p50": round(_percentile(stalls, 0.5), 3),
+                "max": round(max(stalls), 3) if stalls else 0.0,
+            },
+            "ttft_seconds": {
+                "p50": round(_percentile(ttfts, 0.5), 3),
+                "p99": round(_percentile(ttfts, 0.99), 3),
+                "max": round(max(ttfts), 3) if ttfts else 0.0,
+            },
+            # The acceptance contract (ISSUE 16): the long-prompt sweep
+            # GREW the prefill pool (target AND serving replicas) and
+            # shrank it back to the floor after the ramp, every resize
+            # was the autoscaler's (no manual scale anywhere), no
+            # admitted stream was lost or corrupted through any per-role
+            # resize, every retire drained first, at least one planned
+            # KV hand-off landed (the grown pool did real disagg work),
+            # the pool never exceeded its ceiling, and no child leaked.
+            "bounded": (
+                stats["lost"] == 0
+                and stats["mismatches"] == 0
+                and len(demand_ups) >= 1
+                and len(demand_downs) >= 1
+                and max_prefill_target > prefill_min
+                and max_prefill_ready > prefill_min
+                and max_prefill_target <= prefill_max
+                and out["final"]["prefill_target"] == prefill_min
+                and out["final"]["prefill_active"] == prefill_min
+                and out["final"]["mixed_target"] == 1
+                and not manual_resizes
+                and handoffs.get("handoffs.planned", 0) >= 1
+                and drained_before_stop
+                and not out["leaked"]
+                and (not stalls or max(stalls) <= stall_bound_s)
+            ),
+        }
+        return report
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--cycles", type=int, default=5)
@@ -1700,6 +2190,28 @@ def main() -> None:
         "autoscale acceptance run)",
     )
     parser.add_argument(
+        "--disagg-autoscale",
+        type=str,
+        nargs="?",
+        const="1:3,6:10,0.5:4,0:12",
+        default=None,
+        metavar="R1:S1,R2:S2,...",
+        help="ISSUE 16 per-role autoscale ramp: a pinned mixed replica "
+        "plus an autoscaled prefill pool under this long-prompt "
+        "Poisson sweep (with a steady short-prompt floor) — asserts "
+        "the prefill pool grows from the demand EWMA and shrinks "
+        "back after the ramp with no manual resize, zero lost "
+        "admitted work through every per-role resize, and at least "
+        "one planned KV hand-off (default sweep when the flag is "
+        "bare)",
+    )
+    parser.add_argument(
+        "--prefill-max",
+        type=int,
+        default=3,
+        help="prefill-pool ceiling for --disagg-autoscale mode",
+    )
+    parser.add_argument(
         "--disagg",
         action="store_true",
         help="ISSUE 15 disaggregation phase: a prefill-role + "
@@ -1719,6 +2231,16 @@ def main() -> None:
         "recoveries, and RSS plateaus (no host-memory leak)",
     )
     args = parser.parse_args()
+    if args.disagg_autoscale is not None:
+        report = run_disagg_autoscale_ramp(
+            ramp=args.disagg_autoscale,
+            max_tokens=args.max_tokens,
+            prefill_max=args.prefill_max,
+        )
+        print(json.dumps(report))
+        if not report["bounded"]:
+            sys.exit(1)
+        return
     if args.disagg:
         report = run_disagg_soak(
             cycles=args.cycles, max_tokens=args.max_tokens

@@ -85,6 +85,129 @@ def _fmt(value, pct=False) -> str:
     return f"{value:.1f}"
 
 
+def _fmt_delta(value, pct=False) -> str:
+    if value is None:
+        return "-"
+    sign = "+" if value >= 0 else ""
+    if pct:
+        return f"{sign}{value * 100:.1f}pp"
+    return f"{sign}{value:.1f}"
+
+
+def diff_rows(base: list[dict], cur: list[dict]) -> list[dict]:
+    """Per-class deltas, current minus baseline — the review artifact
+    for a QoS/scheduler change (ISSUE 16 satellite).
+
+    Two shapes of comparison fall out of one computation:
+
+    - two scrapes of the SAME server (before/after a run): the counter
+      deltas are the run window, so ``window_goodput_ratio`` is the
+      goodput of exactly the traffic in between;
+    - two INDEPENDENT runs (A/B dumps): cumulative counters "reset"
+      between scrapes, detected per class, and the window becomes the
+      whole current run.
+
+    Ratio/percentile columns are current-minus-baseline either way.
+    """
+    base_by = {r["class"]: r for r in base}
+    out = []
+
+    def delta(cur_v, base_v):
+        if cur_v is None or base_v is None:
+            return None
+        return cur_v - base_v
+
+    for r in cur:
+        b = base_by.get(r["class"])
+        d_requests = r["requests"] - (b["requests"] if b else 0)
+        d_goodput = r["goodput"] - (b["goodput"] if b else 0)
+        if d_requests < 0:
+            # Counters went backwards: not the same accumulation
+            # (restart or an independent A/B dump) — the current
+            # scrape IS the window.
+            d_requests, d_goodput = r["requests"], r["goodput"]
+        out.append(
+            {
+                "class": r["class"],
+                "d_requests": d_requests,
+                "d_goodput": d_goodput,
+                "window_goodput_ratio": (
+                    d_goodput / d_requests if d_requests > 0 else None
+                ),
+                "d_goodput_ratio": delta(
+                    r["goodput_ratio"],
+                    b["goodput_ratio"] if b else None,
+                ),
+                "d_ttft_attain": delta(
+                    r["ttft_attain"], b["ttft_attain"] if b else None
+                ),
+                "d_itl_attain": delta(
+                    r["itl_attain"], b["itl_attain"] if b else None
+                ),
+                "d_ttft_p99_ms": delta(
+                    r["ttft_p99_ms"], b["ttft_p99_ms"] if b else None
+                ),
+                "d_itl_p99_ms": delta(
+                    r["itl_p99_ms"], b["itl_p99_ms"] if b else None
+                ),
+            }
+        )
+    seen = {r["class"] for r in cur}
+    for r in base:
+        if r["class"] not in seen:
+            # Present at baseline, absent now: surface it rather than
+            # silently dropping a class from the review artifact.
+            out.append(
+                {
+                    "class": r["class"],
+                    "d_requests": 0,
+                    "d_goodput": 0,
+                    "window_goodput_ratio": None,
+                    "d_goodput_ratio": None,
+                    "d_ttft_attain": None,
+                    "d_itl_attain": None,
+                    "d_ttft_p99_ms": None,
+                    "d_itl_p99_ms": None,
+                }
+            )
+    out.sort(key=lambda r: r["class"])
+    return out
+
+
+def render_diff_table(rows: list[dict]) -> str:
+    headers = (
+        "class", "d_reqs", "d_goodput", "window_gp",
+        "d_gp_ratio", "d_ttft_ok", "d_itl_ok",
+        "d_ttft_p99", "d_itl_p99",
+    )
+    table = [headers]
+    for r in rows:
+        table.append(
+            (
+                r["class"],
+                str(r["d_requests"]),
+                str(r["d_goodput"]),
+                _fmt(r["window_goodput_ratio"], pct=True),
+                _fmt_delta(r["d_goodput_ratio"], pct=True),
+                _fmt_delta(r["d_ttft_attain"], pct=True),
+                _fmt_delta(r["d_itl_attain"], pct=True),
+                _fmt_delta(r["d_ttft_p99_ms"]),
+                _fmt_delta(r["d_itl_p99_ms"]),
+            )
+        )
+    widths = [
+        max(len(row[i]) for row in table) for i in range(len(headers))
+    ]
+    lines = []
+    for i, row in enumerate(table):
+        lines.append(
+            "  ".join(cell.ljust(w) for cell, w in zip(row, widths))
+        )
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
 def render_table(rows: list[dict]) -> str:
     headers = (
         "class", "reqs", "goodput", "ttft_ok", "itl_ok",
@@ -131,8 +254,26 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--json", action="store_true", help="emit rows as JSON"
     )
+    parser.add_argument(
+        "--diff",
+        metavar="BASELINE",
+        default=None,
+        help="baseline scrape (URL, JSON file, or '-'): render "
+        "per-class goodput/attainment DELTAS, source minus baseline — "
+        "pp columns are percentage-point changes, window_gp is the "
+        "goodput of just the traffic between the two scrapes",
+    )
     args = parser.parse_args(argv)
     rows = class_rows(load_view(args.source))
+    if args.diff is not None:
+        rows = diff_rows(class_rows(load_view(args.diff)), rows)
+        if args.json:
+            print(json.dumps(rows, indent=2))
+        elif not rows:
+            print("no SLO classes observed yet")
+        else:
+            print(render_diff_table(rows))
+        return 0
     if args.json:
         print(json.dumps(rows, indent=2))
     elif not rows:

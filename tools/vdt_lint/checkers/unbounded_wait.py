@@ -223,6 +223,11 @@ class UnboundedWaitChecker(Checker):
         # unbounded export/import wait would park token generation for
         # the whole replica behind one wedged transfer.
         "engine/kv_transfer.py",
+        # ISSUE 16: the QoS registry sits on the admission hot path
+        # (every reserve() resolves a class under the controller lock)
+        # — a wait introduced there would stall all admission.
+        # router/qos.py is already covered by the router/ scope.
+        "engine/qos.py",
     )
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:

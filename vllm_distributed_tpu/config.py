@@ -276,6 +276,15 @@ class SchedulerConfig:
     # sheds it with finish_reason="overloaded" instead of recompute
     # thrash.  0 = off.
     preempt_shed_threshold: int = 0
+    # ---- QoS control plane (ISSUE 16; default OFF = seed behavior) ----
+    # SLO class registry spec, "name:priority[:share[:weight]]" comma
+    # list (engine/qos.py).  Empty disables class-aware admission,
+    # priority admission ordering, and class-weighted preemption.
+    qos_classes: str = ""
+    # Chunked-prefill fairness budget: max fraction of the per-step
+    # token budget prefill chunks may take while a decode-bound request
+    # of higher-or-equal class is running.  0 = off.
+    qos_prefill_share: float = 0.0
     # ---- speculative decoding (ISSUE 11; default OFF) ----
     # Max tokens the n-gram prompt-lookup proposer drafts per request
     # per step (engine/spec_decode.py); the model runner verifies all
@@ -345,6 +354,15 @@ class SchedulerConfig:
                     f"{name} must be >= 0 (0 disables), got "
                     f"{getattr(self, name)}"
                 )
+        if not 0.0 <= self.qos_prefill_share <= 1.0:
+            raise ValueError(
+                "qos_prefill_share must be in [0, 1] (0 disables), got "
+                f"{self.qos_prefill_share}"
+            )
+        # Malformed class specs fail at config time, not mid-overload.
+        from vllm_distributed_tpu.engine.qos import parse_qos_classes
+
+        parse_qos_classes(self.qos_classes)
         if 1 < self.num_decode_steps and (
             self.fused_decode_steps() < self.num_decode_steps
         ):
@@ -647,6 +665,10 @@ class EngineArgs:
     speculative_ngram_max: int | None = None
     speculative_ngram_min: int | None = None
 
+    # QoS control plane (None -> resolved late from VDT_QOS_*).
+    qos_classes: str | None = None
+    qos_prefill_share: float | None = None
+
     # JSON dict (or dict) configuring a KV connector (disaggregated
     # prefill hook, SURVEY.md §3.4); None = off.
     kv_transfer_config: Any = None
@@ -809,6 +831,26 @@ class EngineArgs:
             "(default: $VDT_PREEMPT_SHED_THRESHOLD or 0 = off)",
         )
         parser.add_argument(
+            "--qos-classes",
+            type=str,
+            default=None,
+            help="SLO class registry, one entry per class "
+            '"name:priority[:share[:weight]]" comma-separated: priority '
+            "orders admission/preemption, share is the class's "
+            "guaranteed-minimum fraction of the admission caps, weight "
+            "scales the preempt-to-shed budget (default: "
+            "$VDT_QOS_CLASSES or empty = QoS off, seed scheduling)",
+        )
+        parser.add_argument(
+            "--qos-prefill-share",
+            type=float,
+            default=None,
+            help="chunked-prefill fairness budget: max fraction of the "
+            "per-step token budget prefill may take while a "
+            "decode-bound request of higher-or-equal class runs "
+            "(default: $VDT_QOS_PREFILL_SHARE or 0 = off)",
+        )
+        parser.add_argument(
             "--speculative-ngram-k",
             type=int,
             default=None,
@@ -939,6 +981,12 @@ class EngineArgs:
             ),
             spec_ngram_min=_env_default(
                 self.speculative_ngram_min, "VDT_SPEC_NGRAM_MIN"
+            ),
+            qos_classes=_env_default(
+                self.qos_classes, "VDT_QOS_CLASSES"
+            ),
+            qos_prefill_share=_env_default(
+                self.qos_prefill_share, "VDT_QOS_PREFILL_SHARE"
             ),
         )
         kv_transfer = self.kv_transfer_config

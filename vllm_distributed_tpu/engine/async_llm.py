@@ -141,7 +141,7 @@ class AsyncLLM:
                 # consumed — even on error, the tokens never reach the
                 # waiting queue.
                 est = payload.pop("_est_tokens", 0)
-                self._admission.consumed(est)
+                self._admission.consumed(est, payload.pop("_est_class", None))
                 request_id = payload["request_id"]
                 entry = self._journal.get(request_id)
                 if entry is not None:
@@ -299,7 +299,10 @@ class AsyncLLM:
             if op == "add":
                 # Release the admission reservation even when the loop
                 # is gone — the counters must not leak on shutdown.
-                self._admission.consumed(payload.pop("_est_tokens", 0))
+                self._admission.consumed(
+                    payload.pop("_est_tokens", 0),
+                    payload.pop("_est_class", None),
+                )
             if self._loop is None:
                 continue
             try:
@@ -415,6 +418,7 @@ class AsyncLLM:
         num_requests: int = 1,
         est_tokens: int = 0,
         prompt_token_ids: list[int] | None = None,
+        slo_class: str | None = None,
     ) -> None:
         """Pure admission pre-check for the HTTP layer (no
         reservation): raises EngineOverloadedError so rejects become
@@ -422,7 +426,7 @@ class AsyncLLM:
         authoritative reserving check."""
         try:
             self._admission.check(
-                num_requests, est_tokens, prompt_token_ids
+                num_requests, est_tokens, prompt_token_ids, slo_class
             )
         except EngineOverloadedError as e:
             self.engine.metrics.record_rejected(e.reason)
@@ -454,13 +458,20 @@ class AsyncLLM:
         # violate the drain contract), not new load.
         resume_entry = self._resumable.pop(request_id, None)
         est = 0
+        slo = (
+            sampling_params.slo_class
+            if sampling_params is not None
+            else None
+        )
         if resume_entry is None:
             est = estimate_prompt_tokens(prompt, prompt_token_ids)
             try:
                 # Bounded admission (ISSUE 8): caps + KV watermark +
                 # drain state.  Default-off knobs make this a single
-                # flag read in the seed configuration.
-                self._admission.reserve(est, prompt_token_ids)
+                # flag read in the seed configuration.  The class rides
+                # along so per-class shares (ISSUE 16) bill the right
+                # bucket.
+                self._admission.reserve(est, prompt_token_ids, slo)
             except EngineOverloadedError as e:
                 self.engine.metrics.record_rejected(e.reason)
                 get_tracer().event(
@@ -498,7 +509,7 @@ class AsyncLLM:
                 # Raced the death after the check above: the fail-all
                 # sweep may have already run without seeing our queue.
                 if resume_entry is None:
-                    self._admission.release(est)
+                    self._admission.release(est, slo)
                 raise self._dead_error()
             if resume_entry is not None:
                 self._intake.put(("resume", resume_entry))
@@ -513,6 +524,7 @@ class AsyncLLM:
                             sampling_params=sampling_params,
                             trace_ctx=trace_ctx,
                             _est_tokens=est,
+                            _est_class=slo,
                         ),
                     )
                 )

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import threading
 
+from vllm_distributed_tpu.engine.qos import QosRegistry
 from vllm_distributed_tpu.logger import init_logger
 from vllm_distributed_tpu.utils import cdiv
 
@@ -87,6 +88,15 @@ class AdmissionController:
         # thread's intake drain (the scheduler can't see them yet).
         self._pending_requests = 0
         self._pending_tokens = 0
+        # Per-class mirrors of the pending counters, maintained only
+        # when the QoS registry is enabled (ISSUE 16).  Keys are
+        # registry-resolved class names, so the dicts are bounded by
+        # MAX_CLASSES no matter what strings requests carry.
+        self.qos = QosRegistry.parse(
+            getattr(scheduler_config, "qos_classes", "")
+        )
+        self._pending_by_class: dict[str, int] = {}
+        self._pending_tokens_by_class: dict[str, int] = {}
         self._drain_state = DRAIN_SERVING
         # Bound by the engine thread after boot; None while unwired
         # (checks degrade to caps-only, no scheduler snapshot).
@@ -142,11 +152,58 @@ class AdmissionController:
         base = sched.num_waiting_tokens if sched is not None else 0
         return base + self._pending_tokens
 
+    def class_queue_depth(self, name: str) -> int:
+        """One class's admission-queue depth (scheduler + pending)."""
+        sched = self._scheduler
+        waiting = 0
+        if sched is not None:
+            waiting = getattr(sched, "waiting_by_class", {}).get(name, 0)
+        return waiting + self._pending_by_class.get(name, 0)
+
+    def class_queued_tokens(self, name: str) -> int:
+        sched = self._scheduler
+        base = 0
+        if sched is not None:
+            base = getattr(sched, "waiting_tokens_by_class", {}).get(
+                name, 0
+            )
+        return base + self._pending_tokens_by_class.get(name, 0)
+
+    def _admit_shared(
+        self,
+        cap: int,
+        total: int,
+        new: int,
+        slo_class: str | None,
+        class_usage,
+    ) -> bool:
+        """Guaranteed-minimum share admission (QoS enabled only).
+
+        A class admits if it fits inside its own guaranteed slice of
+        the cap (``share * cap``) OR the whole queue still has spare
+        capacity to borrow (work-conserving: guarantees never idle the
+        cap when one class is the only traffic).  Under sustained
+        overload the borrow clause fails for everyone and only classes
+        inside their guarantee keep admitting — so the 429s land on
+        the over-share / zero-share (low-priority) classes first.  The
+        guarantee clause can overshoot the cap, but by at most
+        ``sum(shares) * cap`` (shares sum <= 1 by construction), so
+        the queue stays bounded at 2x the configured cap worst-case.
+        """
+        if total + new <= cap:
+            return True  # spare capacity: borrow, no questions asked
+        qc = self.qos.resolve(slo_class)
+        if qc.admission_share <= 0.0:
+            return False
+        guaranteed = int(qc.admission_share * cap)
+        return class_usage(qc.name) + new <= guaranteed
+
     def _check(
         self,
         num_requests: int,
         est_tokens: int,
         prompt_token_ids: list[int] | None = None,
+        slo_class: str | None = None,
     ) -> EngineOverloadedError | None:
         """The decision, caps-first (cheapest signals first).  Returns
         the reject to raise, or None to admit."""
@@ -156,9 +213,21 @@ class AdmissionController:
                 "engine is draining; not admitting new requests",
             )
         cfg = self.config
+        qos_on = self.qos.enabled
         if cfg.max_waiting_requests > 0:
             depth = self.queue_depth()
-            if depth + num_requests > cfg.max_waiting_requests:
+            admit = (
+                self._admit_shared(
+                    cfg.max_waiting_requests,
+                    depth,
+                    num_requests,
+                    slo_class,
+                    self.class_queue_depth,
+                )
+                if qos_on
+                else depth + num_requests <= cfg.max_waiting_requests
+            )
+            if not admit:
                 return self._overloaded(
                     "queue_full",
                     f"admission queue holds {depth} request(s), cap is "
@@ -166,7 +235,18 @@ class AdmissionController:
                 )
         if cfg.max_queued_tokens > 0:
             queued = self.queued_tokens()
-            if queued + est_tokens > cfg.max_queued_tokens:
+            admit = (
+                self._admit_shared(
+                    cfg.max_queued_tokens,
+                    queued,
+                    est_tokens,
+                    slo_class,
+                    self.class_queued_tokens,
+                )
+                if qos_on
+                else queued + est_tokens <= cfg.max_queued_tokens
+            )
+            if not admit:
                 return self._overloaded(
                     "queued_tokens",
                     f"{queued} prompt token(s) queued, cap is "
@@ -221,11 +301,14 @@ class AdmissionController:
         num_requests: int = 1,
         est_tokens: int = 0,
         prompt_token_ids: list[int] | None = None,
+        slo_class: str | None = None,
     ) -> None:
         """Pure check (no reservation) — the HTTP layer calls this
         before opening an SSE stream so rejects become proper 429
         responses, not in-stream error frames."""
-        err = self._check(num_requests, est_tokens, prompt_token_ids)
+        err = self._check(
+            num_requests, est_tokens, prompt_token_ids, slo_class
+        )
         if err is not None:
             raise err
 
@@ -233,27 +316,45 @@ class AdmissionController:
         self,
         est_tokens: int,
         prompt_token_ids: list[int] | None = None,
+        slo_class: str | None = None,
     ) -> None:
         """Authoritative admit for ONE request: re-checks the caps and
         reserves intake-pending capacity.  The reservation is released
         by ``consumed`` (engine thread drained the add) or ``release``
         (the add never reached the intake)."""
-        err = self._check(1, est_tokens, prompt_token_ids)
+        err = self._check(1, est_tokens, prompt_token_ids, slo_class)
         if err is not None:
             raise err
         with self._lock:
             self._pending_requests += 1
             self._pending_tokens += est_tokens
+            if self.qos.enabled:
+                name = self.qos.resolve(slo_class).name
+                self._pending_by_class[name] = (
+                    self._pending_by_class.get(name, 0) + 1
+                )
+                self._pending_tokens_by_class[name] = (
+                    self._pending_tokens_by_class.get(name, 0) + est_tokens
+                )
 
-    def consumed(self, est_tokens: int) -> None:
+    def consumed(self, est_tokens: int, slo_class: str | None = None) -> None:
         """Engine thread: one reserved add left the intake (it is now
         scheduler state, counted there)."""
-        self.release(est_tokens)
+        self.release(est_tokens, slo_class)
 
-    def release(self, est_tokens: int) -> None:
+    def release(self, est_tokens: int, slo_class: str | None = None) -> None:
         with self._lock:
             self._pending_requests = max(self._pending_requests - 1, 0)
             self._pending_tokens = max(self._pending_tokens - est_tokens, 0)
+            if self.qos.enabled:
+                name = self.qos.resolve(slo_class).name
+                self._pending_by_class[name] = max(
+                    self._pending_by_class.get(name, 0) - 1, 0
+                )
+                self._pending_tokens_by_class[name] = max(
+                    self._pending_tokens_by_class.get(name, 0) - est_tokens,
+                    0,
+                )
 
 
 def estimate_prompt_tokens(

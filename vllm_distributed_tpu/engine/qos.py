@@ -1,0 +1,163 @@
+"""QoS class registry: the control half of SLO-class serving (ISSUE 16).
+
+PR 12 gave every request an ``slo_class`` and measured per-class goodput
+(engine/slo.py); nothing *acted* on it — a bulk batch job and an
+interactive chat request were admitted, scheduled, preempted, placed,
+and scaled identically.  This registry is the shared vocabulary the
+control loops key on:
+
+- **admission** (engine/overload.py): per-class guaranteed-minimum
+  shares of the bounded-admission caps, with work-conserving borrowing —
+  under overload the 429s land on classes over their share first
+  instead of FIFO arrival order;
+- **scheduling** (engine/scheduler.py): class priority orders waiting
+  admission and picks preemption victims (lowest class evicted first),
+  and the preemption weight scales the preempt-to-shed budget;
+- **placement/scaling** (router/qos.py): the same parsed registry
+  drives per-class replica placement and the per-class goodput
+  autoscale trigger.
+
+Configured via ``VDT_QOS_CLASSES`` / ``--qos-classes`` with one entry
+per class, ``name:priority[:share[:weight]]``, comma-separated — e.g.
+``interactive:10:0.5,default:0:0.3,batch:-10:0:2.0``.  Empty (the
+default) leaves the registry DISABLED: a single "default" class and
+every hook a no-op, so seed scheduling is bit-identical.
+
+Class names pass through :func:`engine.slo.sanitize_class` and the
+registry refuses more than :data:`engine.slo.MAX_CLASSES` entries, so
+every label a QoS control loop can emit already satisfies the PR 12
+metrics cardinality cap.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from vllm_distributed_tpu.engine.slo import (
+    DEFAULT_CLASS,
+    MAX_CLASSES,
+    sanitize_class,
+)
+
+
+@dataclass(frozen=True)
+class QosClass:
+    """One SLO class's control parameters."""
+
+    name: str
+    # Strict ordering: higher admits first, preempts last.  Ties keep
+    # FIFO arrival order, so equal-priority classes behave like today.
+    priority: int = 0
+    # Guaranteed-minimum fraction of each bounded-admission cap
+    # (max_waiting_requests / max_queued_tokens).  0 = no guarantee:
+    # the class admits only from spare (borrowed) capacity.
+    admission_share: float = 0.0
+    # Scales the preempt-to-shed budget (VDT_PREEMPT_SHED_THRESHOLD):
+    # a 0.5-weight class is shed after half the preemptions, a
+    # 2.0-weight class tolerates twice as many.  1.0 = unchanged.
+    preemption_weight: float = 1.0
+
+
+_DEFAULT = QosClass(name=DEFAULT_CLASS)
+
+
+def parse_qos_classes(spec: str) -> dict[str, QosClass]:
+    """Parse a ``name:priority[:share[:weight]]`` comma list.
+
+    Raises ValueError on malformed entries, duplicate names, shares
+    outside [0, 1], shares summing above 1 (guarantees must be
+    satisfiable simultaneously), non-positive weights, or more than
+    MAX_CLASSES entries — config errors surface at boot, not as silent
+    misallocation under overload.
+    """
+    classes: dict[str, QosClass] = {}
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) < 2 or len(parts) > 4:
+            raise ValueError(
+                f"QoS class entry {entry!r} is not "
+                "name:priority[:share[:weight]]"
+            )
+        name = sanitize_class(parts[0])
+        if name in classes:
+            raise ValueError(f"duplicate QoS class {name!r}")
+        try:
+            priority = int(parts[1])
+            share = float(parts[2]) if len(parts) > 2 else 0.0
+            weight = float(parts[3]) if len(parts) > 3 else 1.0
+        except ValueError as e:
+            raise ValueError(
+                f"QoS class entry {entry!r}: {e}"
+            ) from None
+        if not 0.0 <= share <= 1.0:
+            raise ValueError(
+                f"QoS class {name!r} admission share {share} is "
+                "outside [0, 1]"
+            )
+        if weight <= 0.0:
+            raise ValueError(
+                f"QoS class {name!r} preemption weight {weight} must "
+                "be positive"
+            )
+        classes[name] = QosClass(
+            name=name,
+            priority=priority,
+            admission_share=share,
+            preemption_weight=weight,
+        )
+    if len(classes) > MAX_CLASSES:
+        raise ValueError(
+            f"{len(classes)} QoS classes exceed the metrics cardinality "
+            f"cap of {MAX_CLASSES}"
+        )
+    total_share = sum(c.admission_share for c in classes.values())
+    if total_share > 1.0 + 1e-9:
+        raise ValueError(
+            f"QoS admission shares sum to {total_share:.3f} > 1: the "
+            "guaranteed minimums cannot all be honored at once"
+        )
+    return classes
+
+
+class QosRegistry:
+    """Immutable class table with a default-class fallback.
+
+    ``enabled`` is False when built from an empty spec: every consumer
+    guards its QoS branch on it, so the default configuration runs the
+    exact seed code paths.
+    """
+
+    def __init__(self, classes: dict[str, QosClass] | None = None) -> None:
+        self.classes: dict[str, QosClass] = dict(classes or {})
+        self.enabled = bool(self.classes)
+        # Unknown/absent classes get the configured "default" entry's
+        # parameters when one exists, else the neutral built-in.
+        self.default = self.classes.get(DEFAULT_CLASS, _DEFAULT)
+
+    @classmethod
+    def parse(cls, spec: str | None) -> QosRegistry:
+        return cls(parse_qos_classes(spec or ""))
+
+    def resolve(self, slo_class: str | None) -> QosClass:
+        """Class parameters for a request's (raw) slo_class.  Unknown
+        names fold into the default entry — one bucket, so request-
+        supplied strings can never grow the control plane's keyspace
+        past the registry (the same cap discipline as slo.resolve)."""
+        if not self.enabled:
+            return self.default
+        return self.classes.get(sanitize_class(slo_class), self.default)
+
+    def class_names(self) -> list[str]:
+        """Registered names, highest priority first (placement order)."""
+        return sorted(
+            self.classes,
+            key=lambda n: (-self.classes[n].priority, n),
+        )
+
+    def min_priority(self) -> int:
+        if not self.classes:
+            return 0
+        return min(c.priority for c in self.classes.values())

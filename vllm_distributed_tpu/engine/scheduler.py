@@ -31,6 +31,7 @@ from vllm_distributed_tpu.engine.block_manager import (
     PrefixCachingAllocator,
     RadixPrefixCachingAllocator,
 )
+from vllm_distributed_tpu.engine.qos import QosRegistry
 from vllm_distributed_tpu.engine.request import Request, RequestStatus
 from vllm_distributed_tpu.engine.spec_decode import spec_eligible
 from vllm_distributed_tpu.logger import init_logger
@@ -226,6 +227,28 @@ class Scheduler:
         # path below never holds pages (seed behavior); while idle the
         # manager costs one attribute read per schedule.
         self.kv_transfer = None
+        # QoS control plane (ISSUE 16): class registry driving priority
+        # admission order, class-weighted preemption, and the per-class
+        # waiting mirrors the admission shares read.  Disabled (the
+        # default) keeps every decision on the seed code path.
+        self.qos = QosRegistry.parse(scheduler_config.qos_classes)
+        # Per-class mirrors of len(waiting)/num_waiting_tokens, keyed by
+        # the RESOLVED class bucket (unknown names fold into "default",
+        # so the keyspace is capped by the registry).  Maintained only
+        # while QoS is enabled.
+        self.waiting_by_class: dict[str, int] = {}
+        self.waiting_tokens_by_class: dict[str, int] = {}
+        # Cumulative per-class preempt/shed counters (QoS only): the
+        # acceptance evidence that evictions land on the lowest class.
+        self.preemptions_by_class: dict[str, int] = {}
+        self.sheds_by_class: dict[str, int] = {}
+
+    # ---- QoS lookups (ISSUE 16) ----
+    def _qos_priority(self, req: Request) -> int:
+        return self.qos.resolve(req.sampling_params.slo_class).priority
+
+    def _qos_bucket(self, req: Request) -> str:
+        return self.qos.resolve(req.sampling_params.slo_class).name
 
     # ---- waiting-queue mutation (ALL of it goes through these three
     # helpers so num_waiting_tokens can never drift from the deque) ----
@@ -234,18 +257,34 @@ class Scheduler:
             self.waiting.appendleft(req)
         else:
             self.waiting.append(req)
-        self.num_waiting_tokens += req.prefill_target - req.num_computed_tokens
+        tokens = req.prefill_target - req.num_computed_tokens
+        self.num_waiting_tokens += tokens
+        if self.qos.enabled:
+            cls = self._qos_bucket(req)
+            self.waiting_by_class[cls] = (
+                self.waiting_by_class.get(cls, 0) + 1
+            )
+            self.waiting_tokens_by_class[cls] = (
+                self.waiting_tokens_by_class.get(cls, 0) + tokens
+            )
 
     def _waiting_pop(self, req: Request, popleft: bool = False) -> None:
         if popleft:
             self.waiting.popleft()
         else:
             self.waiting.remove(req)
+        tokens = req.prefill_target - req.num_computed_tokens
         self.num_waiting_tokens = max(
-            self.num_waiting_tokens
-            - (req.prefill_target - req.num_computed_tokens),
-            0,
+            self.num_waiting_tokens - tokens, 0
         )
+        if self.qos.enabled:
+            cls = self._qos_bucket(req)
+            self.waiting_by_class[cls] = max(
+                self.waiting_by_class.get(cls, 0) - 1, 0
+            )
+            self.waiting_tokens_by_class[cls] = max(
+                self.waiting_tokens_by_class.get(cls, 0) - tokens, 0
+            )
 
     # ---- intake ----
     def add_request(self, req: Request) -> None:
@@ -409,6 +448,36 @@ class Scheduler:
 
         token_budget = self.config.max_num_batched_tokens
 
+        # Chunked-prefill fairness budget (ISSUE 16): while any
+        # decode-bound request of higher-or-equal class is running,
+        # prefill chunks collectively take at most qos_prefill_share of
+        # the step budget, so a 32k-token prefill can no longer starve
+        # decode ITL on a mixed replica.  Work-conserving: with no
+        # qualifying decode running, prefill fills whatever budget is
+        # left — exactly the seed policy.  Off (share=0, the default)
+        # this whole block is two config reads.
+        prefill_cap: int | None = None
+        max_decode_prio = 0
+        if (
+            self.config.enable_chunked_prefill
+            and 0.0 < self.config.qos_prefill_share < 1.0
+        ):
+            decode_prios = [
+                self._qos_priority(r) if self.qos.enabled else 0
+                for r in self.running
+                if not r.is_prefill
+            ]
+            if decode_prios:
+                max_decode_prio = max(decode_prios)
+                prefill_cap = max(
+                    int(
+                        self.config.qos_prefill_share
+                        * self.config.max_num_batched_tokens
+                    ),
+                    1,
+                )
+        prefill_used = 0
+
         # Multi-step decode: when the whole batch is decoding and nothing
         # is waiting to be admitted, fuse K decode steps into one device
         # dispatch.  K is UNIFORM (the configured value, clamped only by
@@ -460,6 +529,13 @@ class Scheduler:
             if req.is_prefill:
                 remaining = req.prefill_target - req.num_computed_tokens
                 chunk = min(remaining, token_budget)
+                if (
+                    prefill_cap is not None
+                    and self._qos_priority(req) <= max_decode_prio
+                ):
+                    chunk = min(chunk, prefill_cap - prefill_used)
+                    if chunk <= 0:
+                        continue
                 if not self.config.enable_chunked_prefill and chunk < remaining:
                     continue
                 num_new = chunk
@@ -514,7 +590,9 @@ class Scheduler:
             if drafts is not None:
                 out.draft_token_ids[req.request_id] = drafts
                 self.spec_drafted_tokens += len(drafts)
-            if not req.is_prefill:
+            if req.is_prefill:
+                prefill_used += num_new
+            else:
                 req.num_inflight_tokens += num_new
             scheduled_running.append(req)
 
@@ -525,6 +603,13 @@ class Scheduler:
             and len(self.running) < self.config.max_num_seqs
         ):
             req = self.waiting[0]
+            if self.qos.enabled:
+                # Priority admission (ISSUE 16): highest class first,
+                # FIFO within a class (the deque IS arrival order).
+                # Strict: a blocked high-class head blocks lower
+                # classes too — borrowing happens at admission control,
+                # not by reordering around a starved guarantee.
+                req = self._pick_waiting()
             if req.request_id in preempted:
                 break  # do not resume a request preempted this same step
             # Prefix cache: a request without pages resumes after the
@@ -558,6 +643,11 @@ class Scheduler:
                 req.prefill_target - req.num_computed_tokens - hit_tokens
             )
             num_new = min(remaining_prompt, token_budget)
+            if (
+                prefill_cap is not None
+                and self._qos_priority(req) <= max_decode_prio
+            ):
+                num_new = min(num_new, prefill_cap - prefill_used)
             if num_new <= 0:
                 break
             if not self.config.enable_chunked_prefill:
@@ -575,7 +665,7 @@ class Scheduler:
                 ok = self.allocator.can_allocate(req, num_new)
             if not ok:
                 break
-            self._waiting_pop(req, popleft=True)
+            self._waiting_pop(req, popleft=req is self.waiting[0])
             host_hit = 0
             try:
                 if self.enable_prefix_caching and hit_tokens:
@@ -632,6 +722,7 @@ class Scheduler:
                     sampling_params=req.sampling_params,
                 )
             )
+            prefill_used += num_new
 
         # Tiered KV (ISSUE 14): ship the spill/restore spans this
         # schedule produced (evictions during allocate, restores during
@@ -728,6 +819,19 @@ class Scheduler:
             return True
         return self._spec_pipeline_steps >= _SPEC_PROBE_INTERVAL
 
+    def _pick_waiting(self) -> Request:
+        """QoS admission order (ISSUE 16): the highest-priority class's
+        oldest waiting request.  A forward scan keeping the FIRST max
+        preserves FIFO within each class, so equal-priority traffic
+        behaves exactly like the seed deque."""
+        best = self.waiting[0]
+        best_prio = self._qos_priority(best)
+        for cand in self.waiting:
+            p = self._qos_priority(cand)
+            if p > best_prio:
+                best, best_prio = cand, p
+        return best
+
     def _allocate_or_preempt(
         self,
         req: Request,
@@ -747,20 +851,53 @@ class Scheduler:
             try:
                 return True, self.allocator.allocate(req, num_new)
             except NoFreePagesError:
-                victim = None
-                for cand in reversed(self.running):
-                    if (
-                        cand is not req
-                        and cand.request_id not in preempted
-                        and cand not in scheduled_this_step
-                    ):
-                        victim = cand
-                        break
+                victim = self._pick_victim(
+                    req, preempted, scheduled_this_step
+                )
                 if victim is None:
                     # Preempt req itself.
                     self._preempt(req, preempted)
                     return None
                 self._preempt(victim, preempted)
+
+    def _pick_victim(
+        self,
+        req: Request,
+        preempted: set[str],
+        scheduled_this_step: list[Request],
+    ) -> Request | None:
+        """Eviction victim for req's allocation.  Seed policy: the most
+        recently admitted eligible request.  Under QoS (ISSUE 16) the
+        LOWEST class goes first (recency breaks ties within a class),
+        and a victim of strictly higher class than the requester is
+        never evicted — the requester yields instead, so low-class
+        pressure can't thrash high-class decodes."""
+        if not self.qos.enabled:
+            for cand in reversed(self.running):
+                if (
+                    cand is not req
+                    and cand.request_id not in preempted
+                    and cand not in scheduled_this_step
+                ):
+                    return cand
+            return None
+        victim = None
+        victim_prio = 0
+        for idx, cand in enumerate(self.running):
+            if (
+                cand is req
+                or cand.request_id in preempted
+                or cand in scheduled_this_step
+            ):
+                continue
+            p = self._qos_priority(cand)
+            if victim is None or p < victim_prio:
+                victim, victim_prio = cand, p
+            elif p == victim_prio:
+                victim = cand  # later index: recency within the class
+        if victim is not None and victim_prio > self._qos_priority(req):
+            return None
+        return victim
 
     def _preempt(self, req: Request, preempted: set[str]) -> None:
         logger.debug("preempting request %s", req.request_id)
@@ -785,7 +922,27 @@ class Scheduler:
         # no entry in _finished_since_last (it would collide with the
         # request's own resume in a later step's new_requests).
         preempted.add(req.request_id)
+        if self.qos.enabled:
+            cls = self._qos_bucket(req)
+            self.preemptions_by_class[cls] = (
+                self.preemptions_by_class.get(cls, 0) + 1
+            )
         shed_after = self.config.preempt_shed_threshold
+        if shed_after > 0 and self.qos.enabled:
+            # Preemption weight scales the shed budget: a 0.5-weight
+            # class degrades to rejection after half the evictions, a
+            # 2.0-weight class rides out twice as many.
+            shed_after = max(
+                int(
+                    round(
+                        shed_after
+                        * self.qos.resolve(
+                            req.sampling_params.slo_class
+                        ).preemption_weight
+                    )
+                ),
+                1,
+            )
         if shed_after > 0 and req.num_preemptions > shed_after:
             # Sustained-pressure preempt-to-shed (ISSUE 8): this request
             # has been evicted-and-recomputed past the policy budget —
@@ -797,6 +954,11 @@ class Scheduler:
             self.requests.pop(req.request_id, None)
             self._finished_out_of_band.append(req)
             self.num_sheds += 1
+            if self.qos.enabled:
+                cls = self._qos_bucket(req)
+                self.sheds_by_class[cls] = (
+                    self.sheds_by_class.get(cls, 0) + 1
+                )
             get_tracer().event(
                 req.trace_ctx,
                 "engine.preempt_shed",

@@ -149,6 +149,36 @@ def make_parser() -> argparse.ArgumentParser:
         "vllm:goodput_requests_total counters — so scheduler changes "
         "are judged on SLO attainment, not just tokens/s",
     )
+    # --seed comes from EngineArgs.add_cli_args below (shared with the
+    # engine modes); serve mode reuses it for the open-loop arrival
+    # processes and tenant length distributions, and records it in the
+    # results JSON so multi-tenant A/B runs are reproducible.
+    bench.add_argument(
+        "--tenant",
+        dest="tenants",
+        action="append",
+        default=None,
+        metavar="NAME:key=val,...",
+        help="serve mode: MULTI-TENANT load — repeatable named traffic "
+        'profiles, e.g. --tenant "chat:class=interactive,arrival='
+        'bursty,rate=8,burst=4,input=16-64,output=32-128" --tenant '
+        '"bulk:class=batch,arrival=closed,concurrency=16".  Keys: '
+        "class (SLO class sent with every request, default NAME), "
+        "arrival (poisson|bursty|closed), rate (req/s for the "
+        "open-loop arrivals), burst (arrivals per burst epoch), "
+        "concurrency (closed-loop streams), input/output (token "
+        "lengths, INT or LO-HI sampled uniformly per request).  All "
+        "tenants run concurrently for --tenant-seconds; the report "
+        "carries per-tenant client percentiles and shed counts plus "
+        "per-class server goodput deltas — the instrument every QoS "
+        "scheduling change is judged with",
+    )
+    bench.add_argument(
+        "--tenant-seconds",
+        type=float,
+        default=10.0,
+        help="multi-tenant mode: wall-clock duration of the run",
+    )
     bench.add_argument(
         "--disagg",
         action="store_true",
@@ -418,6 +448,9 @@ async def _router_async(args: argparse.Namespace) -> None:
                 state.metrics,
                 cfg,
                 slo_probe=_slo_classes,
+                # Long-prompt arrival EWMA observed by the proxy path;
+                # drives the per-role prefill-pool target (ISSUE 16).
+                prefill_demand=state.prefill_demand,
             )
         state.attach_fleet(manager, autoscaler)
     app = build_router_app(state)
@@ -520,6 +553,100 @@ def parse_ramp(spec: str) -> list[tuple[float, float]]:
     if not segments:
         raise SystemExit("--ramp needs at least one RATE:SECONDS segment")
     return segments
+
+
+TENANT_ARRIVALS = ("poisson", "bursty", "closed")
+
+
+def parse_len_range(spec: str, what: str) -> tuple[int, int]:
+    """``"8"`` → (8, 8); ``"32-128"`` → (32, 128) (uniform bounds)."""
+    lo_s, sep, hi_s = spec.partition("-")
+    try:
+        lo = int(lo_s)
+        hi = int(hi_s) if sep else lo
+        if lo <= 0 or hi < lo:
+            raise ValueError
+    except ValueError:
+        raise SystemExit(
+            f"bad tenant {what} length {spec!r}: want INT or LO-HI "
+            "with 0 < LO <= HI"
+        )
+    return lo, hi
+
+
+def parse_tenants(specs: list[str]) -> list[dict]:
+    """Parse repeatable ``--tenant "NAME:key=val,..."`` profiles
+    (ISSUE 16's multi-tenant load generator).
+
+    Each profile is an independent traffic source with its own SLO
+    class, arrival process, and prompt/output length distributions —
+    e.g. interactive chat (bursty short prompts), long-context
+    summarization (Poisson long prompts), bulk batch (closed-loop).
+    """
+    tenants: list[dict] = []
+    seen: set[str] = set()
+    for spec in specs:
+        name, sep, rest = spec.partition(":")
+        name = name.strip()
+        if not name or not sep:
+            raise SystemExit(
+                f"bad --tenant {spec!r}: want NAME:key=val,..."
+            )
+        if name in seen:
+            raise SystemExit(f"duplicate --tenant name {name!r}")
+        seen.add(name)
+        profile = {
+            "name": name,
+            "slo_class": name,
+            "arrival": "poisson",
+            "rate": 4.0,
+            "burst": 4,
+            "concurrency": 4,
+            "input": (32, 32),
+            "output": (64, 64),
+        }
+        for kv in rest.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            key, sep2, val = kv.partition("=")
+            key, val = key.strip(), val.strip()
+            if not sep2 or not val:
+                raise SystemExit(
+                    f"bad --tenant {name!r} entry {kv!r}: want key=val"
+                )
+            try:
+                if key == "class":
+                    profile["slo_class"] = val
+                elif key == "arrival":
+                    if val not in TENANT_ARRIVALS:
+                        raise ValueError
+                    profile["arrival"] = val
+                elif key == "rate":
+                    profile["rate"] = float(val)
+                    if profile["rate"] <= 0:
+                        raise ValueError
+                elif key == "burst":
+                    profile["burst"] = int(val)
+                    if profile["burst"] < 1:
+                        raise ValueError
+                elif key == "concurrency":
+                    profile["concurrency"] = int(val)
+                    if profile["concurrency"] < 1:
+                        raise ValueError
+                elif key in ("input", "output"):
+                    profile[key] = parse_len_range(val, key)
+                else:
+                    raise SystemExit(
+                        f"unknown --tenant key {key!r} (want class/"
+                        "arrival/rate/burst/concurrency/input/output)"
+                    )
+            except ValueError:
+                raise SystemExit(
+                    f"bad --tenant {name!r} value for {key!r}: {val!r}"
+                )
+        tenants.append(profile)
+    return tenants
 
 
 def _percentiles(xs: list[float]) -> dict:
@@ -681,7 +808,10 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
         return await _bench_disagg_interference(args)
 
     url = args.url.rstrip("/")
-    sem = asyncio.Semaphore(args.concurrency)
+    # The closed-loop semaphore is unused by the tenant path (each
+    # profile carries its own concurrency), so tenant-only invocations
+    # may omit --concurrency entirely.
+    sem = asyncio.Semaphore(getattr(args, "concurrency", None) or 1)
     ttfts: list[float] = []
     itls: list[float] = []
     out_tokens = 0
@@ -698,6 +828,41 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
     ramp_segments = parse_ramp(ramp) if ramp else None
     if ramp_segments and request_rate is not None:
         raise SystemExit("--ramp and --request-rate are mutually exclusive")
+
+    # Reproducible stochastic load (ISSUE 16 satellite): ONE seed
+    # drives every arrival process and length distribution, and is
+    # recorded in the results JSON, so two A/B runs offer the same
+    # workload down to the per-request token counts.
+    import random
+
+    seed = int(getattr(args, "seed", None) or 12345)
+
+    # Multi-tenant profiles (ISSUE 16): independent concurrent traffic
+    # sources, each with its own class/arrivals/length distributions.
+    tenant_specs = getattr(args, "tenants", None)
+    tenants = parse_tenants(tenant_specs) if tenant_specs else None
+    if tenants and (request_rate is not None or ramp_segments):
+        raise SystemExit(
+            "--tenant is mutually exclusive with --request-rate/--ramp"
+        )
+    tenant_seconds = float(getattr(args, "tenant_seconds", None) or 10.0)
+    tenant_runs: list[dict] = [
+        {
+            "profile": p,
+            # Per-tenant NAMED streams: adding or reordering a tenant
+            # can't shift another tenant's arrival or length draws.
+            "arr_rng": random.Random(f"{seed}:{p['name']}:arrival"),
+            "len_rng": random.Random(f"{seed}:{p['name']}:length"),
+            "offered": 0,
+            "completed": 0,
+            "rejected": 0,
+            "timed_out": 0,
+            "errors": 0,
+            "ttfts": [],
+            "itls": [],
+        }
+        for p in (tenants or ())
+    ]
     seg_stats: list[dict] = [
         {
             "rate_rps": rate,
@@ -730,6 +895,13 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
         cls: {"ttfts": [], "itls": [], "completed": 0, "shed": 0}
         for cls in class_pattern
     }
+    for t in tenant_runs:
+        # Tenant classes join the per-class readout (several tenants
+        # may share one SLO class — the server judges by class).
+        per_class.setdefault(
+            t["profile"]["slo_class"],
+            {"ttfts": [], "itls": [], "completed": 0, "shed": 0},
+        )
 
     def class_for(i: int) -> str | None:
         if not class_pattern:
@@ -808,15 +980,28 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
     shared_prefix_len = getattr(args, "shared_prefix_len", 0) or 0
     shared_prefix = [(7 * j) % 900 + 1 for j in range(shared_prefix_len)]
 
-    async def drive_one(session, i: int, seg: dict | None = None) -> None:
+    async def drive_one(
+        session,
+        i: int,
+        seg: dict | None = None,
+        ten: dict | None = None,
+    ) -> None:
         nonlocal out_tokens
+        if ten is not None:
+            p = ten["profile"]
+            input_len = ten["len_rng"].randint(*p["input"])
+            output_len = ten["len_rng"].randint(*p["output"])
+            slo_class = p["slo_class"]
+        else:
+            input_len, output_len = args.input_len, args.output_len
+            slo_class = class_for(i)
         prompt = shared_prefix + [
-            (13 * i + j) % 900 + 1 for j in range(args.input_len)
+            (13 * i + j) % 900 + 1 for j in range(input_len)
         ]
         body = {
             "model": args.model or "bench",
             "prompt": prompt,
-            "max_tokens": args.output_len,
+            "max_tokens": output_len,
             "temperature": 0.0,
             "ignore_eos": True,
             "stream": True,
@@ -827,7 +1012,6 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
         }
         if getattr(args, "deadline_ms", None):
             body["deadline_ms"] = args.deadline_ms
-        slo_class = class_for(i)
         if slo_class is not None:
             body["slo_class"] = slo_class
         t0 = time.perf_counter()
@@ -844,6 +1028,8 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                     counts["rejected"] += 1
                     if seg is not None:
                         seg["rejected"] += 1
+                    if ten is not None:
+                        ten["rejected"] += 1
                     await resp.read()
                     return
                 resp.raise_for_status()
@@ -881,6 +1067,8 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
             counts["errors"] += 1
             if seg is not None:
                 seg["errors"] += 1
+            if ten is not None:
+                ten["errors"] += 1
             return
         if finish_reason in ("timeout", "overloaded"):
             # Deadline/pressure shed mid-generation: partial output —
@@ -888,12 +1076,16 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
             counts["timed_out"] += 1
             if seg is not None:
                 seg["timed_out"] += 1
+            if ten is not None:
+                ten["timed_out"] += 1
             if slo_class is not None:
                 per_class[slo_class]["shed"] += 1
             return
         counts["completed"] += 1
         if seg is not None:
             seg["completed"] += 1
+        if ten is not None:
+            ten["completed"] += 1
         if slo_class is not None:
             per_class[slo_class]["completed"] += 1
         if chunk_times:
@@ -912,6 +1104,10 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                 seg["ttfts"].append(ttft)
                 if itl is not None:
                     seg["itls"].append(itl)
+            if ten is not None:
+                ten["ttfts"].append(ttft)
+                if itl is not None:
+                    ten["itls"].append(itl)
             if slo_class is not None:
                 per_class[slo_class]["ttfts"].append(ttft)
                 if itl is not None:
@@ -931,10 +1127,64 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
     async with aiohttp.ClientSession(timeout=timeout) as session:
         before, slo_before = await scrape_metrics(session)
         t0 = time.perf_counter()
-        if ramp_segments is not None:
-            import random
+        if tenant_runs:
+            # Multi-tenant: every profile is an independent concurrent
+            # traffic source against the same deployment for a fixed
+            # wall-clock window — the per-class contention workload the
+            # QoS control plane is judged on.
+            import itertools
 
-            rng = random.Random(12345)  # reproducible arrival process
+            t_end = time.perf_counter() + tenant_seconds
+            next_i = itertools.count()
+
+            async def tenant_open_loop(ten: dict) -> None:
+                p = ten["profile"]
+                rng = ten["arr_rng"]
+                n_burst = p["burst"] if p["arrival"] == "bursty" else 1
+                # Burst epochs arrive Poisson at rate/burst so the
+                # OFFERED rate equals the configured rate either way —
+                # bursty just concentrates it into spikes.
+                epoch_rate = p["rate"] / n_burst
+                tasks = []
+                while time.perf_counter() < t_end:
+                    for _ in range(n_burst):
+                        ten["offered"] += 1
+                        tasks.append(
+                            asyncio.create_task(
+                                drive_one(session, next(next_i), ten=ten)
+                            )
+                        )
+                    remaining = t_end - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    await asyncio.sleep(
+                        min(rng.expovariate(epoch_rate), remaining)
+                    )
+                await asyncio.gather(*tasks)
+
+            async def tenant_closed_loop(ten: dict) -> None:
+                async def worker() -> None:
+                    while time.perf_counter() < t_end:
+                        ten["offered"] += 1
+                        await drive_one(session, next(next_i), ten=ten)
+
+                await asyncio.gather(
+                    *(
+                        worker()
+                        for _ in range(ten["profile"]["concurrency"])
+                    )
+                )
+
+            await asyncio.gather(
+                *(
+                    tenant_closed_loop(t)
+                    if t["profile"]["arrival"] == "closed"
+                    else tenant_open_loop(t)
+                    for t in tenant_runs
+                )
+            )
+        elif ramp_segments is not None:
+            rng = random.Random(seed)  # reproducible arrival process
             tasks = []
             i = 0
             for seg in seg_stats:
@@ -959,9 +1209,7 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                     )
             await asyncio.gather(*tasks)
         elif request_rate is not None:
-            import random
-
-            rng = random.Random(12345)  # reproducible arrival process
+            rng = random.Random(seed)  # reproducible arrival process
             tasks = []
             for i in range(args.num_prompts):
                 tasks.append(asyncio.create_task(one(session, i)))
@@ -974,22 +1222,27 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
         elapsed = time.perf_counter() - t0
         after, slo_after = await scrape_metrics(session)
 
-    total_requests = (
-        sum(s["offered"] for s in seg_stats)
-        if ramp_segments is not None
-        else args.num_prompts
-    )
+    if tenant_runs:
+        total_requests = sum(t["offered"] for t in tenant_runs)
+    elif ramp_segments is not None:
+        total_requests = sum(s["offered"] for s in seg_stats)
+    else:
+        total_requests = args.num_prompts
     result = {
         "mode": "serve",
         "url": url,
         "num_prompts": total_requests,
         "concurrency": (
             args.concurrency
-            if request_rate is None and ramp_segments is None
+            if request_rate is None
+            and ramp_segments is None
+            and not tenant_runs
             else None
         ),
-        "input_len": args.input_len,
-        "output_len": args.output_len,
+        # Tenant runs carry per-profile length distributions instead of
+        # the global fixed lengths.
+        "input_len": None if tenant_runs else getattr(args, "input_len", None),
+        "output_len": None if tenant_runs else getattr(args, "output_len", None),
         "elapsed_s": round(elapsed, 3),
         "output_tokens_per_s": round(out_tokens / elapsed, 1),
         "requests_per_s": round(total_requests / elapsed, 3),
@@ -1006,6 +1259,45 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
     if request_rate is not None:
         result["offered_rps"] = request_rate
         result["arrival_process"] = "poisson"
+    if request_rate is not None or ramp_segments is not None or tenant_runs:
+        # The stochastic-load modes record their seed so a reported
+        # result names the exact workload that produced it.
+        result["seed"] = seed
+    if tenant_runs:
+        result["arrival_process"] = "multi_tenant"
+        result["tenant_seconds"] = tenant_seconds
+        result["tenants"] = {}
+        for t in tenant_runs:
+            p = t["profile"]
+            entry: dict = {
+                "class": p["slo_class"],
+                "arrival": p["arrival"],
+                "input": list(p["input"]),
+                "output": list(p["output"]),
+                "offered": t["offered"],
+                "completed": t["completed"],
+                "rejected": t["rejected"],
+                "timed_out": t["timed_out"],
+                "errors": t["errors"],
+                "ttft_s": (
+                    _percentiles(t["ttfts"]) if t["ttfts"] else None
+                ),
+                "itl_ms": (
+                    {
+                        k: round(v * 1e3, 3)
+                        for k, v in _percentiles(t["itls"]).items()
+                    }
+                    if t["itls"]
+                    else None
+                ),
+            }
+            if p["arrival"] == "closed":
+                entry["concurrency"] = p["concurrency"]
+            else:
+                entry["rate_rps"] = p["rate"]
+                if p["arrival"] == "bursty":
+                    entry["burst"] = p["burst"]
+            result["tenants"][p["name"]] = entry
     if ramp_segments is not None:
         # Per-segment readout: the rate sweep with each segment's
         # client-side percentiles and shed accounting — what the
@@ -1074,7 +1366,12 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                     4,
                 )
             result["per_class"][cls] = entry
-    if itls and request_rate is None and ramp_segments is None:
+    if (
+        itls
+        and request_rate is None
+        and ramp_segments is None
+        and not tenant_runs
+    ):
         # The dispatch tax as the CLIENT sees it (ISSUE 7): throughput
         # implied by the p50 inter-token pace at this concurrency minus
         # the wall-clock throughput.  ~0 when the driver holds the p50

@@ -524,6 +524,7 @@ async def chat_completions(request: web.Request) -> web.Response:
             num_requests=req.n,
             est_tokens=(len(prompt_ids) if prompt_ids else 0) * req.n,
             prompt_token_ids=prompt_ids,
+            slo_class=params.slo_class,
         )
     except EngineOverloadedError as e:
         return _overloaded_response(e)
@@ -791,6 +792,7 @@ async def completions(request: web.Request) -> web.Response:
             num_requests=len(resolved) * req.n,
             est_tokens=sum(len(ids) for _, ids in resolved) * req.n,
             prompt_token_ids=resolved[0][1],
+            slo_class=params.slo_class,
         )
     except EngineOverloadedError as e:
         return _overloaded_response(e)
@@ -1293,6 +1295,22 @@ async def internal_resume(request: web.Request) -> web.Response:
     if err is not None:
         return err
     _apply_slo_class(request, req, params)
+    # Migrated requests keep their QoS standing (ISSUE 16): the router
+    # journals the original class and sends it top-level, covering the
+    # case where the client set it via header (not in the body we
+    # replay) — the destination replica must bill the same bucket.
+    # Precedence mirrors _apply_slo_class: an explicit body field wins,
+    # then a header, then the journaled class.  (params.slo_class is
+    # already coerced to "default" by to_sampling_params, so the guard
+    # must look at the REQUEST model's field, which is None only when
+    # the body omitted it.)
+    resumed_class = d.get("slo_class")
+    if (
+        resumed_class
+        and req.slo_class is None
+        and not request.headers.get(SLO_CLASS_HEADER)
+    ):
+        params.slo_class = str(resumed_class)
     engine.register_resumable(
         JournalEntry(
             request_id=rid,

@@ -336,6 +336,61 @@ environment_variables: dict[str, Callable[[], Any]] = {
     "VDT_AUTOSCALE_ITL_P99_MS": lambda: float(
         os.environ.get("VDT_AUTOSCALE_ITL_P99_MS", "0")
     ),
+    # --- QoS control plane (ISSUE 16) ---
+    # SLO class registry: one entry per class,
+    # "name:priority[:share[:weight]]", comma-separated (e.g.
+    # "interactive:10:0.5,default:0:0.3,batch:-10:0:2.0").  Priority
+    # orders admission and preemption (higher admits first, preempts
+    # last); share is the class's guaranteed-minimum fraction of the
+    # bounded-admission caps (work-conserving: spare capacity is
+    # borrowable by any class); weight scales the preempt-to-shed
+    # budget.  Empty (the default) disables the QoS control plane
+    # entirely — seed scheduling is bit-identical.
+    "VDT_QOS_CLASSES": lambda: os.environ.get("VDT_QOS_CLASSES", ""),
+    # Chunked-prefill fairness budget: while any decode-bound request
+    # of higher-or-equal class is running, prefill chunks may take at
+    # most this fraction of the per-step token budget, bounding decode
+    # ITL under a long concurrent prefill.  Work-conserving: with no
+    # qualifying decode running, prefill uses the full budget.
+    # 0 = off (the seed policy: prefill fills whatever budget is left).
+    "VDT_QOS_PREFILL_SHARE": lambda: float(
+        os.environ.get("VDT_QOS_PREFILL_SHARE", "0")
+    ),
+    # Router per-class placement: "shared" (seed behaviour — every
+    # class places on every replica), "segregate" (disjoint replica
+    # partition per class, proportional to admission shares), or
+    # "reserve" (co-locate, but lower classes avoid the top class's
+    # headroom replicas while alternatives exist).
+    "VDT_QOS_PLACEMENT": lambda: os.environ.get(
+        "VDT_QOS_PLACEMENT", "shared"
+    ),
+    # Per-class SLO-aware scale-up: grow the fleet when any class's
+    # windowed goodput ratio (from the /router/slo merge) sags below
+    # this floor (0 = trigger off), ignoring windows with fewer than
+    # VDT_AUTOSCALE_GOODPUT_MIN_REQUESTS finished requests.
+    "VDT_AUTOSCALE_GOODPUT_FLOOR": lambda: float(
+        os.environ.get("VDT_AUTOSCALE_GOODPUT_FLOOR", "0")
+    ),
+    "VDT_AUTOSCALE_GOODPUT_MIN_REQUESTS": lambda: int(
+        os.environ.get("VDT_AUTOSCALE_GOODPUT_MIN_REQUESTS", "20")
+    ),
+    # Per-role autoscaling of the disagg prefill pool (ISSUE 15): the
+    # prefill-pool target tracks an EWMA of the long-prompt arrival
+    # rate (prompts at/above VDT_DISAGG_MIN_PROMPT_TOKENS) divided by
+    # the per-replica absorbable rate benched at the crossover.
+    # 0 = off (the pool stays at --fleet-prefill).
+    "VDT_AUTOSCALE_PREFILL_RPS": lambda: float(
+        os.environ.get("VDT_AUTOSCALE_PREFILL_RPS", "0")
+    ),
+    "VDT_AUTOSCALE_PREFILL_EWMA_SECONDS": lambda: float(
+        os.environ.get("VDT_AUTOSCALE_PREFILL_EWMA_SECONDS", "30")
+    ),
+    "VDT_AUTOSCALE_PREFILL_MIN": lambda: int(
+        os.environ.get("VDT_AUTOSCALE_PREFILL_MIN", "0")
+    ),
+    "VDT_AUTOSCALE_PREFILL_MAX": lambda: int(
+        os.environ.get("VDT_AUTOSCALE_PREFILL_MAX", "4")
+    ),
     # --- observability ---
     # SLO targets for goodput accounting (engine/slo.py, ISSUE 12), in
     # milliseconds.  A bare number sets the "default" class; per-class:
@@ -488,6 +543,17 @@ NON_REPLICATED_ENV_VARS = {
     "VDT_AUTOSCALE_DOWN_COOLDOWN_SECONDS",
     "VDT_AUTOSCALE_MAX_REJECT_RATE",
     "VDT_AUTOSCALE_ITL_P99_MS",
+    # QoS (ISSUE 16): placement and the goodput/per-role autoscale
+    # knobs configure the ROUTER's control loops (the class registry
+    # itself, VDT_QOS_CLASSES, and the engine-side fairness budget DO
+    # replicate — every replica must agree on the class table).
+    "VDT_QOS_PLACEMENT",
+    "VDT_AUTOSCALE_GOODPUT_FLOOR",
+    "VDT_AUTOSCALE_GOODPUT_MIN_REQUESTS",
+    "VDT_AUTOSCALE_PREFILL_RPS",
+    "VDT_AUTOSCALE_PREFILL_EWMA_SECONDS",
+    "VDT_AUTOSCALE_PREFILL_MIN",
+    "VDT_AUTOSCALE_PREFILL_MAX",
 }
 
 # Extra vars replicated even though they are not VDT_* (launch.py:70-72).

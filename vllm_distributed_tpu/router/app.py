@@ -45,6 +45,7 @@ from vllm_distributed_tpu.router.metrics import (
     merge_expositions,
 )
 from vllm_distributed_tpu.router.pool import Replica, ReplicaPool
+from vllm_distributed_tpu.router.qos import PrefillDemand, QosRouterPolicy
 from vllm_distributed_tpu.tracing import get_tracer
 from vllm_distributed_tpu.utils import Counter
 from vllm_distributed_tpu.version import __version__
@@ -149,6 +150,15 @@ class RouterState:
         # replica — an all-mixed pool never takes the path.
         self.disagg_min_prompt_tokens = envs.VDT_DISAGG_MIN_PROMPT_TOKENS
         self.disagg_chunk_layers = envs.VDT_DISAGG_CHUNK_LAYERS
+        # QoS placement policy (ISSUE 16): filters the candidate set
+        # per SLO class before the routing policy picks within it.
+        # shared mode (the default) is a passthrough.
+        self.qos = QosRouterPolicy.from_env()
+        # Long-prompt arrival EWMA feeding per-role prefill-pool
+        # autoscaling; shared with the Autoscaler via attach_fleet.
+        self.prefill_demand = PrefillDemand(
+            envs.VDT_AUTOSCALE_PREFILL_EWMA_SECONDS
+        )
         self._rr = 0
         self.session = None  # aiohttp.ClientSession, set on startup
         # Elastic fleet (ISSUE 13): set by attach_fleet() before the
@@ -175,7 +185,11 @@ class RouterState:
 
     # ---- placement ----
     def place(
-        self, keys: list[str], exclude: set[str], pool: str = "serve"
+        self,
+        keys: list[str],
+        exclude: set[str],
+        pool: str = "serve",
+        slo_class: str | None = None,
     ) -> tuple[Replica | None, str]:
         """Pick a replica for a prompt with affinity chain ``keys``.
         Returns (replica, deciding_policy).  Role-aware (ISSUE 15):
@@ -192,6 +206,12 @@ class RouterState:
             non_prefill = [r for r in cands if r.role != "prefill"]
             if non_prefill:
                 cands = non_prefill
+            # QoS placement (ISSUE 16) narrows the serve pool per
+            # class (segregate/reserve); the affinity walk and load
+            # policy below then pick within the class's slice.  The
+            # prefill hop stays unfiltered — that pool is sized by
+            # phase, not by class.
+            cands = self.qos.filter(cands, slo_class)
         if not cands:
             return None, "none"
         if self.policy == "round_robin":
@@ -291,8 +311,27 @@ async def _proxy(request: web.Request, kind: str) -> web.StreamResponse:
         return _error(f"invalid request: {e}")
     request_id = f"rtr-{next(state.request_counter)}"
     journal = RouterJournal(request_id, kind, body)
+    # Effective SLO class, body field over header (the same precedence
+    # the replica applies): drives per-class placement here and rides
+    # every migration/hand-off so the request keeps its QoS standing.
+    slo_class = body.get("slo_class") or request.headers.get(
+        SLO_CLASS_HEADER
+    )
+    if slo_class:
+        journal.slo_class = str(slo_class)
     text, ids = journal.affinity_source()
     keys = state.index.keys_for(text, ids)
+    # Long-prompt arrivals feed the prefill-pool demand EWMA (ISSUE
+    # 16) whether or not the hand-off engages this time — demand is a
+    # property of the workload, not of current pool membership.
+    from vllm_distributed_tpu.router import disagg as _disagg
+
+    if (
+        state.disagg_min_prompt_tokens > 0
+        and _disagg.estimate_prompt_tokens(journal)
+        >= state.disagg_min_prompt_tokens
+    ):
+        state.prefill_demand.observe()
     tracer = get_tracer()
     with tracer.span(
         "router.request",
@@ -338,8 +377,9 @@ def _place_or_none(
     exclude: set[str],
     span,
     pool: str = "serve",
+    slo_class: str | None = None,
 ) -> Replica | None:
-    replica, how = state.place(keys, exclude, pool)
+    replica, how = state.place(keys, exclude, pool, slo_class)
     if replica is not None:
         state.metrics.record_placement(how)
         get_tracer().event(
@@ -363,7 +403,9 @@ async def _proxy_unary(
     exclude: set[str] = set()
     last_429: tuple[bytes, int, dict] | None = None
     while True:
-        replica = _place_or_none(state, keys, exclude, span)
+        replica = _place_or_none(
+            state, keys, exclude, span, slo_class=journal.slo_class
+        )
         if replica is None:
             if last_429 is not None:
                 raw, status, headers = last_429
@@ -484,6 +526,7 @@ async def _proxy_stream(
             exclude,
             span,
             pool="prefill" if plan is not None else "serve",
+            slo_class=journal.slo_class,
         )
         if replica is None and plan is not None:
             # Prefill pool gone (excluded/backed off mid-loop): give up
@@ -640,7 +683,9 @@ async def _migrate_loop(
                 )
             )
             return False
-        target = _place_or_none(state, keys, exclude, span)
+        target = _place_or_none(
+            state, keys, exclude, span, slo_class=journal.slo_class
+        )
         if target is None:
             # Every candidate may just be in Retry-After backoff (busy,
             # not dead): wait out the earliest expiry (capped) and look
@@ -648,7 +693,9 @@ async def _migrate_loop(
             delay = _soonest_backoff_expiry(state, exclude)
             if delay is not None:
                 await asyncio.sleep(delay)
-                target = _place_or_none(state, keys, exclude, span)
+                target = _place_or_none(
+                    state, keys, exclude, span, slo_class=journal.slo_class
+                )
         if target is None:
             await write(
                 json.dumps(

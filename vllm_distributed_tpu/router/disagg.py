@@ -292,7 +292,9 @@ async def forward_prefill_handoff(
         exclude.add(prefill.url)
 
     # ---- pick the decode-side continuation target ----
-    target = _place_or_none(state, keys, exclude, span)
+    target = _place_or_none(
+        state, keys, exclude, span, slo_class=journal.slo_class
+    )
     if target is None:
         await write(
             json.dumps(

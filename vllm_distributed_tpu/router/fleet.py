@@ -31,6 +31,7 @@ Everything here is default-off: a router started with static
 from __future__ import annotations
 
 import asyncio
+import math
 import os
 import shlex
 import subprocess
@@ -286,6 +287,31 @@ class ReplicaManager:
         if reason == "manual":
             self.exhausted = False
         return self.target
+
+    def scale_role_to(self, role: str, n: int, reason: str = "manual") -> int:
+        """Set one disagg role's target (ISSUE 16 per-role autoscaling);
+        the reconcile loop converges just like the mixed target — one
+        spawn per tick up, drain-then-terminate down."""
+        if role not in ("prefill", "decode"):
+            raise ValueError(f"unknown disagg role {role!r}")
+        n = max(int(n), 0)
+        current = self.role_targets.get(role, 0)
+        if n != current:
+            direction = "up" if n > current else "down"
+            self.record_event(
+                "scale_role",
+                role=role,
+                from_target=current,
+                to=n,
+                reason=reason,
+            )
+            if self.metrics is not None:
+                self.metrics.record_scale(direction, reason)
+            logger.info(
+                "fleet %s target %d -> %d (%s)", role, current, n, reason
+            )
+        self.role_targets[role] = n
+        return n
 
     # ---- lifecycle ----
     def start(self, session) -> None:
@@ -689,6 +715,11 @@ class FleetSignals:
     running: float = 0.0  # summed vllm:num_requests_running
     reject_rate: float = 0.0  # router 429s per second since last tick
     itl_p99_ms: float | None = None  # fleet merge (None = not sampled)
+    # Worst SLO class whose windowed goodput ratio sags below the
+    # floor (ISSUE 16; None = trigger off or everyone attaining).
+    goodput_sag: str | None = None
+    # EWMA long-prompt arrival rate, req/s (per-role prefill sizing).
+    prefill_rate: float = 0.0
 
     @property
     def waiting_per_replica(self) -> float:
@@ -706,6 +737,17 @@ class AutoscalerConfig:
     down_cooldown: float = 60.0
     max_reject_rate: float = 0.0  # 0 = trigger off
     itl_p99_ms: float = 0.0  # 0 = trigger off
+    # Per-class goodput trigger (ISSUE 16): scale up when any class's
+    # windowed goodput ratio drops below the floor.  0 = off.
+    goodput_floor: float = 0.0
+    goodput_min_requests: int = 20
+    # Per-role prefill-pool sizing (ISSUE 16): target =
+    # ceil(long-prompt EWMA rate / prefill_rps), clamped to
+    # [prefill_min, prefill_max].  prefill_rps is the benched per-
+    # replica crossover throughput; 0 = off (static --fleet-prefill).
+    prefill_rps: float = 0.0
+    prefill_min: int = 0
+    prefill_max: int = 4
 
     @classmethod
     def from_env(cls) -> "AutoscalerConfig":
@@ -719,6 +761,11 @@ class AutoscalerConfig:
             down_cooldown=envs.VDT_AUTOSCALE_DOWN_COOLDOWN_SECONDS,
             max_reject_rate=envs.VDT_AUTOSCALE_MAX_REJECT_RATE,
             itl_p99_ms=envs.VDT_AUTOSCALE_ITL_P99_MS,
+            goodput_floor=envs.VDT_AUTOSCALE_GOODPUT_FLOOR,
+            goodput_min_requests=envs.VDT_AUTOSCALE_GOODPUT_MIN_REQUESTS,
+            prefill_rps=envs.VDT_AUTOSCALE_PREFILL_RPS,
+            prefill_min=envs.VDT_AUTOSCALE_PREFILL_MIN,
+            prefill_max=envs.VDT_AUTOSCALE_PREFILL_MAX,
         )
 
     def __post_init__(self) -> None:
@@ -763,15 +810,23 @@ def decide(
         and signals.itl_p99_ms is not None
         and signals.itl_p99_ms > cfg.itl_p99_ms
     )
+    goodput_hot = (
+        cfg.goodput_floor > 0 and signals.goodput_sag is not None
+    )
     queue_hot = signals.waiting_per_replica > cfg.up_waiting
-    if queue_hot or reject_hot or itl_hot:
+    if queue_hot or reject_hot or itl_hot or goodput_hot:
         if target >= cfg.max_replicas or now - last_up < cfg.up_cooldown:
             return target, None
-        reason = (
-            "queue_depth"
-            if queue_hot
-            else ("reject_rate" if reject_hot else "itl_p99")
-        )
+        if queue_hot:
+            reason = "queue_depth"
+        elif reject_hot:
+            reason = "reject_rate"
+        elif itl_hot:
+            reason = "itl_p99"
+        else:
+            # Class name is registry-bounded (MAX_CLASSES), so the
+            # reason string space stays small.
+            reason = f"goodput:{signals.goodput_sag}"
         return target + 1, reason
     if (
         signals.waiting_per_replica < cfg.down_waiting
@@ -797,12 +852,19 @@ class Autoscaler:
         cfg: AutoscalerConfig | None = None,
         *,
         slo_probe=None,  # async () -> classes dict (app._fleet_slo)
+        prefill_demand=None,  # router_qos.PrefillDemand (shared w/ app)
     ) -> None:
+        from vllm_distributed_tpu.router.qos import GoodputTracker
+
         self.manager = manager
         self.pool = pool
         self.metrics = metrics
         self.cfg = cfg or AutoscalerConfig.from_env()
         self.slo_probe = slo_probe
+        self.prefill_demand = prefill_demand
+        self.goodput = GoodputTracker(
+            self.cfg.goodput_floor, self.cfg.goodput_min_requests
+        )
         self.last_up = -float("inf")
         self.last_down = -float("inf")
         self.decisions: deque[dict] = deque(maxlen=128)
@@ -833,7 +895,9 @@ class Autoscaler:
         self._last_rejects = rejects
         self._last_tick_mono = now
         itl = None
-        if self.cfg.itl_p99_ms > 0 and self.slo_probe is not None:
+        goodput_sag = None
+        slo_armed = self.cfg.itl_p99_ms > 0 or self.cfg.goodput_floor > 0
+        if slo_armed and self.slo_probe is not None:
             try:
                 classes = await asyncio.wait_for(
                     self.slo_probe(), timeout=20
@@ -845,16 +909,23 @@ class Autoscaler:
                 ]
                 if p99s:
                     itl = max(p99s)
+                if self.cfg.goodput_floor > 0:
+                    goodput_sag = self.goodput.update(classes or {})
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # noqa: BLE001 — the SLO trigger degrades to queue-depth-only
                 logger.debug("autoscaler SLO probe failed: %s", e)
+        prefill_rate = 0.0
+        if self.cfg.prefill_rps > 0 and self.prefill_demand is not None:
+            prefill_rate = self.prefill_demand.sample(now)
         return FleetSignals(
             routable=len(routable),
             waiting=sum(r.waiting for r in routable),
             running=sum(r.running for r in routable),
             reject_rate=rate,
             itl_p99_ms=itl,
+            goodput_sag=goodput_sag,
+            prefill_rate=prefill_rate,
         )
 
     # ---- one tick (also driven directly by tests) ----
@@ -888,7 +959,37 @@ class Autoscaler:
                 }
             )
             self.manager.scale_to(new_target, reason=f"autoscale:{reason}")
+        self._tick_prefill(signals, now)
         return new_target, reason
+
+    def _tick_prefill(self, signals: FleetSignals, now: float) -> None:
+        """Per-role prefill-pool sizing (ISSUE 16): track the EWMA
+        long-prompt arrival rate against the benched per-replica
+        crossover.  Deliberately simpler than ``decide`` — the EWMA is
+        its own damping, and the manager's one-spawn-per-tick /
+        drain-then-retire reconcile absorbs step changes, so admitted
+        work never drops through a resize."""
+        cfg = self.cfg
+        if cfg.prefill_rps <= 0 or self.prefill_demand is None:
+            return
+        want = math.ceil(signals.prefill_rate / cfg.prefill_rps)
+        want = min(max(want, cfg.prefill_min), cfg.prefill_max)
+        current = self.manager.role_targets.get("prefill", 0)
+        if want == current:
+            return
+        self.decisions.append(
+            {
+                "mono": round(now, 3),
+                "role": "prefill",
+                "from": current,
+                "to": want,
+                "reason": "prefill_demand",
+                "prefill_rate": round(signals.prefill_rate, 3),
+            }
+        )
+        self.manager.scale_role_to(
+            "prefill", want, reason="autoscale:prefill_demand"
+        )
 
     # ---- loop plumbing ----
     def start(self) -> None:
@@ -935,6 +1036,20 @@ class Autoscaler:
                 "down_cooldown": self.cfg.down_cooldown,
                 "max_reject_rate": self.cfg.max_reject_rate,
                 "itl_p99_ms": self.cfg.itl_p99_ms,
+                "goodput_floor": self.cfg.goodput_floor,
+                "goodput_min_requests": self.cfg.goodput_min_requests,
+                "prefill_rps": self.cfg.prefill_rps,
+                "prefill_min": self.cfg.prefill_min,
+                "prefill_max": self.cfg.prefill_max,
             },
+            "goodput_window": {
+                cls: {"requests": r, "goodput": g}
+                for cls, (r, g) in self.goodput.window.items()
+            },
+            "prefill_rate": (
+                round(self.prefill_demand.rate, 3)
+                if self.prefill_demand is not None
+                else None
+            ),
             "decisions": list(self.decisions),
         }

@@ -120,6 +120,11 @@ class RouterJournal:
         self.model: str | None = None
         self.migrations = 0
         self.served_by: str | None = None  # replica_id of current server
+        # Effective SLO class (body field or X-VDT-SLO-Class header,
+        # body wins — mirroring the replica's _apply_slo_class).  Rides
+        # every resume/hand-off so a migrated request keeps its QoS
+        # standing and its SLO accounting bucket (ISSUE 16).
+        self.slo_class: str | None = None
 
     # ---- affinity ----
     def affinity_source(self) -> tuple[str | None, list[int] | None]:
@@ -173,4 +178,5 @@ class RouterJournal:
             "prompt": choice.prompt,
             "prompt_token_ids": choice.prompt_token_ids,
             "emitted_token_ids": list(choice.emitted_token_ids),
+            "slo_class": self.slo_class,
         }

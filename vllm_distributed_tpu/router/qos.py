@@ -1,0 +1,216 @@
+"""Router-side QoS: per-class placement, goodput autoscale signal,
+prefill-demand tracking (ISSUE 16).
+
+The engine half (engine/qos.py) orders work *within* one replica; this
+module orders work *across* the fleet:
+
+- :class:`QosRouterPolicy` restricts which replicas a class may land
+  on, composed in front of the PR 14 affinity walk (the policy filters
+  the candidate set, affinity/least-loaded picks within it);
+- :class:`GoodputTracker` turns the cumulative per-class counters of
+  the ``/router/slo`` fleet merge into windowed goodput ratios and
+  reports the worst class sagging below ``VDT_AUTOSCALE_GOODPUT_FLOOR``
+  — DistServe's argument (Zhong et al. 2024) that scaling should chase
+  goodput, not queue depth;
+- :class:`PrefillDemand` keeps an EWMA of long-prompt arrival rate so
+  the autoscaler can size the PR 15 disaggregated prefill pool to its
+  phase (Splitwise, Patel et al. 2024) instead of a static count.
+
+Everything is default-off: ``VDT_QOS_PLACEMENT=shared`` and an empty
+class registry make ``filter`` a passthrough, and a zero goodput floor
+/ prefill rate disable the autoscale signals.
+"""
+
+from __future__ import annotations
+
+import math
+
+from vllm_distributed_tpu.engine.qos import QosRegistry
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+PLACEMENT_MODES = ("shared", "segregate", "reserve")
+
+
+class QosRouterPolicy:
+    """Per-class replica placement.
+
+    Modes (``VDT_QOS_PLACEMENT``):
+
+    - ``shared``: no restriction — every class sees every replica
+      (seed behaviour).
+    - ``segregate``: replicas are deterministically partitioned into
+      disjoint per-class sets, sized by admission share (zero-share
+      classes split the leftover).  A batch burst cannot queue behind
+      interactive traffic at all, at the cost of per-class capacity.
+    - ``reserve``: the highest-priority class may use every replica;
+      lower classes avoid its reserved headroom slice (the tail of the
+      replica list, ``ceil(top_share * n)`` replicas) while any
+      alternative exists.  Work-conserving flavour of segregation.
+
+    Both restricted modes fall back to the full candidate set whenever
+    the restriction would leave a class with zero routable replicas —
+    placement never fails closed just because the fleet shrank.
+    """
+
+    def __init__(
+        self, registry: QosRegistry, placement: str = "shared"
+    ) -> None:
+        if placement not in PLACEMENT_MODES:
+            raise ValueError(
+                f"VDT_QOS_PLACEMENT {placement!r} is not one of "
+                f"{PLACEMENT_MODES}"
+            )
+        self.registry = registry
+        self.placement = placement
+
+    @classmethod
+    def from_env(cls) -> QosRouterPolicy:
+        from vllm_distributed_tpu import envs
+
+        return cls(
+            QosRegistry.parse(envs.VDT_QOS_CLASSES),
+            envs.VDT_QOS_PLACEMENT,
+        )
+
+    @property
+    def active(self) -> bool:
+        return self.registry.enabled and self.placement != "shared"
+
+    def filter(self, replicas: list, slo_class: str | None) -> list:
+        """Restrict ``replicas`` (routable candidates) for a class.
+        Returns the input list object untouched when inactive."""
+        if not self.active or len(replicas) <= 1:
+            return replicas
+        name = self.registry.resolve(slo_class).name
+        ordered = sorted(replicas, key=lambda r: r.replica_id)
+        if self.placement == "segregate":
+            subset = self._segregate(ordered).get(name)
+        else:
+            subset = self._reserve(ordered, name)
+        if not subset:
+            return replicas
+        return subset
+
+    def _segregate(self, ordered: list) -> dict[str, list]:
+        """Disjoint per-class slices of the replica-id-sorted list,
+        sized by admission share via largest remainder.  Deterministic
+        in fleet membership, so every router instance agrees."""
+        names = self.registry.class_names()
+        n = len(ordered)
+        shares = [
+            self.registry.classes[c].admission_share for c in names
+        ]
+        configured = sum(shares)
+        zeros = sum(1 for s in shares if s <= 0.0)
+        leftover = max(1.0 - configured, 0.0)
+        weights = [
+            s if s > 0.0 else (leftover / zeros if zeros else 0.0)
+            for s in shares
+        ]
+        total = sum(weights) or 1.0
+        quotas = [w / total * n for w in weights]
+        counts = [int(q) for q in quotas]
+        # Largest remainder, ties to higher priority (list order).
+        for i in sorted(
+            range(len(names)),
+            key=lambda i: (quotas[i] - counts[i], -i),
+            reverse=True,
+        ):
+            if sum(counts) >= n:
+                break
+            counts[i] += 1
+        out: dict[str, list] = {}
+        start = 0
+        for name, count in zip(names, counts):
+            out[name] = ordered[start : start + count]
+            start += count
+        return out
+
+    def _reserve(self, ordered: list, name: str) -> list:
+        names = self.registry.class_names()
+        top = names[0]
+        if name == top:
+            return ordered
+        share = self.registry.classes[top].admission_share
+        headroom = math.ceil(share * len(ordered)) if share > 0.0 else 0
+        open_set = ordered[: len(ordered) - headroom]
+        return open_set if open_set else ordered
+
+
+class GoodputTracker:
+    """Windowed per-class goodput from cumulative ``/router/slo``
+    counters.  ``update`` takes the fleet-merged class map, diffs it
+    against the previous scrape, and returns the name of the worst
+    class whose windowed goodput ratio sags below the floor (with at
+    least ``min_requests`` finished in the window, so one unlucky
+    request can't trigger a scale-up)."""
+
+    def __init__(self, floor: float, min_requests: int) -> None:
+        self.floor = floor
+        self.min_requests = max(min_requests, 1)
+        self._last: dict[str, tuple[int, int]] = {}
+        # Last window's (d_requests, d_goodput) per class, for
+        # /router/fleet introspection.
+        self.window: dict[str, tuple[int, int]] = {}
+
+    def update(self, classes: dict) -> str | None:
+        worst: str | None = None
+        worst_ratio = 0.0
+        window: dict[str, tuple[int, int]] = {}
+        for name, view in (classes or {}).items():
+            requests = int(view.get("requests", 0))
+            goodput = int(view.get("goodput", 0))
+            prev_r, prev_g = self._last.get(name, (0, 0))
+            d_r, d_g = requests - prev_r, goodput - prev_g
+            if d_r < 0:
+                # Cumulative counters went backwards: a replica left
+                # the merge (restart/scale-down).  Restart the window.
+                d_r, d_g = requests, goodput
+            self._last[name] = (requests, goodput)
+            window[name] = (d_r, d_g)
+            if self.floor <= 0.0 or d_r < self.min_requests:
+                continue
+            ratio = d_g / d_r
+            if ratio < self.floor and (
+                worst is None or ratio < worst_ratio
+            ):
+                worst, worst_ratio = name, ratio
+        self.window = window
+        return worst
+
+
+class PrefillDemand:
+    """EWMA of long-prompt arrival rate (requests/s).
+
+    The router calls :meth:`observe` on every request whose estimated
+    prompt length crosses the disagg hand-off threshold; the autoscaler
+    calls :meth:`sample` once per tick, which folds the interval's
+    instantaneous rate into an exponentially-weighted average with a
+    time-constant of ``ewma_seconds`` (irregular tick spacing handled
+    via ``alpha = 1 - exp(-dt/tau)``)."""
+
+    def __init__(self, ewma_seconds: float = 30.0) -> None:
+        self.tau = max(ewma_seconds, 1e-6)
+        self.rate = 0.0
+        self._count = 0
+        self._last_t: float | None = None
+
+    def observe(self, n: int = 1) -> None:
+        self._count += n
+
+    def sample(self, now: float) -> float:
+        if self._last_t is None:
+            self._last_t = now
+            self._count = 0
+            return self.rate
+        dt = now - self._last_t
+        if dt <= 0.0:
+            return self.rate
+        inst = self._count / dt
+        self._count = 0
+        self._last_t = now
+        alpha = 1.0 - math.exp(-dt / self.tau)
+        self.rate += alpha * (inst - self.rate)
+        return self.rate
