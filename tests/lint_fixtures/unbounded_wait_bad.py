@@ -54,3 +54,17 @@ async def kv_handoff_transfer(session, decode_url):
     resp = await session.post(decode_url, json={"op": "chunk"})
     body = await resp.read()  # EXPECT
     return body
+
+
+def wal_rotate_barrier(fsync_done, pending_records):
+    # The ISSUE 17 WAL pattern gone wrong: segment rotation blocking on
+    # an unbounded flusher handshake parks the router control plane
+    # (and every checkpoint behind it) on one stuck fsync.
+    fsync_done.wait()  # EXPECT
+    return pending_records.get()  # EXPECT
+
+
+async def wal_replay_gather(segments):
+    # ...and the recovery replay awaiting every segment read with no
+    # deadline: one unreadable segment wedges router startup forever.
+    await asyncio.gather(*segments)  # EXPECT

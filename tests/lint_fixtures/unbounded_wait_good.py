@@ -87,3 +87,25 @@ async def kv_handoff_transfer(executor, session, pages, decode_url):
     resp = await session.post(decode_url, json={"op": "chunk"})
     body = await asyncio.wait_for(resp.read(), timeout=30)
     return chunk, body
+
+
+def wal_rotate_barrier(fsync_done, pending_records, stop):
+    # The ISSUE 17 WAL pattern done right: the rotation handshake and
+    # the record drain both poll with a deadline and re-check the stop
+    # flag, so one stuck fsync degrades a checkpoint instead of
+    # wedging the router control plane.
+    import queue
+
+    while not stop.is_set():
+        if fsync_done.wait(timeout=0.5):
+            break
+    try:
+        return pending_records.get(timeout=0.5)
+    except queue.Empty:
+        return None
+
+
+async def wal_replay_gather(segments):
+    # ...and the recovery replay bounded end to end: one unreadable
+    # segment fails startup loudly instead of wedging it forever.
+    await asyncio.wait_for(asyncio.gather(*segments), timeout=30)

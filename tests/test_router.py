@@ -487,6 +487,75 @@ def test_internal_resume_bit_identical(model_dir, monkeypatch):
         engine.shutdown()
 
 
+def test_internal_resume_duplicate_takeover(model_dir, monkeypatch):
+    """/internal/resume is idempotent per request id (ISSUE 17): a
+    router that crashed mid-hand-off replays the SAME id after restart
+    without knowing whether the first POST landed.  The replay must win
+    cleanly — the original handler is torn down, the replay streams the
+    full bit-identical continuation, and the engine never wedges on a
+    double-registered id."""
+    monkeypatch.setenv("VDT_MOCK_TOKEN_SEQ", "1")
+    monkeypatch.setenv("VDT_MOCK_EXECUTE_SLEEP_SECONDS", "0.05")
+    engine = _mk_engine(model_dir)
+    state = init_app_state(engine, served_model_name="resume")
+    body = {
+        "prompt": [1, 2, 3],
+        "max_tokens": 8,
+        "temperature": 0.0,
+        "ignore_eos": True,
+        "stream": True,
+    }
+    expected = list(range(3, 11))
+    payload = {
+        "request_id": "mig-dup",
+        "kind": "completions",
+        "body": body,
+        "prompt_token_ids": [1, 2, 3],
+        "emitted_token_ids": expected[:2],
+    }
+
+    async def go():
+        server = TestServer(build_app(state))
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            r1 = await client.post("/internal/resume", json=payload)
+            assert r1.status == 200
+            # Read up to the first data frame so the original handler
+            # is demonstrably live mid-stream when the replay lands.
+            saw_frame = False
+            async for raw in r1.content:
+                if raw.strip().startswith(b"data:"):
+                    saw_frame = True
+                    break
+            assert saw_frame
+            # The replay: same id, same journal checkpoint.  Must not
+            # hang or 409 — it takes over and delivers the whole
+            # continuation from the checkpoint.
+            r2 = await asyncio.wait_for(
+                client.post("/internal/resume", json=payload), timeout=30
+            )
+            assert r2.status == 200
+            frames = _sse_chunks(
+                await asyncio.wait_for(r2.text(), timeout=30)
+            )
+            new_ids = [
+                t for f in frames for t in f.get("token_ids") or ()
+            ]
+            assert new_ids == expected[2:]
+            assert frames[-1]["finish_reason"] == "length"
+            r1.close()
+            # No takeover bookkeeping leaks once the winner finishes.
+            assert state.resume_takeovers == {}
+        finally:
+            await client.close()
+
+    try:
+        _run(go())
+    finally:
+        engine.shutdown()
+
+
 def test_trace_header_parents_replica_span(model_dir, monkeypatch):
     """PR 4 trace context through the router hop: a request arriving
     with X-VDT-Trace-Id '<trace>-<span>' parents the replica's

@@ -268,6 +268,28 @@ def test_vdt003_scope_covers_qos_modules(tmp_path):
     assert len(hits) == 2 * N_UNBOUNDED
 
 
+def test_vdt003_scope_covers_router_persist(tmp_path):
+    """ISSUE 17: the router WAL (router/persist.py) sits inside the
+    deadline discipline via the router/ scope — its fsync/rotation
+    waits are control-plane waits — and the shipped module itself is
+    clean (no baseline entry hides a wedging wait)."""
+    text = (FIXTURES / "unbounded_wait_bad.py").read_text()
+    pkg = tmp_path / "pkg"
+    dest = pkg / "router" / "persist.py"
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    dest.write_text(text)
+    report = run_lint([pkg], baseline=None)
+    hits = [f for f in report.new if f.rule == "unbounded-wait"]
+    assert len(hits) == N_UNBOUNDED
+    assert all(f.path.endswith("router/persist.py") for f in hits)
+    # The real module passes the gate with no baseline at all: the WAL
+    # never bought itself a waiver.
+    real = run_lint(
+        [PACKAGE_ROOT / "router" / "persist.py"], baseline=None
+    )
+    assert [f for f in real.new if f.rule == "unbounded-wait"] == []
+
+
 # ---- CLI ----
 def _run_cli(*argv: str):
     return subprocess.run(

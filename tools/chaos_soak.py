@@ -1638,6 +1638,411 @@ def run_fleet_ramp(
 
 
 # ---------------------------------------------------------------------
+# Router-kill chaos (ISSUE 17): SIGKILL the ROUTER ITSELF — mid-stream
+# and mid-scale-up, with durable state on — then restart it against the
+# same state dir and assert the restarted incarnation re-adopts every
+# recorded child (zero leaked, zero double-spawned processes) and
+# finishes every admitted in-flight stream bit-identically through the
+# X-VDT-Resume-Id / X-VDT-Resume-Tokens reconnect protocol.
+# ---------------------------------------------------------------------
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(int(pid), 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def run_router_kill(
+    *,
+    cycles: int = 1,
+    fleet_size: int = 2,
+    scale_to: int = 3,
+    streams: int = 3,
+    max_tokens: int = 48,
+    kill_after_tokens: int = 4,
+    token_sleep_s: float = 0.2,
+) -> dict:
+    """Run the router-kill chaos cycle(s); returns the report dict.
+
+    Unlike the other phases the router here is a REAL subprocess
+    (``python -m vllm_distributed_tpu.entrypoints.cli router``) so it
+    can be SIGKILLed like a crashed process, with ``--state-dir``
+    pointed at a WAL this harness also reads back directly
+    (``router.persist.load_state``) to check what the dead incarnation
+    managed to record.  Children are ``tests.mock_replica`` processes
+    spawned BY the router through ``--fleet-cmd``; they live in their
+    own sessions, so the router SIGKILL orphans them — exactly the
+    re-adoption scenario."""
+    import asyncio
+    import signal
+    import subprocess
+
+    from vllm_distributed_tpu.router.persist import load_state
+    from vllm_distributed_tpu.testing import write_llama_config
+    from vllm_distributed_tpu.utils import get_open_port
+
+    tmpdir = tempfile.mkdtemp(prefix="vdt_router_kill_")
+    model_dir = write_llama_config(os.path.join(tmpdir, "m"))
+    state_dir = os.path.join(tmpdir, "router-state")
+    prompt = [1, 2, 3]
+    expected = list(range(len(prompt), len(prompt) + max_tokens))
+    router_port = get_open_port()
+    router_url = f"http://127.0.0.1:{router_port}"
+
+    env = {
+        **os.environ,
+        **ROUTER_AGENT_ENV,
+        # Slow token cadence: streams must still be mid-flight after
+        # the scale POST when the SIGKILL lands.
+        "VDT_MOCK_EXECUTE_SLEEP_SECONDS": str(token_sleep_s),
+        # Near-line-rate WAL freshness, and a verify window generous
+        # enough for a child that was still BOOTING when the router
+        # died (the mid-scale-up spawn) to come up and answer.
+        "VDT_ROUTER_STATE_CKPT_INTERVAL_SECONDS": "0.05",
+        "VDT_ROUTER_STATE_FSYNC_INTERVAL_SECONDS": "0.05",
+        "VDT_ROUTER_STATE_VERIFY_WINDOW_SECONDS": "60",
+        # Decouple the journal TTL from the adoption poll bound: a slow
+        # adoption must surface as adoption_complete=false, not cascade
+        # into expired-journal replay refusals (lost work).
+        "VDT_ROUTER_STATE_RECOVERY_TTL_SECONDS": "600",
+        "PYTHONPATH": _REPO_ROOT
+        + os.pathsep
+        + os.environ.get("PYTHONPATH", ""),
+    }
+    argv = [
+        sys.executable,
+        "-m",
+        "vllm_distributed_tpu.entrypoints.cli",
+        "router",
+        "--host",
+        "127.0.0.1",
+        "--port",
+        str(router_port),
+        "--fleet-size",
+        str(fleet_size),
+        "--fleet-cmd",
+        f"{sys.executable} -m tests.mock_replica --port {{port}} "
+        f"--model-dir {model_dir}",
+        "--state-dir",
+        state_dir,
+        "--health-interval",
+        "0.25",
+    ]
+
+    stats = {
+        "offered": 0,
+        "admitted": 0,
+        "completed": 0,
+        "resumed": 0,
+        "interrupted": 0,
+        "mismatches": 0,
+        "lost": 0,
+    }
+
+    def spawn_router() -> "subprocess.Popen":
+        return subprocess.Popen(argv, env=env, cwd=_REPO_ROOT)  # vdt-lint: disable=thread-leak — waited on every kill/teardown path below
+
+    async def go() -> dict:
+        import aiohttp
+
+        async def fleet_snap(session) -> dict | None:
+            try:
+                async with session.get(
+                    f"{router_url}/router/fleet",
+                    timeout=aiohttp.ClientTimeout(total=5),
+                ) as r:
+                    if r.status != 200:
+                        return None
+                    return await r.json()
+            except Exception:  # noqa: BLE001 — router (re)booting
+                return None
+
+        async def wait_ready(session, want: int, bound_s: float) -> dict:
+            deadline = time.monotonic() + bound_s
+            while time.monotonic() < deadline:
+                snap = await fleet_snap(session)
+                if snap is not None and snap["ready"] >= want:
+                    return snap
+                await asyncio.sleep(0.2)
+            raise RuntimeError(
+                f"fleet never reached {want} ready replica(s)"
+            )
+
+        async def one_stream(session, rec: dict) -> None:
+            body = {
+                "prompt": list(prompt),
+                "max_tokens": max_tokens,
+                "temperature": 0.0,
+                "ignore_eos": True,
+                "stream": True,
+            }
+            headers = {"X-VDT-Router": "1"}
+            if rec.get("rid"):
+                # Reconnect after the router kill: echo the request id
+                # back and declare exactly what we already hold so the
+                # journal rewinds/fast-forwards to OUR position.
+                headers["X-VDT-Resume-Id"] = rec["rid"]
+                headers["X-VDT-Resume-Tokens"] = (
+                    f"{len(rec['toks'])}:{len(rec['text'])}"
+                )
+            try:
+                async with session.post(
+                    f"{router_url}/v1/completions",
+                    json=body,
+                    headers=headers,
+                    timeout=aiohttp.ClientTimeout(
+                        total=None, sock_read=120
+                    ),
+                ) as resp:
+                    if resp.status != 200:
+                        rec["status"] = resp.status
+                        return
+                    rid = resp.headers.get("X-VDT-Request-Id")
+                    if rid:
+                        rec["rid"] = rid
+                    async for raw in resp.content:
+                        line = raw.decode().strip()
+                        if not line.startswith("data:"):
+                            continue
+                        payload = line[5:].strip()
+                        if payload == "[DONE]":
+                            rec["done"] = True
+                            break
+                        obj = json.loads(payload)
+                        if "error" in obj and not obj.get("choices"):
+                            rec["router_error"] = obj["error"]
+                            break
+                        for ch in obj.get("choices") or ():
+                            rec["toks"] += ch.get("vdt_token_ids") or []
+                            rec["text"] += ch.get("text") or ""
+            except Exception as e:  # noqa: BLE001 — the router SIGKILL severs streams by design
+                rec["conn_error"] = str(e)
+
+        per_cycle: list[dict] = []
+        all_pids: set[int] = set()
+        proc = spawn_router()
+        try:
+            async with aiohttp.ClientSession() as session:
+                await wait_ready(session, fleet_size, 180)
+                for cyc in range(cycles):
+                    crep: dict = {"cycle": cyc}
+                    recs = [
+                        {"toks": [], "text": "", "done": False}
+                        for _ in range(streams)
+                    ]
+                    stats["offered"] += streams
+                    tasks = [
+                        asyncio.ensure_future(one_stream(session, r))
+                        for r in recs
+                    ]
+                    # Let every stream get admitted and mid-flight.
+                    deadline = time.monotonic() + 60
+                    while time.monotonic() < deadline:
+                        if all(
+                            r.get("rid")
+                            and len(r["toks"]) >= kill_after_tokens
+                            for r in recs
+                        ) or all(t.done() for t in tasks):
+                            break
+                        await asyncio.sleep(0.05)
+                    # Kick off a scale-up and catch it mid-warmup.
+                    try:
+                        async with session.post(
+                            f"{router_url}/router/scale",
+                            json={"replicas": scale_to},
+                            timeout=aiohttp.ClientTimeout(total=5),
+                        ) as r:
+                            crep["scale_ack"] = r.status == 200
+                    except Exception:  # noqa: BLE001 — judged via killed_mid_scale_up below
+                        crep["scale_ack"] = False
+                    mid_scale = False
+                    sdl = time.monotonic() + 5
+                    while time.monotonic() < sdl:
+                        snap = await fleet_snap(session)
+                        if snap is None:
+                            break
+                        states = [
+                            x["state"] for x in snap["replicas"]
+                        ]
+                        if (
+                            len(snap["replicas"]) > fleet_size
+                            or "starting" in states
+                        ):
+                            mid_scale = True
+                            break
+                        await asyncio.sleep(0.05)
+                    crep["killed_mid_scale_up"] = mid_scale
+                    # The crash: SIGKILL, no goodbyes.
+                    proc.kill()
+                    proc.wait()
+                    await asyncio.gather(*tasks, return_exceptions=True)
+                    interrupted = [
+                        r for r in recs if r.get("rid") and not r["done"]
+                    ]
+                    stats["interrupted"] += len(interrupted)
+                    # Read the dead incarnation's WAL directly: the
+                    # children must be recorded AND still alive, and
+                    # every severed stream's journal must be there.
+                    recovered = load_state(state_dir)
+                    wal_pids = {
+                        int(v["pid"])
+                        for v in recovered.replicas.values()
+                        if v.get("pid")
+                    }
+                    all_pids |= wal_pids
+                    crep["wal_replicas"] = len(recovered.replicas)
+                    crep["children_survived_kill"] = bool(
+                        wal_pids
+                    ) and all(_pid_alive(p) for p in wal_pids)
+                    crep["journaled_inflight"] = all(
+                        r["rid"] in recovered.journals
+                        for r in interrupted
+                    )
+                    # Restart against the same state dir.
+                    proc = spawn_router()
+                    await wait_ready(session, 1, 180)
+                    # Adoption must complete: every adopt must verify
+                    # (fresh spawns only ever cover dead-pid shortfall).
+                    adopted: set[str] = set()
+                    verified: set[str] = set()
+                    snap: dict = {}
+                    vdl = time.monotonic() + 120
+                    while time.monotonic() < vdl:
+                        snap = await fleet_snap(session) or {}
+                        events = snap.get("events") or []
+                        adopted = {
+                            e["replica_id"]
+                            for e in events
+                            if e["kind"] == "adopt"
+                        }
+                        verified = {
+                            e["replica_id"]
+                            for e in events
+                            if e["kind"] == "adopt_verified"
+                        }
+                        if adopted and adopted <= verified:
+                            break
+                        await asyncio.sleep(0.2)
+                    crep["adopted"] = sorted(adopted)
+                    crep["adoption_complete"] = bool(
+                        adopted
+                    ) and adopted <= verified
+                    crep["double_spawns"] = len(
+                        [
+                            e
+                            for e in (snap.get("events") or [])
+                            if e["kind"] == "spawn"
+                            and e["replica_id"] in adopted
+                        ]
+                    )
+                    snap_pids = {
+                        int(x["pid"])
+                        for x in (snap.get("replicas") or [])
+                        if x.get("pid")
+                    }
+                    all_pids |= snap_pids
+                    crep["pids_preserved"] = {
+                        int(x["pid"])
+                        for x in (snap.get("replicas") or [])
+                        if x.get("pid") and x["replica_id"] in adopted
+                    } <= wal_pids
+                    # Replay every severed stream through the reconnect
+                    # protocol; tokens must concatenate bit-identically.
+                    rtasks = [
+                        asyncio.ensure_future(one_stream(session, r))
+                        for r in interrupted
+                    ]
+                    if rtasks:
+                        await asyncio.wait_for(
+                            asyncio.gather(
+                                *rtasks, return_exceptions=True
+                            ),
+                            timeout=180,
+                        )
+                    for r in recs:
+                        if r.get("rid"):
+                            stats["admitted"] += 1
+                        if not r.get("rid") or not r["done"]:
+                            stats["lost"] += 1
+                        elif r["toks"] != expected:
+                            stats["mismatches"] += 1
+                            print(
+                                f"cycle {cyc}: TOKEN MISMATCH "
+                                f"{r['toks']} != {expected}",
+                                file=sys.stderr,
+                            )
+                        else:
+                            stats["completed"] += 1
+                            if r in interrupted:
+                                stats["resumed"] += 1
+                    per_cycle.append(crep)
+                # Graceful goodbye: SIGTERM drains and reaps the fleet.
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(timeout=90)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        # Zero-leak scan over every child pid we ever saw (WAL records
+        # + fleet snapshots), with a short grace for teardown.
+        leaked: list[int] = []
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            leaked = [p for p in sorted(all_pids) if _pid_alive(p)]
+            if not leaked:
+                break
+            await asyncio.sleep(0.25)
+        for pid in leaked:  # clean up, but still report the failure
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+        return {"cycles": per_cycle, "leaked": leaked}
+
+    try:
+        out = asyncio.new_event_loop().run_until_complete(go())
+        per = out["cycles"]
+        report = {
+            "mode": "router_kill",
+            "fleet_size": fleet_size,
+            "scale_to": scale_to,
+            **stats,
+            "cycles_detail": per,
+            "leaked_children": out["leaked"],
+            # The acceptance contract (ISSUE 17): the kill really
+            # landed mid-stream AND mid-scale-up; every recorded child
+            # survived the router death and was re-adopted (no leak, no
+            # double-spawn, pids preserved); every admitted in-flight
+            # stream was journaled, replayed, and finished with the
+            # exact greedy tokens an unkilled run produces.
+            "bounded": (
+                stats["lost"] == 0
+                and stats["mismatches"] == 0
+                and stats["interrupted"] >= 1
+                and stats["resumed"] == stats["interrupted"]
+                and bool(per)
+                and all(c["children_survived_kill"] for c in per)
+                and all(c["journaled_inflight"] for c in per)
+                and all(c["adoption_complete"] for c in per)
+                and all(c["double_spawns"] == 0 for c in per)
+                and all(c["pids_preserved"] for c in per)
+                and all(c["killed_mid_scale_up"] for c in per)
+                and not out["leaked"]
+            ),
+        }
+        return report
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------
 # Disagg per-role autoscale ramp (ISSUE 16): a mixed decode-capable
 # replica plus an AUTOSCALED prefill pool, under a rising-then-falling
 # long-prompt Poisson sweep with a steady short-prompt floor — the
@@ -2223,6 +2628,23 @@ def main() -> None:
         "happy path",
     )
     parser.add_argument(
+        "--router-kill",
+        action="store_true",
+        help="ISSUE 17 crash-safe router phase: run a managed fleet "
+        "with durable state (--state-dir WAL), SIGKILL the ROUTER "
+        "ITSELF mid-stream and mid-scale-up, restart it against the "
+        "same state dir — asserts every recorded child survives and "
+        "is re-adopted (zero leaked, zero double-spawned processes) "
+        "and every admitted in-flight stream finishes bit-identically "
+        "through the X-VDT-Resume-Id reconnect protocol",
+    )
+    parser.add_argument(
+        "--router-kill-cycles",
+        type=int,
+        default=1,
+        help="kill→restart cycles for --router-kill mode",
+    )
+    parser.add_argument(
         "--kv-spill",
         action="store_true",
         help="ISSUE 14 spill phase: kill-recover cycles with an ACTIVE "
@@ -2231,6 +2653,12 @@ def main() -> None:
         "recoveries, and RSS plateaus (no host-memory leak)",
     )
     args = parser.parse_args()
+    if args.router_kill:
+        report = run_router_kill(cycles=args.router_kill_cycles)
+        print(json.dumps(report))
+        if not report["bounded"]:
+            sys.exit(1)
+        return
     if args.disagg_autoscale is not None:
         report = run_disagg_autoscale_ramp(
             ramp=args.disagg_autoscale,

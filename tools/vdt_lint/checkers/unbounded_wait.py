@@ -216,7 +216,10 @@ class UnboundedWaitChecker(Checker):
         # ISSUE 10: the router IS a control plane over replicas — a
         # silently dead backend must trigger migration, never a wedged
         # client stream (Llumnix-style migration is only safe on a
-        # deadline-disciplined control plane).
+        # deadline-disciplined control plane).  Since ISSUE 17 this
+        # scope also covers router/persist.py: the WAL sits on the
+        # admission/checkpoint hot path, so every fsync/rotation wait
+        # there must be deadline-bounded too.
         "router/",
         # ISSUE 15: the KV hand-off module drives device collectives
         # and cross-replica transfers from the engine thread — an

@@ -485,6 +485,11 @@ class RouterArgs:
     autoscale: bool = False
     autoscale_min: int | None = None
     autoscale_max: int | None = None
+    # Crash-safe router (ISSUE 17; default off): directory for the
+    # durable control-plane WAL.  A router restarted against the same
+    # dir re-adopts its still-running managed replicas and replays
+    # journaled in-flight requests when their clients reconnect.
+    state_dir: str | None = None  # None -> $VDT_ROUTER_STATE_DIR
 
     @staticmethod
     def add_cli_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
@@ -590,6 +595,15 @@ class RouterArgs:
             help="autoscaler ceiling (default: "
             "$VDT_AUTOSCALE_MAX_REPLICAS or 4)",
         )
+        parser.add_argument(
+            "--state-dir", type=str, default=None,
+            help="durable control-plane state directory: a bounded "
+            "write-ahead log of fleet membership, in-flight request "
+            "journals, and QoS config; a router restarted against it "
+            "re-adopts still-running managed replicas and finishes "
+            "interrupted streams bit-identically when clients "
+            "reconnect (default: $VDT_ROUTER_STATE_DIR; empty = off)",
+        )
         return parser
 
     @classmethod
@@ -605,6 +619,13 @@ class RouterArgs:
         if not urls:
             urls = list(envs.VDT_ROUTER_REPLICAS)
         return urls
+
+    def resolved_state_dir(self) -> str:
+        """--state-dir over $VDT_ROUTER_STATE_DIR; "" = durable state
+        off (the seed behavior)."""
+        if self.state_dir is not None:
+            return self.state_dir
+        return envs.VDT_ROUTER_STATE_DIR
 
 
 @dataclass

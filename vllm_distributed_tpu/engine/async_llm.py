@@ -542,16 +542,33 @@ class AsyncLLM:
                 if item.finished:
                     return
         finally:
-            self._queues.pop(request_id, None)
-            self._journal.pop(request_id, None)
-            self._intake.put(("abort", request_id))
-            self._wake.set()
+            # A resume takeover (api_server.internal_resume replaying
+            # an id after a router crash, ISSUE 17) may have replaced
+            # this handler's queue with a fresh one; tearing down here
+            # would abort the successor's engine-side request.  Only
+            # clean up what is still ours.
+            if self._queues.get(request_id) is q:
+                self._queues.pop(request_id, None)
+                self._journal.pop(request_id, None)
+                self._intake.put(("abort", request_id))
+                self._wake.set()
 
     async def abort(self, request_id: str) -> None:
         self._intake.put(("abort", request_id))
         self._wake.set()
         self._queues.pop(request_id, None)
         self._journal.pop(request_id, None)
+
+    async def intake_barrier(self) -> None:
+        """Resolve once every intake op enqueued before this call has
+        been applied by the engine thread.  The takeover fence for a
+        replayed /internal/resume (ISSUE 17): after ``abort(rid)`` +
+        ``intake_barrier()``, the engine holds no request ``rid`` and no
+        stale output of the old incarnation can contaminate a successor
+        — outputs dispatched before the barrier resolved found no queue
+        or journal registered under the id and were dropped (output
+        dispatch and barrier resolution are FIFO on the event loop)."""
+        await self._run_aux(lambda: None)
 
     # ---- graceful drain (ISSUE 8) ----
     @property

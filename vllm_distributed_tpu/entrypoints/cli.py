@@ -395,6 +395,25 @@ async def _router_async(args: argparse.Namespace) -> None:
         api_key=args.api_key,
         allow_empty_pool=fleet_on,
     )
+    # Crash-safe state (ISSUE 17; default off): open the WAL and
+    # recover whatever the previous incarnation left — fleet membership
+    # to re-adopt, in-flight journals to replay on reconnect.
+    persist_log = None
+    recovered = None
+    state_dir = router_args.resolved_state_dir()
+    if state_dir:
+        from vllm_distributed_tpu.router.persist import RouterStateLog
+
+        persist_log = RouterStateLog(state_dir)
+        recovered = persist_log.open()
+        state.attach_persist(persist_log, recovered)
+        logger.info(
+            "router durable state at %s: recovered %d replica "
+            "record(s), %d in-flight journal(s)",
+            state_dir,
+            len(recovered.replicas),
+            len(recovered.journals),
+        )
     if fleet_on:
         # Elastic fleet (ISSUE 13): the router owns `vdt serve`
         # replicas as supervised children, optionally resized by the
@@ -436,7 +455,27 @@ async def _router_async(args: argparse.Namespace) -> None:
                 "prefill": router_args.fleet_prefill,
                 "decode": router_args.fleet_decode,
             },
+            # Durable membership (ISSUE 17): spawn/retire events land in
+            # the WAL so the next incarnation can re-adopt live children.
+            persist=persist_log,
         )
+        # Recovered scale targets win over the CLI defaults: a crash
+        # between a scale-up and its convergence must not revert the
+        # fleet (the first reconcile tick would retire the extras the
+        # previous incarnation just spawned).
+        if recovered is not None and recovered.fleet_target is not None:
+            if recovered.fleet_target != manager.target:
+                logger.info(
+                    "restoring recovered fleet target %d "
+                    "(CLI default was %d)",
+                    recovered.fleet_target,
+                    manager.target,
+                )
+            manager.target = recovered.fleet_target
+            for role, n in (recovered.fleet_role_targets or {}).items():
+                if role in manager.role_targets:
+                    manager.role_targets[role] = int(n)
+        manager.persist_targets()
         if cfg is not None:
 
             async def _slo_classes() -> dict:

@@ -116,6 +116,12 @@ class ServerState:
     role: str = "mixed"
     request_counter: Counter = field(default_factory=Counter)
     metrics: Any = None
+    # Live /internal/resume handler task per request id (ISSUE 17): a
+    # router replaying a resume for an id it already resumed (it
+    # crashed mid-hand-off and cannot know whether the first attempt
+    # landed) takes over from the stale handler instead of deadlocking
+    # behind its registration.
+    resume_takeovers: dict = field(default_factory=dict)
 
 
 # Endpoints that stay open without an API key (probes + scrapers), the
@@ -1311,6 +1317,39 @@ async def internal_resume(request: web.Request) -> web.Response:
         and not request.headers.get(SLO_CLASS_HEADER)
     ):
         params.slo_class = str(resumed_class)
+    # Idempotent replay (ISSUE 17 satellite): a router that crashed
+    # mid-hand-off replays the same journaled request id, and the
+    # second POST must win cleanly.  Cancel the stale handler and wait
+    # for its teardown — its generate() finally aborts the engine-side
+    # request through the FIFO intake, so the abort is ordered BEFORE
+    # the ("resume", ...) our fresh generate() enqueues below — then
+    # belt-and-braces abort in case the stale handler died outside its
+    # generate loop and never reached that finally.
+    prior = state.resume_takeovers.get(rid)
+    if prior is not None and prior is not asyncio.current_task():
+        prior.cancel()
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(prior, return_exceptions=True), timeout=5
+            )
+        except asyncio.TimeoutError:
+            return _error(
+                f"stale resume handler for {rid} did not exit", 503
+            )
+        await engine.abort(rid)
+        # Fence: the old engine-side request keeps stepping until the
+        # abort is consumed, and its outputs would land in OUR queue
+        # (same id) once we register below — duplicating tokens in the
+        # replayed stream.  The barrier resolves only after the abort
+        # applied and every stale output dispatch has run (and dropped,
+        # nothing being registered under the id right now).
+        try:
+            await asyncio.wait_for(engine.intake_barrier(), timeout=5)
+        except asyncio.TimeoutError:
+            return _error(
+                f"engine did not quiesce {rid} for takeover", 503
+            )
+    state.resume_takeovers[rid] = asyncio.current_task()
     engine.register_resumable(
         JournalEntry(
             request_id=rid,
@@ -1371,6 +1410,11 @@ async def internal_resume(request: web.Request) -> web.Response:
         await send_frame({"error": str(e), "code": 503})
     except (ConnectionResetError, asyncio.CancelledError):
         logger.info("router disconnected from resumed %s", rid)
+    finally:
+        # Only drop the registration if it is still ours — a takeover
+        # that cancelled this handler has already installed itself.
+        if state.resume_takeovers.get(rid) is asyncio.current_task():
+            state.resume_takeovers.pop(rid, None)
     await response.write_eof()
     return response
 

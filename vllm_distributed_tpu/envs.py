@@ -228,6 +228,55 @@ environment_variables: dict[str, Callable[[], Any]] = {
     "VDT_ROUTER_READ_TIMEOUT_SECONDS": lambda: float(
         os.environ.get("VDT_ROUTER_READ_TIMEOUT_SECONDS", "600")
     ),
+    # --- crash-safe router (ISSUE 17) ---
+    # Directory for the router's durable control-plane state (a bounded
+    # write-ahead log of fleet membership, in-flight request journal
+    # checkpoints, and QoS/placement config).  Empty (the default) =
+    # no persistence: the router behaves exactly as before.  With a
+    # state dir set, a restarted router re-adopts still-running managed
+    # replicas instead of leaking or respawning them, and replays
+    # journaled in-flight requests when their clients reconnect.
+    "VDT_ROUTER_STATE_DIR": lambda: os.environ.get(
+        "VDT_ROUTER_STATE_DIR", ""
+    ),
+    # WAL segment rotation threshold: when the live segment exceeds
+    # this many bytes it is compacted (current membership + config +
+    # live journals only) into a fresh segment via atomic rename, so
+    # the on-disk state stays bounded regardless of uptime.
+    "VDT_ROUTER_STATE_SEGMENT_BYTES": lambda: int(
+        os.environ.get("VDT_ROUTER_STATE_SEGMENT_BYTES", "4194304")
+    ),
+    # Bounded fsync cadence: appended records are flushed to the OS on
+    # every write but fsync'd at most this often (plus on rotation and
+    # close) — a crash can lose at most this window of checkpoints,
+    # never the membership records (those fsync immediately).
+    "VDT_ROUTER_STATE_FSYNC_INTERVAL_SECONDS": lambda: float(
+        os.environ.get("VDT_ROUTER_STATE_FSYNC_INTERVAL_SECONDS", "0.2")
+    ),
+    # Per-request journal checkpoint cadence: a live stream's cumulative
+    # journal (prompt ids + emitted tokens) is re-recorded at most this
+    # often — NOT per token, which would make the WAL quadratic in
+    # stream length.  Replays after a crash may therefore re-emit up to
+    # this window's worth of tokens; the reconnecting client trims them
+    # via X-VDT-Resume-Tokens.
+    "VDT_ROUTER_STATE_CKPT_INTERVAL_SECONDS": lambda: float(
+        os.environ.get("VDT_ROUTER_STATE_CKPT_INTERVAL_SECONDS", "0.25")
+    ),
+    # Re-adoption grace window: a recovered replica enters the pool in
+    # the `verifying` state and transport-level probe failures within
+    # this window keep it there (with faster jittered re-probes)
+    # instead of declaring it unreachable — a restart storm must not
+    # mass-eject a healthy fleet that is briefly slow to answer.
+    "VDT_ROUTER_STATE_VERIFY_WINDOW_SECONDS": lambda: float(
+        os.environ.get("VDT_ROUTER_STATE_VERIFY_WINDOW_SECONDS", "10")
+    ),
+    # How long recovered in-flight journals are held for client
+    # reconnects after a router restart.  A client that reconnects with
+    # X-VDT-Resume-Id inside the window finishes its generation
+    # bit-identically; after it, the id gets a clean 503 (retry fresh).
+    "VDT_ROUTER_STATE_RECOVERY_TTL_SECONDS": lambda: float(
+        os.environ.get("VDT_ROUTER_STATE_RECOVERY_TTL_SECONDS", "120")
+    ),
     # --- disaggregated prefill/decode (ISSUE 15) ---
     # Role this serving replica announces in /health ("prefill" |
     # "decode" | "mixed").  The router places long prompts on the
@@ -515,6 +564,16 @@ NON_REPLICATED_ENV_VARS = {
     "VDT_ROUTER_MAX_MIGRATIONS",
     "VDT_ROUTER_CONNECT_TIMEOUT_SECONDS",
     "VDT_ROUTER_READ_TIMEOUT_SECONDS",
+    # Crash-safe router state (ISSUE 17): the WAL is the ROUTER
+    # process's local durable state — replicating the dir onto workers
+    # or replicas would have every process writing (and on boot,
+    # recovering) the same fleet.
+    "VDT_ROUTER_STATE_DIR",
+    "VDT_ROUTER_STATE_SEGMENT_BYTES",
+    "VDT_ROUTER_STATE_FSYNC_INTERVAL_SECONDS",
+    "VDT_ROUTER_STATE_CKPT_INTERVAL_SECONDS",
+    "VDT_ROUTER_STATE_VERIFY_WINDOW_SECONDS",
+    "VDT_ROUTER_STATE_RECOVERY_TTL_SECONDS",
     # Disaggregation (ISSUE 15): the role is per-replica identity like
     # VDT_REPLICA_ID; the crossover/chunking knobs configure the ROUTER
     # process's hand-off orchestration; export holds are driver-engine

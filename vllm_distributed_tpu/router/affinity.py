@@ -214,6 +214,23 @@ class PrefixAffinityIndex:
                 scores[replica_id] = matched * self.block_tokens
         return scores
 
+    def warm(
+        self,
+        replica_id: str,
+        prompt_text: str | None = None,
+        prompt_token_ids: list[int] | None = None,
+    ) -> bool:
+        """Seed the mirror from an out-of-band observation — router
+        crash recovery (ISSUE 17) replays recovered journals' prompts
+        through here so the rebuilt index steers repeat traffic back at
+        the replicas whose KV caches are still hot.  Returns whether
+        the prompt produced any chain to record."""
+        keys = self.keys_for(prompt_text, prompt_token_ids)
+        if not keys:
+            return False
+        self.observe(replica_id, keys)
+        return True
+
     def forget(self, replica_id: str) -> None:
         """Drop a replica's chains (its process died or drained: the
         KV cache backing them is gone)."""

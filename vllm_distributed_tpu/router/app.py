@@ -63,6 +63,16 @@ ROUTER_HEADER = "X-VDT-Router"
 # Disaggregated prefill (ISSUE 15): marks the prefill-pool hop; the
 # replica runs the request prefill-only and holds its KV for export.
 DISAGG_HEADER = "X-VDT-Disagg"
+# Crash-safe router (ISSUE 17): with a state dir attached, the router
+# echoes every proxied request's id; a client whose stream died with
+# the router reconnects by re-POSTing with the echoed id (plus how
+# many tokens per choice it already holds) and the journaled remainder
+# replays bit-identically.  Unknown/expired ids get a clean 503.
+REQUEST_ID_HEADER = "X-VDT-Request-Id"
+RESUME_ID_HEADER = "X-VDT-Resume-Id"
+# Per-choice "tokens" or "tokens:textchars" counts, comma-separated in
+# ascending choice-index order, of what the client already holds.
+RESUME_TOKENS_HEADER = "X-VDT-Resume-Tokens"
 
 _PATHS = {"completions": "/v1/completions", "chat": "/v1/chat/completions"}
 
@@ -161,6 +171,15 @@ class RouterState:
         )
         self._rr = 0
         self.session = None  # aiohttp.ClientSession, set on startup
+        # Crash-safe state (ISSUE 17), installed by attach_persist():
+        # None = no durable state, the exact pre-ISSUE-17 behavior.
+        self.persist = None  # router.persist.RouterStateLog
+        self.recovered = None  # router.persist.RecoveredState, until startup
+        # request_id -> (expiry_mono, journal dict): recovered in-flight
+        # journals awaiting their clients' reconnect, TTL-bounded.
+        self.recovered_journals: dict[str, tuple[float, dict]] = {}
+        self.recovery_ttl = 0.0
+        self.rid_prefix = "rtr"
         # Elastic fleet (ISSUE 13): set by attach_fleet() before the
         # app starts; None = static replica set, exactly the PR 8
         # behavior.
@@ -182,6 +201,53 @@ class RouterState:
         (the manager needs the router's client session)."""
         self.manager = manager
         self.autoscaler = autoscaler
+
+    def attach_persist(self, log, recovered=None) -> None:
+        """Install the durable-state WAL (ISSUE 17) and any state it
+        recovered.  Request ids become unique across incarnations
+        (``rtr-<pid>-<n>``) so a restarted router's fresh requests can
+        never collide with journals recovered from the previous one."""
+        import os
+
+        self.persist = log
+        self.recovered = recovered
+        self.recovery_ttl = envs.VDT_ROUTER_STATE_RECOVERY_TTL_SECONDS
+        self.rid_prefix = f"rtr-{os.getpid()}"
+
+    # ---- durable-state hooks (no-ops without attach_persist) ----
+    def persist_checkpoint(self, journal, *, force: bool = False) -> None:
+        if self.persist is None or self.persist.closed:
+            return
+        try:
+            self.persist.checkpoint_journal(journal, force=force)
+        except Exception:  # noqa: BLE001 — a sick WAL must not take down serving
+            logger.exception(
+                "journal checkpoint for %s failed", journal.request_id
+            )
+
+    def persist_done(self, request_id: str) -> None:
+        if self.persist is None or self.persist.closed:
+            return
+        try:
+            self.persist.journal_done(request_id)
+        except Exception:  # noqa: BLE001 — a sick WAL must not take down serving
+            logger.exception("journal done for %s failed", request_id)
+
+    def take_recovered(self, request_id: str) -> dict | None:
+        """Claim a recovered journal for a reconnecting client (pop:
+        the first reconnect wins).  Expired entries are reaped lazily
+        and marked done in the WAL so compaction drops them."""
+        now = time.monotonic()
+        expired = [
+            rid
+            for rid, (deadline, _) in self.recovered_journals.items()
+            if deadline < now
+        ]
+        for rid in expired:
+            self.recovered_journals.pop(rid, None)
+            self.persist_done(rid)
+        entry = self.recovered_journals.pop(request_id, None)
+        return entry[1] if entry is not None else None
 
     # ---- placement ----
     def place(
@@ -309,7 +375,11 @@ async def _proxy(request: web.Request, kind: str) -> web.StreamResponse:
     except Exception as e:  # noqa: BLE001
         state.metrics.record_request(kind, "bad_request")
         return _error(f"invalid request: {e}")
-    request_id = f"rtr-{next(state.request_counter)}"
+    if state.persist is not None and request.headers.get(RESUME_ID_HEADER):
+        # Crash recovery (ISSUE 17): a client whose stream died with
+        # the previous router incarnation finishing its request.
+        return await _proxy_reconnect(request, state, kind)
+    request_id = f"{state.rid_prefix}-{next(state.request_counter)}"
     journal = RouterJournal(request_id, kind, body)
     # Effective SLO class, body field over header (the same precedence
     # the replica applies): drives per-class placement here and rides
@@ -332,6 +402,10 @@ async def _proxy(request: web.Request, kind: str) -> web.StreamResponse:
         >= state.disagg_min_prompt_tokens
     ):
         state.prefill_demand.observe()
+    # Admission checkpoint (ISSUE 17): once this record is durable the
+    # request is replayable after a router crash; a crash before it
+    # means the client's reconnect gets a clean 503 (retry fresh).
+    state.persist_checkpoint(journal, force=True)
     tracer = get_tracer()
     with tracer.span(
         "router.request",
@@ -350,6 +424,9 @@ async def _proxy(request: web.Request, kind: str) -> web.StreamResponse:
             )
         span.set_attribute("migrations", journal.migrations)
         span.set_attribute("served_by", journal.served_by)
+    # Terminal for this incarnation (completed, failed with a terminal
+    # frame, or client gone): nothing left to replay.
+    state.persist_done(journal.request_id)
     return response
 
 
@@ -482,11 +559,14 @@ async def _proxy_unary(
             )
         else:
             state.metrics.record_request(kind, "bad_request")
+        headers = {REPLICA_HEADER: served_id}
+        if state.persist is not None:
+            headers[REQUEST_ID_HEADER] = journal.request_id
         return web.Response(
             body=raw,
             status=status,
             content_type="application/json",
-            headers={REPLICA_HEADER: served_id},
+            headers=headers,
         )
     return web.Response(
         body=raw, status=status, content_type="application/json"
@@ -604,6 +684,10 @@ async def _proxy_stream(
     }
     if span.ctx is not None:
         headers[TRACE_HEADER] = span.ctx[0]
+    if state.persist is not None:
+        # The reconnect handle (ISSUE 17): with durable state on, the
+        # client can finish this stream across a router crash.
+        headers[REQUEST_ID_HEADER] = journal.request_id
     response = web.StreamResponse(headers=headers)
     await response.prepare(request)
 
@@ -780,6 +864,10 @@ async def _forward_primary(
                         }
                     )
             await write(json.dumps(obj))
+            # Progress checkpoint (ISSUE 17), rate-limited inside the
+            # WAL; the reconnect protocol reconciles either direction
+            # of checkpoint-vs-client lag via X-VDT-Resume-Tokens.
+            state.persist_checkpoint(journal)
             if migrate:
                 raise MigrationNeeded("overloaded")
     except asyncio.CancelledError:
@@ -945,6 +1033,28 @@ async def _forward_resumed(
                 # migrate the remainder instead of surfacing a
                 # truncated "overloaded" result.
                 finish = None
+            # Reconnect fast-forward (ISSUE 17): when the client holds
+            # MORE tokens than the recovered checkpoint (the crash beat
+            # the checkpoint cadence), the resumed replica re-emits the
+            # overlap — greedy regeneration makes it bit-identical to
+            # what the client already has, so drop those frames while
+            # still advancing the journal.  Frame-atomic: a frame
+            # carrying more than the remaining overlap forwards whole.
+            skip_map = getattr(journal, "resume_skip", None)
+            skip = skip_map.get(choice.index, 0) if skip_map else 0
+            if (
+                skip > 0
+                and new_ids
+                and skip >= len(new_ids)
+                and finish is None
+                and not shed
+            ):
+                skip_map[choice.index] = skip - len(new_ids)
+                choice.observe(
+                    new_ids, delta_text, None, obj.get("prompt_token_ids")
+                )
+                state.persist_checkpoint(journal)
+                continue
             chunk = _synth_chunk(
                 journal, choice, delta_text, new_ids, finish, client_debug
             )
@@ -956,6 +1066,7 @@ async def _forward_resumed(
                 # Only a chunk actually written can have carried the
                 # role-bearing first delta.
                 choice.role_sent = True
+            state.persist_checkpoint(journal)
             if shed:
                 raise MigrationNeeded("overloaded")
             if finish is not None:
@@ -991,6 +1102,214 @@ async def _forward_resumed(
             )
         )
     await write("[DONE]")
+
+
+# ---- crash-recovery reconnect (ISSUE 17) ----
+def _parse_resume_counts(
+    journal, header: str
+) -> tuple[dict[int, int], str | None]:
+    """Reconcile the client's held position against the recovered
+    checkpoint.  Header entries are per-choice ``tokens`` or
+    ``tokens:textchars`` in ascending choice-index order.  Client
+    behind the checkpoint (the write beat the crash but not the
+    socket): REWIND the journal to the client's position — truncate
+    the emitted prefix and clear any unseen finish, so the resumed
+    replica regenerates (bit-identically) from where the client
+    actually stopped.  Client ahead of the checkpoint: return the
+    per-choice overlap to skip during forwarding."""
+    skip: dict[int, int] = {}
+    if not header:
+        return skip, None
+    entries = [e.strip() for e in header.split(",")]
+    indices = sorted(journal.choices)
+    if len(entries) > len(indices):
+        return skip, "more counts than choices"
+    for idx, entry in zip(indices, entries):
+        tok_s, _, text_s = entry.partition(":")
+        try:
+            held_tok = int(tok_s)
+            held_text = int(text_s) if text_s else None
+        except ValueError:
+            return skip, f"invalid count {entry!r}"
+        if held_tok < 0 or (held_text is not None and held_text < 0):
+            return skip, f"negative count {entry!r}"
+        choice = journal.choices[idx]
+        have = len(choice.emitted_token_ids)
+        if held_tok < have:
+            del choice.emitted_token_ids[held_tok:]
+            if held_text is not None:
+                choice.forwarded_text_len = min(
+                    held_text, choice.forwarded_text_len
+                )
+            choice.finish_reason = None
+        elif held_tok > have:
+            skip[idx] = held_tok - have
+            if held_text is not None and held_text < choice.forwarded_text_len:
+                choice.forwarded_text_len = held_text
+    return skip, None
+
+
+async def _proxy_reconnect(
+    request: web.Request, state: RouterState, kind: str
+) -> web.StreamResponse:
+    """Finish a request interrupted by a router crash: claim its
+    recovered journal, reconcile positions with what the client holds,
+    and replay the remainder onto a healthy replica via the normal
+    /internal/resume machinery.  Admitted work finishes bit-identical;
+    an id the WAL never admitted (or whose TTL lapsed) gets a clean
+    503 — the client retries as a fresh request."""
+    resume_id = request.headers.get(RESUME_ID_HEADER, "")
+    entry = state.take_recovered(resume_id)
+    if entry is None:
+        state.metrics.record_request(kind, "rejected")
+        return _error(
+            f"unknown or expired resume id {resume_id!r}; "
+            "retry as a new request",
+            503,
+            retry_after=1,
+        )
+    try:
+        journal = RouterJournal.from_dict(entry)
+    except Exception as e:  # noqa: BLE001 — a checkpoint this incarnation can't parse is unreplayable
+        logger.exception("recovered journal %s unusable", resume_id)
+        state.persist_done(resume_id)
+        state.metrics.record_request(kind, "failed")
+        return _error(f"recovered journal unusable: {e}", 503, retry_after=1)
+    if journal.kind != kind:
+        state.metrics.record_request(kind, "bad_request")
+        return _error(
+            f"resume id {resume_id!r} belongs to a {journal.kind} request"
+        )
+    skip, err = _parse_resume_counts(
+        journal, request.headers.get(RESUME_TOKENS_HEADER, "")
+    )
+    if err is not None:
+        state.metrics.record_request(kind, "bad_request")
+        return _error(f"invalid {RESUME_TOKENS_HEADER}: {err}")
+    journal.resume_skip = skip
+    # The crash hand-off consumes one migration slot, mirroring any
+    # other replica switch the client's stream lives through.
+    journal.migrations += 1
+    # Re-admit into THIS incarnation's WAL: a second crash mid-replay
+    # must leave the request reconnectable again.
+    state.persist_checkpoint(journal, force=True)
+    text, ids = journal.affinity_source()
+    keys = state.index.keys_for(text, ids)
+    tracer = get_tracer()
+    with tracer.span(
+        "router.reconnect",
+        trace_root=True,
+        kind=kind,
+        request_id=journal.request_id,
+    ) as span:
+        fwd = _forward_headers(request, span.ctx)
+        if journal.stream:
+            response = await _reconnect_stream(
+                request, state, journal, keys, fwd, span
+            )
+        else:
+            # Non-streaming: nothing was delivered before the crash, so
+            # completing "from the journal" is whole-request
+            # resubmission of the journaled body — greedy regeneration
+            # answers bit-identically.
+            response = await _proxy_unary(
+                request, state, journal, keys, fwd, span
+            )
+        span.set_attribute("migrations", journal.migrations)
+        span.set_attribute("served_by", journal.served_by)
+    state.persist_done(journal.request_id)
+    return response
+
+
+async def _reconnect_stream(
+    request, state: RouterState, journal, keys, fwd, span
+) -> web.StreamResponse:
+    """Streaming half of the reconnect: commit the client response,
+    re-send any finish the crash swallowed, then drive the standard
+    resume/migration machinery until the remainder completes."""
+    kind = journal.kind
+    exclude: set[str] = set()
+    client_debug = request.headers.get(ROUTER_HEADER) == "1"
+    headers = {
+        "Content-Type": "text/event-stream",
+        "Cache-Control": "no-cache",
+        REQUEST_ID_HEADER: journal.request_id,
+    }
+    if journal.served_by:
+        headers[REPLICA_HEADER] = journal.served_by
+    if span.ctx is not None:
+        headers[TRACE_HEADER] = span.ctx[0]
+    response = web.StreamResponse(headers=headers)
+    await response.prepare(request)
+
+    async def write(payload: str) -> None:
+        await response.write(f"data: {payload}\n\n".encode())
+
+    completed = False
+    try:
+        try:
+            # Choices the checkpoint saw finish: the client reconnected,
+            # so at minimum the [DONE] (and possibly the finish chunk)
+            # was lost — re-state the finish with an empty delta.
+            for choice in journal.choices.values():
+                if choice.finished:
+                    await write(
+                        json.dumps(
+                            _synth_chunk(
+                                journal,
+                                choice,
+                                "",
+                                [],
+                                choice.finish_reason,
+                                client_debug,
+                            )
+                        )
+                    )
+            target = _place_or_none(
+                state, keys, exclude, span, slo_class=journal.slo_class
+            )
+            if target is None:
+                delay = _soonest_backoff_expiry(state, exclude)
+                if delay is not None:
+                    await asyncio.sleep(delay)
+                    target = _place_or_none(
+                        state, keys, exclude, span,
+                        slo_class=journal.slo_class,
+                    )
+            if target is None:
+                await write(
+                    json.dumps(
+                        {
+                            "error": "no healthy replica to resume on",
+                            "code": 503,
+                        }
+                    )
+                )
+            else:
+                try:
+                    await _forward_resumed(
+                        state, journal, target, fwd, write, client_debug
+                    )
+                    journal.served_by = target.replica_id
+                    completed = True
+                except MigrationNeeded as m:
+                    completed = await _migrate_loop(
+                        state, journal, keys, exclude, target, m,
+                        fwd, write, client_debug, span,
+                    )
+        except StreamAbort:
+            completed = False
+        if completed:
+            state.index.observe(journal.served_by, keys)
+            state.metrics.record_request(kind, "migrated_completed")
+        else:
+            state.metrics.record_request(kind, "failed")
+    except (ConnectionResetError, asyncio.CancelledError):
+        logger.info(
+            "client disconnected from reconnect %s", journal.request_id
+        )
+    await response.write_eof()
+    return response
 
 
 # ---- route handlers ----
@@ -1223,11 +1542,80 @@ async def version(request: web.Request) -> web.Response:
 
 
 # ---- app assembly ----
+def _config_record(state: RouterState) -> dict:
+    """The QoS/placement knob snapshot stored in the WAL's config
+    record (ISSUE 17)."""
+    return {
+        "policy": state.policy,
+        "max_migrations": int(state.max_migrations),
+        "qos": state.qos.config_fingerprint(),
+    }
+
+
+def _rebuild_from_recovery(state: RouterState) -> None:
+    """Warm the control plane from the recovered WAL (ISSUE 17): every
+    journaled request re-seeds the affinity mirror for the replica that
+    was serving it (its prefix KV is still hot there), and unfinished
+    journals go on the TTL shelf awaiting their clients' reconnect."""
+    recovered = state.recovered
+    if recovered is None:
+        return
+    current_cfg = _config_record(state)
+    if recovered.config is not None and recovered.config != current_cfg:
+        # The scheduling state in the WAL was built under different
+        # knobs (QoS classes/placement or routing policy changed across
+        # the restart).  Recovery still proceeds — membership and
+        # journals are knob-independent — but the flip is surfaced.
+        logger.warning(
+            "router config changed across restart: recovered %s, now %s",
+            recovered.config,
+            current_cfg,
+        )
+    if state.persist is not None and not state.persist.closed:
+        try:
+            state.persist.record_config(current_cfg)
+        except Exception:  # noqa: BLE001 — a sick WAL must not block boot
+            logger.exception("recording router config failed")
+    deadline = time.monotonic() + state.recovery_ttl
+    restored = 0
+    for rid, jdict in recovered.journals.items():
+        try:
+            journal = RouterJournal.from_dict(jdict)
+        except Exception:  # noqa: BLE001 — one bad checkpoint must not sink the rest
+            logger.exception("recovered journal %s unusable; dropping", rid)
+            state.persist_done(rid)
+            continue
+        if journal.served_by:
+            text, ids = journal.affinity_source()
+            state.index.warm(journal.served_by, text, ids)
+        state.recovered_journals[rid] = (deadline, jdict)
+        restored += 1
+    if restored or recovered.replicas:
+        logger.info(
+            "router recovery: %d journal(s) awaiting reconnect "
+            "(TTL %.0fs), %d replica record(s) processed",
+            restored,
+            state.recovery_ttl,
+            len(recovered.replicas),
+        )
+    state.recovered = None
+
+
 async def _on_startup(app: web.Application) -> None:
     import aiohttp
 
     state: RouterState = app["router_state"]
     state.session = aiohttp.ClientSession()
+    # Crash recovery (ISSUE 17) runs before the first probe sweep:
+    # re-adopted children must be pool members (in their verifying
+    # grace window) by the time probes and the reconcile loop look.
+    if (
+        state.recovered is not None
+        and state.manager is not None
+        and state.recovered.replicas
+    ):
+        state.manager.session = state.session
+        state.manager.adopt_recovered(state.recovered.replicas)
     # One synchronous sweep so the first request after boot has health
     # states to place against, then the steady poll loop.
     await state.pool.probe_all(state.session)
@@ -1236,6 +1624,16 @@ async def _on_startup(app: web.Application) -> None:
         state.manager.start(state.session)
     if state.autoscaler is not None:
         state.autoscaler.start()
+    if state.recovered is not None:
+        _rebuild_from_recovery(state)
+        # SLO baselines: one best-effort fleet scrape so per-class
+        # attainment starts from the live pool's view, not from zero.
+        try:
+            await _fleet_slo(state)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — baselines warm up via the steady scrape anyway
+            logger.debug("recovery SLO scrape failed: %s", e)
 
 
 async def _on_cleanup(app: web.Application) -> None:
@@ -1250,6 +1648,8 @@ async def _on_cleanup(app: web.Application) -> None:
     await state.pool.stop()
     if state.session is not None:
         await state.session.close()
+    if state.persist is not None:
+        state.persist.close()
 
 
 @web.middleware

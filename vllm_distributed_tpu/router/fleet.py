@@ -34,6 +34,7 @@ import asyncio
 import math
 import os
 import shlex
+import signal
 import subprocess
 import time
 from collections import deque
@@ -73,6 +74,60 @@ class PopenHandle:
 
     def wait(self, timeout: float | None = None):
         return self._proc.wait(timeout=timeout)
+
+
+class AdoptedHandle:
+    """ChildHandle for a re-adopted orphan (ISSUE 17): the process was
+    spawned by a previous router incarnation, and when that router was
+    SIGKILLed the child (in its own session) survived and was
+    reparented — so it is NOT our child and ``waitpid`` can never reap
+    it.  ``poll()`` degrades to a liveness signal (``os.kill(pid, 0)``)
+    and the exit code of a vanished orphan is unknowable (reported as
+    -1); ``wait()`` is a bounded poll for the same reason."""
+
+    def __init__(self, pid: int) -> None:
+        self.pid = int(pid)
+        self._exit_code: int | None = None
+
+    def poll(self):
+        if self._exit_code is not None:
+            return self._exit_code
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except ProcessLookupError:
+            self._exit_code = -1
+            return self._exit_code
+        except PermissionError:
+            return None  # alive, owned by someone else
+
+    def terminate(self) -> None:
+        os.kill(self.pid, signal.SIGTERM)
+
+    def kill(self) -> None:
+        os.kill(self.pid, signal.SIGKILL)
+
+    def wait(self, timeout: float | None = None):
+        deadline = time.monotonic() + (
+            timeout if timeout is not None else 10.0
+        )
+        while self.poll() is None:
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"adopted pid {self.pid} still alive after wait"
+                )
+            time.sleep(0.05)
+        return self._exit_code
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
 
 
 class CommandLauncher:
@@ -178,6 +233,7 @@ class ReplicaManager:
         drainer=None,
         port_factory=get_open_port,
         role_targets: dict[str, int] | None = None,
+        persist=None,
     ) -> None:
         def _env(value, name):
             return getattr(envs, name) if value is None else value
@@ -217,6 +273,11 @@ class ReplicaManager:
         self._health_check = health_check or self._http_health
         self._drainer = drainer or self._http_drain
         self._port_factory = port_factory
+        # Durable membership log (ISSUE 17): every spawn/exit is
+        # recorded so a restarted router can re-adopt still-running
+        # children instead of leaking or double-spawning them.  None =
+        # no persistence, the exact pre-ISSUE-17 behavior.
+        self.persist = persist
         self.replicas: list[ManagedReplica] = []
         self.events: deque[dict] = deque(maxlen=512)
         self.restarts_total = 0
@@ -230,6 +291,44 @@ class ReplicaManager:
         self.session = None
         self._task: asyncio.Task | None = None
         self._stopped = asyncio.Event()
+
+    # ---- durable membership (ISSUE 17) ----
+    def _persist_replica(self, mr: ManagedReplica) -> None:
+        if self.persist is None or self.persist.closed:
+            return
+        try:
+            self.persist.record_replica(
+                mr.replica_id,
+                port=mr.port,
+                pid=getattr(mr.handle, "pid", None),
+                role=mr.role,
+                template=getattr(self.launcher, "template", None),
+            )
+        except Exception:  # noqa: BLE001 — a sick WAL must not take down supervision
+            logger.exception("persist of replica %s failed", mr.replica_id)
+
+    def _persist_gone(self, replica_id: str) -> None:
+        if self.persist is None or self.persist.closed:
+            return
+        try:
+            self.persist.record_replica_gone(replica_id)
+        except Exception:  # noqa: BLE001 — a sick WAL must not take down supervision
+            logger.exception(
+                "persist of replica %s removal failed", replica_id
+            )
+
+    def persist_targets(self) -> None:
+        """Record the current scale targets in the WAL.  The targets
+        are control-plane state: a router crash between a scale-up and
+        its convergence must not revert the fleet to the CLI default."""
+        if self.persist is None or self.persist.closed:
+            return
+        try:
+            self.persist.record_fleet_targets(
+                self.target, dict(self.role_targets)
+            )
+        except Exception:  # noqa: BLE001 — a sick WAL must not take down supervision
+            logger.exception("persist of fleet targets failed")
 
     # ---- introspection ----
     def record_event(self, kind: str, replica_id: str = "", **detail) -> None:
@@ -283,9 +382,12 @@ class ReplicaManager:
             logger.info(
                 "fleet target %d -> %d (%s)", self.target, n, reason
             )
+        changed = n != self.target
         self.target = n
         if reason == "manual":
             self.exhausted = False
+        if changed:
+            self.persist_targets()
         return self.target
 
     def scale_role_to(self, role: str, n: int, reason: str = "manual") -> int:
@@ -310,7 +412,10 @@ class ReplicaManager:
             logger.info(
                 "fleet %s target %d -> %d (%s)", role, current, n, reason
             )
+        changed = n != current
         self.role_targets[role] = n
+        if changed:
+            self.persist_targets()
         return n
 
     # ---- lifecycle ----
@@ -398,6 +503,7 @@ class ReplicaManager:
                 "serving" if was_ready else "warming",
             )
             self.replicas.remove(mr)
+            self._persist_gone(mr.replica_id)
             self._note_crash()
 
     def _note_crash(self) -> None:
@@ -431,6 +537,174 @@ class ReplicaManager:
         self._spawn_gate_mono = now + self._backoff
         self._backoff = min(self._backoff * 2, self.backoff_cap)
 
+    # ---- restart recovery: orphan re-adoption (ISSUE 17) ----
+    def adopt_recovered(
+        self,
+        recovered: dict[str, dict],
+        *,
+        verify_window: float | None = None,
+    ) -> list[ManagedReplica]:
+        """Re-adopt the WAL's recorded children instead of leaking or
+        respawning them.  For each record: a dead pid is reaped from
+        the log (the normal reconcile respawns the shortfall); a live
+        pid becomes a supervised :class:`ManagedReplica` again — state
+        ``ready`` (it was serving when the old router died) behind an
+        :class:`AdoptedHandle`, entered into the pool in the
+        ``verifying`` grace state so it takes no traffic until a probe
+        confirms it, while ``_adopt_gate`` checks that ``/health`` still
+        answers with the recorded ``VDT_REPLICA_ID`` (a reused pid or
+        port belongs to a stranger — dropped, never signalled).
+
+        Must be called before ``start()``/the first reconcile tick, on
+        the running event loop."""
+        vw = float(
+            verify_window
+            if verify_window is not None
+            else envs.VDT_ROUTER_STATE_VERIFY_WINDOW_SECONDS
+        )
+        adopted: list[ManagedReplica] = []
+        for replica_id, rec in recovered.items():
+            pid = rec.get("pid")
+            port = rec.get("port")
+            if not pid or not port:
+                self._persist_gone(replica_id)
+                continue
+            if not _pid_alive(int(pid)):
+                # Reaped from the log; the reconcile loop respawns the
+                # shortfall through the normal spawn path.  Not charged
+                # to the crash budget — the child didn't crash-loop,
+                # it died while no supervisor existed.
+                self.record_event("adopt_dead", replica_id, pid=pid)
+                logger.info(
+                    "recorded replica %s (pid %s) is gone; will respawn",
+                    replica_id,
+                    pid,
+                )
+                self._persist_gone(replica_id)
+                continue
+            role = rec.get("role") or "mixed"
+            if role not in ("prefill", "decode", "mixed"):
+                role = "mixed"
+            now = time.monotonic()
+            mr = ManagedReplica(
+                replica_id=replica_id,
+                port=int(port),
+                handle=AdoptedHandle(int(pid)),
+                state="ready",
+                role=role,
+                spawned_mono=now,
+                ready_mono=now,
+            )
+            self.replicas.append(mr)
+            self.pool.add(
+                mr.url,
+                replica_id=replica_id,
+                role=role,
+                verify_window=vw,
+            )
+            self.record_event("adopt", replica_id, pid=pid, port=port)
+            logger.info(
+                "re-adopted replica %s (pid %s, port %s); verifying",
+                replica_id,
+                pid,
+                port,
+            )
+            mr.task = asyncio.get_running_loop().create_task(
+                self._adopt_gate(mr, vw)
+            )
+            adopted.append(mr)
+        # Keep fresh spawn ids disjoint from adopted ones: fleet-<seq>
+        # must not collide with a replica we just re-adopted.
+        for mr in adopted:
+            tail = mr.replica_id.rsplit("-", 1)[-1]
+            if tail.isdigit():
+                self._seq = max(self._seq, int(tail))
+        return adopted
+
+    async def _health_identity(self, url: str) -> tuple[bool, str]:
+        """One bounded /health read: (answered-200, replica_id)."""
+        import aiohttp
+
+        timeout = aiohttp.ClientTimeout(total=2, connect=2)
+        try:
+            async with self.session.get(
+                f"{url}/health", timeout=timeout
+            ) as resp:
+                if resp.status != 200:
+                    return False, ""
+                try:
+                    body = await resp.json()
+                except Exception:  # noqa: BLE001 — 200 with no JSON body still proves liveness
+                    body = {}
+                return True, str((body or {}).get("replica_id") or "")
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — not answering (yet)
+            return False, ""
+
+    async def _adopt_gate(self, mr: ManagedReplica, verify_window: float) -> None:
+        """Identity check for a re-adopted child: within the grace
+        window, /health must answer 200 with the recorded replica id.
+        A stranger on the port (pid/port reuse) is dropped from
+        supervision without being signalled — it is not ours to kill;
+        a silent window expiry reaps the pid we do own and respawns
+        through the normal crash budget."""
+        deadline = time.monotonic() + max(verify_window, 0.5)
+        try:
+            while time.monotonic() < deadline:
+                if mr.state != "ready":
+                    return  # retired/crashed mid-verify
+                if mr.handle.poll() is not None:
+                    return  # died; _sweep_exits attributes the crash
+                answered, rid = await self._health_identity(mr.url)
+                if answered:
+                    if rid and rid != mr.replica_id:
+                        self.record_event(
+                            "adopt_identity_mismatch",
+                            mr.replica_id,
+                            found=rid,
+                        )
+                        logger.warning(
+                            "port %d answers as %r, not %r; dropping "
+                            "adoption (not signalling a stranger)",
+                            mr.port,
+                            rid,
+                            mr.replica_id,
+                        )
+                        mr.state = "failed"
+                        self.pool.remove(mr.url)
+                        if mr in self.replicas:
+                            self.replicas.remove(mr)
+                        self._persist_gone(mr.replica_id)
+                        self._note_crash()
+                        return
+                    self.record_event("adopt_verified", mr.replica_id)
+                    return
+                await asyncio.sleep(
+                    min(0.5, max(self.check_interval / 2, 0.05))
+                )
+        except asyncio.CancelledError:
+            raise
+        if mr.state != "ready":
+            return
+        # Grace window expired with the pid alive but /health mute:
+        # whatever is running is not servable — reap our pid, respawn.
+        mr.state = "failed"
+        self.record_event(
+            "adopt_verify_timeout", mr.replica_id, timeout=verify_window
+        )
+        logger.error(
+            "re-adopted replica %s never verified within %.0fs; reaping",
+            mr.replica_id,
+            verify_window,
+        )
+        self.pool.remove(mr.url)
+        await self._reap(mr)
+        if mr in self.replicas:
+            self.replicas.remove(mr)
+        self._persist_gone(mr.replica_id)
+        self._note_crash()
+
     # ---- spawn + health-gated warmup ----
     def _spawn_one(self, role: str = "mixed") -> ManagedReplica:
         self._seq += 1
@@ -461,6 +735,7 @@ class ReplicaManager:
             role=role,
             pid=getattr(handle, "pid", None),
         )
+        self._persist_replica(mr)
         mr.task = asyncio.get_running_loop().create_task(
             self._warmup_gate(mr)
         )
@@ -532,6 +807,7 @@ class ReplicaManager:
         await self._reap(mr)
         if mr in self.replicas:
             self.replicas.remove(mr)
+        self._persist_gone(mr.replica_id)
         self._note_crash()
 
     # ---- scale-down: drain, then terminate, then reap ----
@@ -606,6 +882,7 @@ class ReplicaManager:
         self.record_event("stopped", mr.replica_id, exit_code=mr.exit_code)
         if mr in self.replicas:
             self.replicas.remove(mr)
+        self._persist_gone(mr.replica_id)
 
     async def _reap(self, mr: ManagedReplica) -> None:
         """TERM, bounded wait, KILL, synchronous reap.  Nothing returns
@@ -698,6 +975,7 @@ class ReplicaManager:
             self.record_event(
                 "stopped", mr.replica_id, exit_code=mr.exit_code
             )
+            self._persist_gone(mr.replica_id)
         self.replicas.clear()
         if self.metrics is not None:
             self.metrics.update_fleet(self)

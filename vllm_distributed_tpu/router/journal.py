@@ -55,6 +55,40 @@ class ChoiceState:
         if finish_reason is not None:
             self.finish_reason = finish_reason
 
+    # ---- durable state (ISSUE 17) ----
+    def to_dict(self) -> dict:
+        return {
+            "index": self.index,
+            "prompt": self.prompt,
+            "prompt_token_ids": (
+                list(self.prompt_token_ids)
+                if self.prompt_token_ids is not None
+                else None
+            ),
+            "emitted_token_ids": list(self.emitted_token_ids),
+            "forwarded_text_len": self.forwarded_text_len,
+            "finish_reason": self.finish_reason,
+            "role_sent": self.role_sent,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChoiceState":
+        return cls(
+            index=int(d["index"]),
+            prompt=d.get("prompt"),
+            prompt_token_ids=(
+                [int(t) for t in d["prompt_token_ids"]]
+                if d.get("prompt_token_ids") is not None
+                else None
+            ),
+            emitted_token_ids=[
+                int(t) for t in d.get("emitted_token_ids") or ()
+            ],
+            forwarded_text_len=int(d.get("forwarded_text_len") or 0),
+            finish_reason=d.get("finish_reason"),
+            role_sent=bool(d.get("role_sent")),
+        )
+
 
 def _normalize_prompts(body: dict) -> list[tuple[str | None, list[int] | None]]:
     """The completions prompt forms (str | [str] | [int] | [[int]]),
@@ -162,6 +196,44 @@ class RouterJournal:
 
     def unfinished(self) -> list[ChoiceState]:
         return [c for c in self.choices.values() if not c.finished]
+
+    # ---- durable state (ISSUE 17) ----
+    def to_dict(self) -> dict:
+        """Checkpoint form for the router WAL: everything a restarted
+        router needs to finish this request bit-identically via
+        ``resume_payload`` — the original body, per-choice cumulative
+        progress, and the client-visible identity."""
+        return {
+            "request_id": self.request_id,
+            "kind": self.kind,
+            "body": self.body,
+            "stream": self.stream,
+            "upstream_id": self.upstream_id,
+            "model": self.model,
+            "migrations": self.migrations,
+            "served_by": self.served_by,
+            "slo_class": self.slo_class,
+            "choices": [
+                self.choices[i].to_dict() for i in sorted(self.choices)
+            ],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RouterJournal":
+        j = cls(str(d["request_id"]), str(d["kind"]), dict(d["body"]))
+        j.stream = bool(d.get("stream"))
+        j.upstream_id = d.get("upstream_id")
+        j.model = d.get("model")
+        j.migrations = int(d.get("migrations") or 0)
+        j.served_by = d.get("served_by")
+        j.slo_class = d.get("slo_class")
+        # Checkpointed choices replace the freshly-derived skeleton —
+        # the checkpoint knows learned prompt ids and emitted progress
+        # the body alone can't reconstruct.
+        for cd in d.get("choices") or ():
+            c = ChoiceState.from_dict(cd)
+            j.choices[c.index] = c
+        return j
 
     # ---- migration ----
     def resume_payload(self, choice: ChoiceState) -> dict:

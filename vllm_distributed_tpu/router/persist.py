@@ -1,0 +1,463 @@
+"""Durable router control-plane state (ISSUE 17 tentpole).
+
+A bounded write-ahead log under ``VDT_ROUTER_STATE_DIR`` recording the
+three things a restarted router cannot rebuild from thin air:
+
+* **fleet membership** — replica id/port/role/pid and the launch
+  template, so the new router can re-adopt still-running supervised
+  children instead of leaking or double-spawning them;
+* **in-flight request journals** — per-request :class:`RouterJournal`
+  checkpoints (prompt ids + emitted tokens), so interrupted
+  generations finish bit-identically when their clients reconnect;
+* **QoS/placement config and fleet scale targets** — the knob snapshot
+  the scheduling state was built under (so recovery can detect a config
+  flip) and the operator's last runtime scale intent (so a crash does
+  not undo a scale-up by reverting to the CLI default).
+
+Format: one JSONL record per line, each line ``<crc32-hex8> <json>\n``
+with the checksum taken over the JSON bytes.  Recovery replays
+segments in sequence order and stops at the first record that fails
+the checksum or JSON parse — a torn tail (router killed mid-write) is
+truncated, never loaded.  The log stays bounded by compaction: when
+the live segment passes ``VDT_ROUTER_STATE_SEGMENT_BYTES`` the current
+state (live membership + config + live journals) is rewritten into a
+fresh segment via write-to-temp / fsync / atomic rename, and old
+segments are deleted.
+
+Durability is tiered: membership records fsync immediately (losing one
+means leaking a child), journal checkpoints fsync at a bounded cadence
+(``VDT_ROUTER_STATE_FSYNC_INTERVAL_SECONDS``) — a crash can cost at
+most that window of token progress, which the resumed stream simply
+re-emits and the reconnecting client trims.
+
+Everything here is synchronous file I/O on the router's event loop;
+every operation is a bounded number of writes (no waits, no retries —
+a failing disk surfaces as an exception, not a hang).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zlib
+from dataclasses import dataclass, field
+
+from vllm_distributed_tpu import envs
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.router.journal import RouterJournal
+
+logger = init_logger(__name__)
+
+WAL_VERSION = 1
+_SEG_PREFIX = "wal."
+_SEG_SUFFIX = ".log"
+
+
+# ---------------------------------------------------------------------------
+# record codec — pure helpers, used directly by the torn-write tests
+# ---------------------------------------------------------------------------
+
+
+def encode_record(rec: dict) -> bytes:
+    """``<crc32 of payload, 8 hex chars> <compact json>\n``."""
+    payload = json.dumps(
+        rec, separators=(",", ":"), sort_keys=True
+    ).encode("utf-8")
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    return b"%08x " % crc + payload + b"\n"
+
+
+def decode_segment(data: bytes) -> list[dict]:
+    """Decode a WAL segment, stopping at the first torn or corrupt
+    record.  A trailing line without a newline is by definition torn
+    (the writer appends the newline in the same write), and any line
+    whose checksum or JSON fails is treated as the start of garbage —
+    nothing after it is trusted."""
+    records: list[dict] = []
+    start = 0
+    n = len(data)
+    while start < n:
+        nl = data.find(b"\n", start)
+        if nl < 0:
+            break  # torn tail: no newline ever made it to disk
+        line = data[start:nl]
+        start = nl + 1
+        if len(line) < 10 or line[8:9] != b" ":
+            break
+        try:
+            crc = int(line[:8], 16)
+        except ValueError:
+            break
+        payload = line[9:]
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            break
+        try:
+            rec = json.loads(payload)
+        except ValueError:
+            break
+        if not isinstance(rec, dict):
+            break
+        records.append(rec)
+    return records
+
+
+def _segment_seq(name: str) -> int | None:
+    if not (name.startswith(_SEG_PREFIX) and name.endswith(_SEG_SUFFIX)):
+        return None
+    mid = name[len(_SEG_PREFIX) : -len(_SEG_SUFFIX)]
+    try:
+        return int(mid)
+    except ValueError:
+        return None
+
+
+def _segment_name(seq: int) -> str:
+    return f"{_SEG_PREFIX}{seq:08d}{_SEG_SUFFIX}"
+
+
+def _list_segments(state_dir: str) -> list[tuple[int, str]]:
+    out: list[tuple[int, str]] = []
+    try:
+        names = os.listdir(state_dir)
+    except FileNotFoundError:
+        return out
+    for name in names:
+        seq = _segment_seq(name)
+        if seq is not None:
+            out.append((seq, os.path.join(state_dir, name)))
+    out.sort()
+    return out
+
+
+def _fsync_dir(path: str) -> None:
+    """Make a rename/create in ``path`` durable.  Best-effort: some
+    filesystems refuse directory fsync; the segment data itself is
+    already fsync'd, so the worst case is replaying the prior segment."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+# ---------------------------------------------------------------------------
+# recovered state
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RecoveredState:
+    """What replaying the WAL yields: the mirrors a restarted router
+    rebuilds its control plane from."""
+
+    replicas: dict[str, dict] = field(default_factory=dict)
+    journals: dict[str, dict] = field(default_factory=dict)
+    config: dict | None = None
+    # Fleet scale targets at crash time — the operator's last runtime
+    # intent.  A restart must not undo a scale-up by reverting to the
+    # CLI --fleet-size default.
+    fleet_target: int | None = None
+    fleet_role_targets: dict[str, int] | None = None
+
+    @property
+    def empty(self) -> bool:
+        return not self.replicas and not self.journals
+
+
+def _replay(records: list[dict], state: RecoveredState) -> None:
+    for rec in records:
+        t = rec.get("t")
+        if t == "replica":
+            rid = rec.get("id")
+            if isinstance(rid, str) and rid:
+                state.replicas[rid] = {
+                    k: rec.get(k)
+                    for k in ("id", "port", "pid", "role", "template")
+                }
+        elif t == "replica_gone":
+            state.replicas.pop(rec.get("id"), None)
+        elif t == "journal":
+            rid = rec.get("rid")
+            j = rec.get("j")
+            if isinstance(rid, str) and isinstance(j, dict):
+                state.journals[rid] = j  # latest checkpoint wins
+        elif t == "journal_done":
+            state.journals.pop(rec.get("rid"), None)
+        elif t == "config":
+            cfg = rec.get("cfg")
+            if isinstance(cfg, dict):
+                state.config = cfg
+        elif t == "fleet":
+            target = rec.get("target")
+            if isinstance(target, int) and target >= 0:
+                state.fleet_target = target
+            roles = rec.get("roles")
+            if isinstance(roles, dict):
+                state.fleet_role_targets = {
+                    str(k): int(v)
+                    for k, v in roles.items()
+                    if isinstance(v, int) and v >= 0
+                }
+        # unknown types skipped: forward-compatible replay
+
+
+def load_state(state_dir: str) -> RecoveredState:
+    """Read-only replay of every segment in sequence order.  Safe to
+    call on a live or dead router's state dir (the chaos harness reads
+    the WAL of a SIGKILLed router this way)."""
+    state = RecoveredState()
+    for _seq, path in _list_segments(state_dir):
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            logger.warning("router WAL: cannot read %s: %s", path, e)
+            continue
+        _replay(decode_segment(data), state)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# writer
+# ---------------------------------------------------------------------------
+
+
+class RouterStateLog:
+    """Append-side of the WAL.  One instance per router process;
+    ``open()`` replays any prior state, compacts it into a fresh
+    segment, and returns it — callers then feed membership / journal /
+    config events as they happen."""
+
+    def __init__(
+        self,
+        state_dir: str,
+        *,
+        segment_bytes: int | None = None,
+        fsync_interval: float | None = None,
+        ckpt_interval: float | None = None,
+        clock=time.monotonic,
+    ) -> None:
+        self.state_dir = state_dir
+        self.segment_bytes = int(
+            segment_bytes
+            if segment_bytes is not None
+            else envs.VDT_ROUTER_STATE_SEGMENT_BYTES
+        )
+        self.fsync_interval = float(
+            fsync_interval
+            if fsync_interval is not None
+            else envs.VDT_ROUTER_STATE_FSYNC_INTERVAL_SECONDS
+        )
+        self.ckpt_interval = float(
+            ckpt_interval
+            if ckpt_interval is not None
+            else envs.VDT_ROUTER_STATE_CKPT_INTERVAL_SECONDS
+        )
+        self._clock = clock
+        self._f = None
+        self._seq = 0
+        self._size = 0
+        self._last_fsync = 0.0
+        self._dirty = False
+        # In-memory mirrors of live state, for compaction snapshots.
+        self._replicas: dict[str, dict] = {}
+        self._journals: dict[str, dict] = {}
+        self._config: dict | None = None
+        self._fleet: dict | None = None
+        self._last_ckpt: dict[str, float] = {}
+
+    # ---- lifecycle ----
+    def open(self) -> RecoveredState:
+        os.makedirs(self.state_dir, exist_ok=True)
+        segments = _list_segments(self.state_dir)
+        recovered = load_state(self.state_dir)
+        self._replicas = dict(recovered.replicas)
+        self._journals = dict(recovered.journals)
+        self._config = recovered.config
+        if recovered.fleet_target is not None:
+            self._fleet = {
+                "target": recovered.fleet_target,
+                "roles": dict(recovered.fleet_role_targets or {}),
+            }
+        self._seq = (segments[-1][0] + 1) if segments else 0
+        # Start this incarnation on a freshly-compacted segment so a
+        # crash loop can't accrete segments.
+        self._write_snapshot_segment(self._seq)
+        for _seq, path in segments:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        path = os.path.join(self.state_dir, _segment_name(self._seq))
+        self._f = open(path, "ab")
+        self._size = os.path.getsize(path)
+        self._last_fsync = self._clock()
+        return recovered
+
+    def close(self) -> None:
+        if self._f is None:
+            return
+        try:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        except OSError:
+            pass
+        self._f.close()
+        self._f = None
+
+    @property
+    def closed(self) -> bool:
+        return self._f is None
+
+    # ---- event surface ----
+    def record_replica(
+        self,
+        replica_id: str,
+        *,
+        port: int,
+        pid: int | None,
+        role: str = "mixed",
+        template: str | None = None,
+    ) -> None:
+        rec = {
+            "t": "replica",
+            "id": replica_id,
+            "port": port,
+            "pid": pid,
+            "role": role,
+            "template": template,
+        }
+        self._replicas[replica_id] = {
+            k: rec[k] for k in ("id", "port", "pid", "role", "template")
+        }
+        self._append(rec, durable=True)
+
+    def record_replica_gone(self, replica_id: str) -> None:
+        self._replicas.pop(replica_id, None)
+        self._append({"t": "replica_gone", "id": replica_id}, durable=True)
+
+    def record_config(self, cfg: dict) -> None:
+        self._config = dict(cfg)
+        self._append({"t": "config", "cfg": self._config}, durable=True)
+
+    def record_fleet_targets(
+        self, target: int, role_targets: dict[str, int] | None = None
+    ) -> None:
+        """Durably record the fleet scale targets — control-plane state
+        a restart must honor (a crash must not undo a scale-up)."""
+        self._fleet = {
+            "target": int(target),
+            "roles": {k: int(v) for k, v in (role_targets or {}).items()},
+        }
+        self._append({"t": "fleet", **self._fleet}, durable=True)
+
+    def checkpoint_journal(
+        self, journal: RouterJournal, *, force: bool = False
+    ) -> bool:
+        """Record the request's cumulative progress.  Rate-limited per
+        request (full-journal records per token would make the WAL
+        quadratic in stream length); ``force`` bypasses the limiter for
+        admission and terminal checkpoints."""
+        rid = journal.request_id
+        now = self._clock()
+        if not force:
+            last = self._last_ckpt.get(rid)
+            if last is not None and now - last < self.ckpt_interval:
+                return False
+        self._last_ckpt[rid] = now
+        j = journal.to_dict()
+        self._journals[rid] = j
+        self._append({"t": "journal", "rid": rid, "j": j}, durable=force)
+        return True
+
+    def journal_done(self, request_id: str) -> None:
+        if request_id not in self._journals:
+            return
+        self._journals.pop(request_id, None)
+        self._last_ckpt.pop(request_id, None)
+        self._append({"t": "journal_done", "rid": request_id})
+
+    # ---- write path ----
+    def _append(self, rec: dict, durable: bool = False) -> None:
+        if self._f is None:
+            return
+        buf = encode_record(rec)
+        try:
+            self._f.write(buf)
+            self._f.flush()
+        except OSError as e:
+            logger.error("router WAL: append failed: %s", e)
+            return
+        self._size += len(buf)
+        self._dirty = True
+        now = self._clock()
+        if durable or now - self._last_fsync >= self.fsync_interval:
+            self._fsync(now)
+        if self._size > self.segment_bytes:
+            self._rotate()
+
+    def _fsync(self, now: float) -> None:
+        if self._f is None or not self._dirty:
+            return
+        try:
+            os.fsync(self._f.fileno())
+        except OSError as e:
+            logger.error("router WAL: fsync failed: %s", e)
+            return
+        self._last_fsync = now
+        self._dirty = False
+
+    def _snapshot_records(self) -> list[dict]:
+        recs: list[dict] = [{"t": "meta", "version": WAL_VERSION}]
+        for r in self._replicas.values():
+            recs.append({"t": "replica", **r})
+        if self._config is not None:
+            recs.append({"t": "config", "cfg": self._config})
+        if self._fleet is not None:
+            recs.append({"t": "fleet", **self._fleet})
+        for rid, j in self._journals.items():
+            recs.append({"t": "journal", "rid": rid, "j": j})
+        return recs
+
+    def _write_snapshot_segment(self, seq: int) -> None:
+        """Compacted snapshot → ``.tmp`` → fsync → atomic rename.  A
+        crash at any point leaves either the old segments or a complete
+        new one, never a half-written replacement."""
+        path = os.path.join(self.state_dir, _segment_name(seq))
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            for rec in self._snapshot_records():
+                f.write(encode_record(rec))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(self.state_dir)
+
+    def _rotate(self) -> None:
+        old_seq, new_seq = self._seq, self._seq + 1
+        try:
+            self._write_snapshot_segment(new_seq)
+        except OSError as e:
+            # Keep appending to the oversized segment rather than lose
+            # durability — rotation retries on the next append.
+            logger.error("router WAL: rotation failed: %s", e)
+            return
+        if self._f is not None:
+            self._f.close()
+        old_path = os.path.join(self.state_dir, _segment_name(old_seq))
+        try:
+            os.remove(old_path)
+        except OSError:
+            pass
+        new_path = os.path.join(self.state_dir, _segment_name(new_seq))
+        self._f = open(new_path, "ab")
+        self._seq = new_seq
+        self._size = os.path.getsize(new_path)
+        self._last_fsync = self._clock()
+        self._dirty = False

@@ -41,7 +41,11 @@ _LOAD_GAUGES = (
 class Replica:
     url: str  # base URL, no trailing slash
     replica_id: str = ""  # learned from /health; url until then
-    state: str = "unknown"  # healthy|recovering|draining|drained|dead|unreachable|unknown
+    # healthy|recovering|draining|drained|dead|unreachable|unknown, plus
+    # the router-local "verifying" (ISSUE 17): a re-adopted replica in
+    # its post-recovery grace window — kept out of placement but immune
+    # to transport-failure ejection until the window expires.
+    state: str = "unknown"
     # Disaggregation role (ISSUE 15), learned from the /health body (or
     # pinned by the fleet manager at spawn): "prefill" replicas only
     # take the router's prefill-only hand-off hops; "decode"/"mixed"
@@ -54,6 +58,15 @@ class Replica:
     consecutive_failures: int = 0
     last_error: str = ""
     last_probe_mono: float = 0.0
+    # Monotonic deadline of the "verifying" grace window; 0 = none.
+    verify_deadline_mono: float = 0.0
+
+    @property
+    def verifying(self) -> bool:
+        return (
+            self.state == "verifying"
+            and time.monotonic() < self.verify_deadline_mono
+        )
 
     def __post_init__(self) -> None:
         self.url = self.url.rstrip("/")
@@ -146,11 +159,19 @@ class ReplicaPool:
         replica_id: str = "",
         state: str = "unknown",
         role: str = "mixed",
+        verify_window: float = 0.0,
     ) -> Replica | None:
         """Add a replica URL (idempotent).  The fleet manager passes
         ``state="healthy"`` after its health-gated warmup so a fresh
         replica is routable immediately instead of waiting a poll tick,
         and pins the role it spawned the replica with.
+
+        ``verify_window`` > 0 (recovery re-adoption, ISSUE 17) enters
+        the replica in the ``verifying`` state instead: not routable
+        until a probe confirms it, but transport-level probe failures
+        inside the window keep it verifying (with faster re-probes)
+        rather than declaring it unreachable — a router restart storm
+        must not mass-eject a fleet that is briefly slow to answer.
         """
         url = url.rstrip("/")
         if not url:
@@ -158,9 +179,15 @@ class ReplicaPool:
         existing = self.by_url(url)
         if existing is not None:
             return existing
+        if verify_window > 0:
+            state = "verifying"
         replica = Replica(
             url=url, replica_id=replica_id, state=state, role=role
         )
+        if verify_window > 0:
+            replica.verify_deadline_mono = (
+                time.monotonic() + verify_window
+            )
         self.replicas.append(replica)
         return replica
 
@@ -241,6 +268,7 @@ class ReplicaPool:
                     replica.state = "healthy"
                     replica.consecutive_failures = 0
                     replica.last_error = ""
+                    replica.verify_deadline_mono = 0.0
                     rid = (body or {}).get("replica_id")
                     if rid:
                         replica.replica_id = str(rid)
@@ -264,6 +292,16 @@ class ReplicaPool:
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001 — any transport failure = unreachable
+            if replica.verifying:
+                # Grace window (ISSUE 17): a just-re-adopted replica
+                # may be slow to answer while the whole fleet and the
+                # restarted router come up together.  Remember the
+                # error, stay in "verifying", and let the (faster)
+                # re-probes decide; only a window expiry or an explicit
+                # /health verdict can eject it.
+                replica.consecutive_failures += 1
+                replica.last_error = f"{type(e).__name__}: {e}"
+                return
             self.note_unreachable(replica, f"{type(e).__name__}: {e}")
             return
         if replica.state != "healthy":
@@ -324,6 +362,17 @@ class ReplicaPool:
             self._poll_loop(session)
         )
 
+    def _next_interval(self) -> float:
+        """Poll cadence: normally ``health_interval``, but while any
+        replica is inside its ``verifying`` grace window, re-probe on a
+        faster (still bounded — never below 0.2s) cadence so adoption
+        confirms in a fraction of the window instead of one poll tick
+        per attempt.  Per-probe jitter in ``probe_all`` spreads the
+        storm."""
+        if any(r.verifying for r in self.replicas):
+            return max(self.health_interval / 4.0, 0.2)
+        return self.health_interval
+
     async def _poll_loop(self, session) -> None:
         while not self._stopped.is_set():
             try:
@@ -334,7 +383,7 @@ class ReplicaPool:
                 logger.exception("replica health poll failed")
             try:
                 await asyncio.wait_for(
-                    self._stopped.wait(), timeout=self.health_interval
+                    self._stopped.wait(), timeout=self._next_interval()
                 )
             except asyncio.TimeoutError:
                 continue

@@ -78,6 +78,17 @@ class QosRouterPolicy:
     def active(self) -> bool:
         return self.registry.enabled and self.placement != "shared"
 
+    def config_fingerprint(self) -> dict:
+        """Stable summary of the placement-relevant config, recorded in
+        the router WAL (ISSUE 17) so a restart can detect that the
+        scheduling state it recovered was built under different QoS
+        knobs (recovery logs the flip instead of silently mixing)."""
+        classes: dict[str, float] = {}
+        if self.registry.enabled:
+            for name in self.registry.class_names():
+                classes[name] = self.registry.classes[name].admission_share
+        return {"placement": self.placement, "classes": classes}
+
     def filter(self, replicas: list, slo_class: str | None) -> list:
         """Restrict ``replicas`` (routable candidates) for a class.
         Returns the input list object untouched when inactive."""
