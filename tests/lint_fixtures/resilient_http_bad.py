@@ -1,0 +1,25 @@
+"""VDT010 positive corpus: raw session HTTP calls in router/ that
+bypass the resilience wrapper.  Parsed, never imported."""
+
+
+async def unary(state, url):
+    async with state.session.get(url) as resp:  # EXPECT
+        return await resp.json()
+
+
+async def post_json(state, url, payload):
+    resp = await state.session.post(url, json=payload)  # EXPECT
+    return resp.status
+
+
+class Probe:
+    async def health(self, url, timeout):
+        return await self.session.request("GET", url, timeout=timeout)  # EXPECT
+
+
+async def websocket(session, url):
+    return await session.ws_connect(url)  # EXPECT
+
+
+async def private_session(self, url):
+    return await self._kv_session.put(url, data=b"")  # EXPECT

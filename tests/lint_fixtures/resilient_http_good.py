@@ -1,0 +1,31 @@
+"""VDT010 negative corpus: wrapped, waived, or non-session calls that
+must produce zero NEW resilient-http findings.  Parsed, never
+imported."""
+
+
+async def wrapped_unary(state, rz, url):
+    # The wrapper itself: the session is an argument, not the receiver.
+    async with await rz.request(
+        state.session, "GET", url, endpoint="health"
+    ) as resp:
+        return await resp.json()
+
+
+async def hedged_read(rz, fetch):
+    return await rz.hedged("metrics", None, fetch)
+
+
+async def waived_bootstrap(state, url):
+    # A probe that runs before the manager exists carries the reason.
+    async with state.session.get(url) as resp:  # vdt-lint: disable=resilient-http — bootstrap probe predates the resilience manager
+        return resp.status
+
+
+def not_http(cache, url):
+    # dict.get on a non-session receiver is not an outbound call.
+    return cache.get(url)
+
+
+async def other_client(downloader, url):
+    # Receiver does not look like an aiohttp session.
+    return await downloader.get(url)

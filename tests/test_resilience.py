@@ -1,0 +1,699 @@
+"""Resilient DCN data-plane suite (ISSUE 19).
+
+Pure state-machine units on synthetic clocks — breaker transitions,
+retry-budget amplification bounds, adaptive-deadline clamps, hedge
+outcomes with an injected sleep (no real timers) — plus the default-off
+A/B pins: with no resilience env set the manager is disabled and
+``request()`` is a pure passthrough that preserves the caller's timeout
+object, hedging runs its factory exactly once, and the KV-transfer
+begin frame carries no ``resume_from``.  The resumable-transfer
+protocol and the inbound ``/internal/kv`` frame-size bound are pinned
+over the real replica HTTP surface with mock-uniproc engines.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import aiohttp
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from tests.mock_worker import MockUniProcExecutor
+from vllm_distributed_tpu.config import EngineArgs
+from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+from vllm_distributed_tpu.entrypoints.openai.api_server import (
+    build_app,
+    init_app_state,
+)
+from vllm_distributed_tpu.router.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BreakerOpen,
+    CircuitBreaker,
+    LatencyTracker,
+    ResilienceConfig,
+    ResilienceManager,
+)
+from vllm_distributed_tpu.testing import write_llama_config
+
+pytestmark = pytest.mark.resilience
+
+PAGE = 16
+
+# Every resilience knob, for the clean-env A/B fixtures.
+RESILIENCE_ENVS = [
+    "VDT_ROUTER_BREAKER_FAILURES",
+    "VDT_ROUTER_BREAKER_COOLDOWN_SECONDS",
+    "VDT_ROUTER_BREAKER_TIMEOUT_RATE",
+    "VDT_ROUTER_BREAKER_WINDOW_SECONDS",
+    "VDT_ROUTER_RETRY_BUDGET_RATIO",
+    "VDT_ROUTER_RETRY_BUDGET_MIN",
+    "VDT_ROUTER_ADAPTIVE_DEADLINE",
+    "VDT_ROUTER_DEADLINE_FLOOR_SECONDS",
+    "VDT_ROUTER_DEADLINE_CEILING_SECONDS",
+    "VDT_ROUTER_DEADLINE_MULTIPLIER",
+    "VDT_ROUTER_HEDGE",
+    "VDT_ROUTER_HEDGE_MIN_DELAY_MS",
+    "VDT_ROUTER_KV_CHUNK_RETRIES",
+]
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 1000.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+async def _noop_sleep(_delay: float) -> None:
+    return None
+
+
+def _mgr(clock=None, **cfg_kw) -> ResilienceManager:
+    return ResilienceManager(
+        ResilienceConfig(**cfg_kw),
+        clock=clock or FakeClock(),
+        sleep=_noop_sleep,
+    )
+
+
+# ---------------------------------------------------------------------
+# circuit breaker state machine (synthetic clock)
+# ---------------------------------------------------------------------
+def test_breaker_trips_cools_probes_and_closes():
+    clk = FakeClock()
+    br = CircuitBreaker(
+        failures=3, cooldown=5.0, timeout_rate=0.0, window=30.0, clock=clk
+    )
+    assert br.state == CLOSED and br.acquire()
+    br.record_failure(timeout=False)
+    br.record_failure(timeout=False)
+    assert br.state == CLOSED  # two of three
+    br.record_failure(timeout=True)
+    assert br.state == OPEN
+    # Rejections during cooldown never extend it.
+    assert not br.acquire()
+    clk.advance(4.9)
+    assert not br.acquire() and not br.can_route()
+    clk.advance(0.2)  # past the cooldown armed at the trip
+    assert br.can_route()
+    assert br.acquire()  # THE half-open probe
+    assert br.state == HALF_OPEN
+    assert not br.acquire()  # single probe: second caller rejected
+    br.record_success()
+    assert br.state == CLOSED and br.acquire()
+
+
+def test_breaker_probe_failure_reopens_with_fresh_cooldown():
+    clk = FakeClock()
+    br = CircuitBreaker(
+        failures=1, cooldown=5.0, timeout_rate=0.0, window=30.0, clock=clk
+    )
+    br.record_failure(timeout=False)
+    assert br.state == OPEN
+    clk.advance(5.0)
+    assert br.acquire() and br.state == HALF_OPEN
+    br.record_failure(timeout=True)
+    assert br.state == OPEN
+    clk.advance(4.0)
+    assert not br.acquire()  # the re-trip re-armed the full cooldown
+    clk.advance(1.1)
+    assert br.acquire()
+    br.record_success()
+    assert br.state == CLOSED
+
+
+def test_breaker_success_resets_consecutive_count():
+    clk = FakeClock()
+    br = CircuitBreaker(
+        failures=3, cooldown=5.0, timeout_rate=0.0, window=30.0, clock=clk
+    )
+    for _ in range(4):
+        br.record_failure(timeout=False)
+        br.record_failure(timeout=False)
+        br.record_success()
+    assert br.state == CLOSED
+
+
+def test_breaker_timeout_rate_trip_needs_min_samples_and_window():
+    clk = FakeClock()
+    br = CircuitBreaker(
+        failures=0, cooldown=5.0, timeout_rate=0.5, window=30.0, clock=clk
+    )
+    # Nine timeouts: below the 10-sample floor, no trip.
+    for _ in range(9):
+        br.record_failure(timeout=True)
+    assert br.state == CLOSED
+    br.record_failure(timeout=True)  # tenth sample, rate 1.0 >= 0.5
+    assert br.state == OPEN
+
+    # Events older than the window are pruned before the rate check.
+    clk2 = FakeClock()
+    br2 = CircuitBreaker(
+        failures=0, cooldown=5.0, timeout_rate=0.5, window=30.0, clock=clk2
+    )
+    for _ in range(9):
+        br2.record_failure(timeout=True)
+    clk2.advance(31.0)
+    for _ in range(9):
+        br2.record_failure(timeout=False)
+    assert br2.state == CLOSED  # stale timeouts gone; rate now 0/9
+    br2.record_failure(timeout=False)
+    assert br2.state == CLOSED
+
+
+def test_breaker_placement_filter_and_forget():
+    clk = FakeClock()
+    mgr = _mgr(clock=clk, breaker_failures=1, breaker_cooldown=5.0)
+    assert mgr.replica_available("r1")  # no breaker yet
+    br = mgr._breaker("r1")
+    br.record_failure(timeout=False)
+    assert not mgr.replica_available("r1")
+    clk.advance(5.0)
+    assert mgr.replica_available("r1")  # cooldown elapsed: probeable
+    mgr.forget_replica("r1")
+    assert mgr.breakers == {} and mgr.replica_available("r1")
+
+
+# ---------------------------------------------------------------------
+# retry budget: granted <= min + ratio * attempts over any horizon
+# ---------------------------------------------------------------------
+def test_budget_off_always_grants():
+    mgr = _mgr()  # ratio 0 = off
+    assert all(mgr.try_spend_retry() for _ in range(1000))
+    assert mgr.retries_denied == 0
+
+
+def test_budget_amplification_bound_holds():
+    mgr = _mgr(retry_ratio=0.2, retry_min=5.0)
+    # No attempts yet: only the fixed reserve is spendable.
+    granted = sum(1 for _ in range(50) if mgr.try_spend_retry())
+    assert granted == 5
+    assert mgr.retries_denied == 45
+    # Every 10 first-attempts buy ratio*10 = 2 more retries.
+    for _ in range(10):
+        mgr.first_attempts += 1
+    assert mgr.try_spend_retry() and mgr.try_spend_retry()
+    assert not mgr.try_spend_retry()
+    assert (
+        mgr.retries_granted
+        <= mgr.cfg.retry_min + mgr.cfg.retry_ratio * mgr.first_attempts
+    )
+
+
+def test_budget_per_replica_bound_is_tighter():
+    mgr = _mgr(retry_ratio=0.5, retry_min=8.0)
+    mgr.first_attempts = 1000  # global allowance is huge
+    # Per-replica: max(1, 8/4)=2 reserve + 0.5 * replica attempts(0).
+    assert mgr.try_spend_retry("r1")
+    assert mgr.try_spend_retry("r1")
+    assert not mgr.try_spend_retry("r1")
+    # Another replica has its own reserve; replica-less spends only
+    # check the global bound.
+    assert mgr.try_spend_retry("r2")
+    assert mgr.try_spend_retry(None)
+
+
+# ---------------------------------------------------------------------
+# adaptive deadlines
+# ---------------------------------------------------------------------
+def test_latency_tracker_needs_min_samples():
+    tr = LatencyTracker()
+    for _ in range(7):
+        tr.observe(0.1)
+    assert tr.p95() is None
+    tr.observe(0.1)
+    assert tr.p95() is not None and tr.p95() >= 0.1
+
+
+def test_deadline_clamps_floor_ceiling_and_gates():
+    mgr = _mgr(
+        adaptive_deadline=True,
+        deadline_floor=1.0,
+        deadline_ceiling=4.0,
+        deadline_multiplier=3.0,
+    )
+    assert mgr.deadline("cold") is None  # no samples yet
+    for _ in range(8):
+        mgr.observe_latency("fast", 0.01)
+    assert mgr.deadline("fast") == 1.0  # 3*p95 << floor
+    for _ in range(8):
+        mgr.observe_latency("slow", 10.0)
+    assert mgr.deadline("slow") == 4.0  # clamped to ceiling
+    # Ceiling 0 falls back to the router read timeout.
+    mgr2 = _mgr(
+        adaptive_deadline=True,
+        deadline_floor=1.0,
+        deadline_ceiling=0.0,
+        read_timeout=7.0,
+    )
+    for _ in range(8):
+        mgr2.observe_latency("slow", 10.0)
+    assert mgr2.deadline("slow") == 7.0
+    # Off = None regardless of samples.
+    mgr3 = _mgr()
+    for _ in range(8):
+        mgr3.observe_latency("ep", 10.0)
+    assert mgr3.deadline("ep") is None
+
+
+# ---------------------------------------------------------------------
+# request(): passthrough identity and breaker/deadline integration
+# ---------------------------------------------------------------------
+class FakeSession:
+    """Records request() kwargs; returns or raises per script."""
+
+    def __init__(self, results=None) -> None:
+        self.calls: list[dict] = []
+        self.results = list(results or [])
+
+    async def request(self, method, url, *, timeout=None, **kw):
+        self.calls.append(
+            {"method": method, "url": url, "timeout": timeout, **kw}
+        )
+        if self.results:
+            r = self.results.pop(0)
+            if isinstance(r, Exception):
+                raise r
+            return r
+        return "resp"
+
+
+def test_from_env_clean_environment_is_disabled(monkeypatch):
+    for k in RESILIENCE_ENVS:
+        monkeypatch.delenv(k, raising=False)
+    mgr = ResilienceManager.from_env()
+    assert not mgr.enabled
+    assert not mgr.cfg.breaker_on and not mgr.cfg.budget_on
+
+
+def test_disabled_request_is_pure_passthrough():
+    mgr = _mgr()  # all defaults: disabled
+    assert not mgr.enabled
+    sess = FakeSession()
+    timeout = aiohttp.ClientTimeout(total=12.5, connect=3.0)
+    out = _run(
+        mgr.request(
+            sess, "GET", "http://r/health", endpoint="health",
+            replica_id="r1", timeout=timeout,
+        )
+    )
+    assert out == "resp"
+    # The caller's timeout OBJECT reaches the wire unchanged, and no
+    # resilience state moves — byte-identical to the pre-ISSUE router.
+    assert sess.calls[0]["timeout"] is timeout
+    assert mgr.first_attempts == 0
+    assert mgr.breakers == {} and mgr.latency == {}
+
+
+def test_enabled_request_keeps_fixed_timeout_until_adaptive_on():
+    mgr = _mgr(breaker_failures=3)  # enabled, adaptive off
+    sess = FakeSession()
+    timeout = aiohttp.ClientTimeout(total=9.0)
+    _run(
+        mgr.request(
+            sess, "GET", "http://r/health", endpoint="health",
+            replica_id="r1", timeout=timeout,
+        )
+    )
+    assert sess.calls[0]["timeout"] is timeout
+    assert mgr.first_attempts == 1
+
+
+def test_adaptive_request_replaces_unary_total_only():
+    mgr = _mgr(
+        adaptive_deadline=True, deadline_floor=2.0, deadline_ceiling=8.0
+    )
+    for _ in range(8):
+        mgr.observe_latency("health", 0.05)
+    sess = FakeSession()
+    fixed = aiohttp.ClientTimeout(total=60.0, connect=3.0)
+    _run(
+        mgr.request(
+            sess, "GET", "http://r/health", endpoint="health",
+            timeout=fixed,
+        )
+    )
+    sent = sess.calls[0]["timeout"]
+    assert sent is not fixed
+    assert sent.total == 2.0  # clamped to floor
+    assert sent.connect == 3.0  # connect survives the rebuild
+
+    # Streaming (total=None) and adaptive=False opt-outs stay fixed.
+    streaming = aiohttp.ClientTimeout(total=None, sock_read=600)
+    _run(
+        mgr.request(
+            sess, "POST", "http://r/v1/completions", endpoint="proxy",
+            timeout=streaming,
+        )
+    )
+    assert sess.calls[1]["timeout"] is streaming
+    drain = aiohttp.ClientTimeout(total=40.0)
+    _run(
+        mgr.request(
+            sess, "POST", "http://r/drain", endpoint="health",
+            adaptive=False, timeout=drain,
+        )
+    )
+    assert sess.calls[2]["timeout"] is drain
+
+
+def test_request_failures_trip_breaker_and_reject_before_io():
+    clk = FakeClock()
+    mgr = _mgr(clock=clk, breaker_failures=2, breaker_cooldown=5.0)
+
+    async def go():
+        sess = FakeSession(
+            results=[ConnectionError("boom"), ConnectionError("boom")]
+        )
+        for _ in range(2):
+            with pytest.raises(ConnectionError):
+                await mgr.request(
+                    sess, "GET", "http://r/health", endpoint="health",
+                    replica_id="r1",
+                )
+        assert mgr.breakers["r1"].state == OPEN
+        with pytest.raises(BreakerOpen):
+            await mgr.request(
+                sess, "GET", "http://r/health", endpoint="health",
+                replica_id="r1",
+            )
+        assert len(sess.calls) == 2  # the rejection never hit the wire
+        assert mgr.transitions["r1:open"] == 1
+        # Cooldown elapses: the probe goes through and closes.
+        clk.advance(5.0)
+        ok = FakeSession()
+        assert (
+            await mgr.request(
+                ok, "GET", "http://r/health", endpoint="health",
+                replica_id="r1",
+            )
+            == "resp"
+        )
+        assert mgr.breakers["r1"].state == CLOSED
+        assert mgr.transitions["r1:half_open"] == 1
+        assert mgr.transitions["r1:closed"] == 1
+
+    _run(go())
+
+
+# ---------------------------------------------------------------------
+# hedged requests (injected sleep; no real timers)
+# ---------------------------------------------------------------------
+def _warm(mgr: ResilienceManager, endpoint: str = "ep") -> None:
+    for _ in range(8):
+        mgr.observe_latency(endpoint, 0.05)
+
+
+def test_hedge_off_or_cold_runs_factory_once():
+    calls = []
+
+    async def factory():
+        calls.append(1)
+        return "v"
+
+    mgr = _mgr()  # hedge off
+    assert _run(mgr.hedged("ep", None, factory)) == "v"
+    assert len(calls) == 1
+
+    mgr2 = _mgr(hedge=True)  # on, but the endpoint is cold
+    assert _run(mgr2.hedged("cold", None, factory)) == "v"
+    assert len(calls) == 2
+
+
+def test_hedge_primary_wins_without_spending():
+    mgr = _mgr(hedge=True, retry_ratio=0.5, retry_min=4.0)
+    _warm(mgr)
+    calls = []
+
+    async def fast():
+        calls.append(1)
+        return "p"
+
+    assert _run(mgr.hedged("ep", None, fast)) == "p"
+    assert len(calls) == 1
+    assert mgr.retries_granted == 0  # no hedge fired, nothing spent
+
+
+def test_hedge_fires_after_delay_and_wins():
+    mgr = _mgr(hedge=True)  # budget off: hedges always granted
+    _warm(mgr)
+    calls = []
+
+    async def factory():
+        calls.append(len(calls))
+        if len(calls) == 1:
+            await asyncio.Event().wait()  # primary hangs; cancelled later
+        return "h"
+
+    assert _run(mgr.hedged("ep", None, factory)) == "h"
+    assert len(calls) == 2
+
+
+def test_hedge_denied_by_budget_falls_back_to_primary():
+    mgr = _mgr(hedge=True, retry_ratio=0.5, retry_min=0.0)
+    _warm(mgr)  # allowance = 0 + 0.5 * 0 attempts = 0: always denied
+    ev = asyncio.Event()
+    calls = []
+
+    async def factory():
+        calls.append(1)
+        await ev.wait()
+        return "p"
+
+    async def go():
+        task = asyncio.ensure_future(mgr.hedged("ep", None, factory))
+        for _ in range(10):
+            await asyncio.sleep(0)  # timer (no-op sleep) fires, denial lands
+        ev.set()
+        return await task
+
+    assert _run(go()) == "p"
+    assert len(calls) == 1
+    assert mgr.retries_denied == 1
+
+
+def test_hedge_survives_failed_primary():
+    """A primary that fails AFTER the hedge launched must not discard
+    a hedge that is about to succeed — the hedge's success is the
+    outcome."""
+    mgr = _mgr(hedge=True)
+    _warm(mgr)
+    primary_fail = asyncio.Event()
+    hedge_go = asyncio.Event()
+    calls = []
+
+    async def factory():
+        calls.append(len(calls))
+        if len(calls) == 1:
+            await primary_fail.wait()
+            raise ConnectionError("primary died")
+        await hedge_go.wait()
+        return "h"
+
+    async def go():
+        task = asyncio.ensure_future(mgr.hedged("ep", None, factory))
+        for _ in range(10):
+            await asyncio.sleep(0)  # timer fires, hedge launches
+        primary_fail.set()
+        for _ in range(10):
+            await asyncio.sleep(0)  # primary dies with the hedge live
+        hedge_go.set()
+        return await task
+
+    assert _run(go()) == "h"
+    assert len(calls) == 2
+
+
+def test_hedge_both_failed_raises_primary_error():
+    mgr = _mgr(hedge=True)
+    _warm(mgr)
+    go_ev = asyncio.Event()
+    calls = []
+
+    async def factory():
+        me = len(calls)
+        calls.append(me)
+        await go_ev.wait()  # hold both past the hedge launch
+        if me == 0:
+            raise ValueError("primary error")
+        raise ConnectionError("hedge error")
+
+    async def go():
+        task = asyncio.ensure_future(mgr.hedged("ep", None, factory))
+        for _ in range(10):
+            await asyncio.sleep(0)  # timer fires, hedge launches
+        go_ev.set()
+        return await task
+
+    with pytest.raises(ValueError, match="primary error"):
+        _run(go())
+    assert len(calls) == 2
+
+
+# ---------------------------------------------------------------------
+# resumable KV transfer protocol + inbound frame bound (replica surface)
+# ---------------------------------------------------------------------
+def _mk_engine(model_dir: str, **kw) -> AsyncLLM:
+    args = dict(
+        model=model_dir,
+        skip_tokenizer_init=True,
+        load_format="dummy",
+        num_kv_pages=96,
+        max_model_len=1024,
+        num_decode_steps=1,
+        enable_prefix_caching=True,
+        distributed_executor_backend=MockUniProcExecutor,
+    )
+    args.update(kw)
+    return AsyncLLM.from_engine_args(EngineArgs(**args))
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    return write_llama_config(
+        str(tmp_path_factory.mktemp("resilience") / "m")
+    )
+
+
+def test_kv_begin_resume_protocol(model_dir, monkeypatch):
+    """Default begin responses carry no resume fields (wire-identical
+    to the pre-ISSUE protocol); a resume_from begin returns the live
+    reservation's received-layer set, and a mismatched prompt or
+    unknown id is rejected with transfer_id=None."""
+    monkeypatch.setenv("VDT_MOCK_TOKEN_SEQ", "1")
+    prompt = [(i % 300) + 1 for i in range(3 * PAGE)]
+    engine = _mk_engine(model_dir)
+    state = init_app_state(engine, served_model_name="m", role="decode")
+
+    async def go():
+        client = TestClient(TestServer(build_app(state)))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/internal/kv",
+                json={"op": "begin", "prompt_token_ids": prompt},
+            )
+            begin = await r.json()
+            assert r.status == 200 and begin["transfer_id"]
+            # A/B pin: the NORMAL begin frame has exactly the
+            # pre-ISSUE keys — resume adds fields only when asked for.
+            assert set(begin) == {"transfer_id", "num_pages"}
+            tid = begin["transfer_id"]
+
+            r = await client.post(
+                "/internal/kv",
+                json={
+                    "op": "begin",
+                    "prompt_token_ids": prompt,
+                    "resume_from": tid,
+                },
+            )
+            resumed = await r.json()
+            assert r.status == 200
+            assert resumed["transfer_id"] == tid
+            assert resumed["received"] == []  # nothing landed yet
+            assert resumed["num_pages"] == len(prompt) // PAGE
+
+            # Mismatched prompt prefix: resume refused.
+            r = await client.post(
+                "/internal/kv",
+                json={
+                    "op": "begin",
+                    "prompt_token_ids": [9] * len(prompt),
+                    "resume_from": tid,
+                },
+            )
+            assert (await r.json())["transfer_id"] is None
+            # Unknown transfer id: refused, nothing implicitly created.
+            r = await client.post(
+                "/internal/kv",
+                json={
+                    "op": "begin",
+                    "prompt_token_ids": prompt,
+                    "resume_from": "kvimp-nope",
+                },
+            )
+            assert (await r.json())["transfer_id"] is None
+            # The real reservation is still live and abortable.
+            r = await client.post(
+                "/internal/kv", json={"op": "abort", "transfer_id": tid}
+            )
+            assert r.status == 200
+        finally:
+            await client.close()
+
+    try:
+        _run(go())
+    finally:
+        engine.shutdown()
+
+
+def test_kv_frame_size_bound_413(model_dir, monkeypatch):
+    """Frames above VDT_KV_MAX_FRAME_BYTES get a typed 413 before
+    buffering; frames under the bound (and any frame with the bound
+    disabled) proceed."""
+    monkeypatch.setenv("VDT_MOCK_TOKEN_SEQ", "1")
+    prompt = [(i % 300) + 1 for i in range(2 * PAGE)]
+    engine = _mk_engine(model_dir)
+    state = init_app_state(engine, served_model_name="m", role="decode")
+
+    async def go():
+        client = TestClient(TestServer(build_app(state)))
+        await client.start_server()
+        try:
+            monkeypatch.setenv("VDT_KV_MAX_FRAME_BYTES", "256")
+            r = await client.post(
+                "/internal/kv",
+                json={
+                    "op": "chunk",
+                    "transfer_id": "t",
+                    "layers": [{"pad": "x" * 4096}],
+                },
+            )
+            assert r.status == 413
+            err = await r.json()
+            assert "VDT_KV_MAX_FRAME_BYTES" in err["message"]
+            # Small frames still serve under the same bound.
+            r = await client.post(
+                "/internal/kv",
+                json={"op": "begin", "prompt_token_ids": prompt},
+            )
+            begin = await r.json()
+            assert r.status == 200 and begin["transfer_id"]
+            await client.post(
+                "/internal/kv",
+                json={"op": "abort", "transfer_id": begin["transfer_id"]},
+            )
+            # 0 disables the check entirely.
+            monkeypatch.setenv("VDT_KV_MAX_FRAME_BYTES", "0")
+            r = await client.post(
+                "/internal/kv",
+                json={
+                    "op": "chunk",
+                    "transfer_id": "t",
+                    "layers": [{"pad": "x" * 4096}],
+                },
+            )
+            assert r.status != 413
+        finally:
+            await client.close()
+
+    try:
+        _run(go())
+    finally:
+        engine.shutdown()

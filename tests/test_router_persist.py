@@ -408,12 +408,17 @@ class _FakeResp:
 
 
 class _FakeSession:
-    """session.get stub: /health answers per the script, /metrics 404s."""
+    """ClientSession stub: /health answers per the script, /metrics 404s."""
 
     def __init__(self, script):
         self.script = script  # callable url -> _FakeResp (or raises)
 
     def get(self, url, timeout=None):
+        return self.script(url)
+
+    async def request(self, method, url, timeout=None, **kw):
+        # The pool probe egresses via the resilience wrapper, whose
+        # passthrough awaits session.request (ISSUE 19).
         return self.script(url)
 
 

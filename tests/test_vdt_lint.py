@@ -41,19 +41,29 @@ RULES = {
     "orphan-span": "VDT007",
     "unbounded-queue": "VDT008",
     "bounded-cardinality": "VDT009",
+    "resilient-http": "VDT010",
+}
+
+# Rules whose scope excludes distributed/ seed into a directory where
+# they DO apply (VDT010 only checks the router's outbound data plane).
+SEED_DIRS = {
+    "resilient_http_bad.py": "router",
+    "resilient_http_good.py": "router",
 }
 
 
 def _seed(tmp_path: Path, fixture: str, transform=None) -> tuple[Path, Path]:
     """Copy one fixture into a synthetic package tree under
     ``distributed/`` (so every rule's scope applies — the acceptance
-    criterion seeds positives into distributed/)."""
+    criterion seeds positives into distributed/), or the rule's own
+    scope directory when distributed/ is outside it."""
     pkg = tmp_path / "pkg"
-    (pkg / "distributed").mkdir(parents=True, exist_ok=True)
+    subdir = SEED_DIRS.get(fixture, "distributed")
+    (pkg / subdir).mkdir(parents=True, exist_ok=True)
     text = (FIXTURES / fixture).read_text()
     if transform is not None:
         text = transform(text)
-    dest = pkg / "distributed" / fixture
+    dest = pkg / subdir / fixture
     dest.write_text(text)
     return pkg, dest
 

@@ -1,0 +1,243 @@
+"""Fault-injection TCP proxy for network chaos tests (ISSUE 19).
+
+A ``ChaosProxy`` sits between the router and one replica and shapes the
+raw byte stream the way a congested or partitioned DCN link would:
+
+- **latency/jitter**: every forwarded chunk waits a fixed base delay
+  plus a uniform jitter draw (per direction — a request pays it on the
+  way up AND the response pays it on the way down);
+- **probabilistic drop**: each forwarded chunk has ``drop_prob`` odds
+  of killing the whole connection mid-flight (an abortive close, the
+  way a flapping link actually fails — not a polite FIN);
+- **bandwidth cap**: chunk delays sized so sustained throughput never
+  exceeds ``bandwidth_bps``;
+- **full partition**: new connections are refused with an abortive
+  close and every established one is torn down — armable and healable
+  at runtime, so a soak can partition one replica mid-stream and then
+  watch the breaker walk open → half-open → closed after the heal.
+
+All randomness comes from a seeded ``random.Random`` so a chaos run is
+reproducible.  The proxy is pure asyncio (no extra deps) and is used
+in-process by ``tools/chaos_soak.py --partition``; the CLI main exists
+for poking at a live replica by hand.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import random
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+# Forwarding unit: small enough that latency/bandwidth shaping has
+# sub-chunk resolution, large enough not to dominate CPU.
+_CHUNK = 16 * 1024
+
+
+class ChaosProxy:
+    """One shapeable TCP proxy in front of one ``host:port`` target."""
+
+    def __init__(
+        self,
+        target_host: str,
+        target_port: int,
+        *,
+        listen_host: str = "127.0.0.1",
+        listen_port: int = 0,
+        seed: int = 0,
+    ) -> None:
+        self.target_host = target_host
+        self.target_port = int(target_port)
+        self.listen_host = listen_host
+        self.listen_port = int(listen_port)
+        self.rng = random.Random(seed)
+        # Fault knobs (all off = transparent forwarding).
+        self.latency_ms = 0.0
+        self.jitter_ms = 0.0
+        self.drop_prob = 0.0
+        self.bandwidth_bps = 0.0  # 0 = unlimited
+        self.partitioned = False
+        # Filled by start().
+        self.port: int | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._writers: set[asyncio.StreamWriter] = set()
+        # Counters for soak assertions.
+        self.connections_total = 0
+        self.connections_refused = 0
+        self.connections_dropped = 0
+        self.bytes_forwarded = 0
+
+    # ---- lifecycle ----
+    async def start(self) -> "ChaosProxy":
+        self._server = await asyncio.start_server(
+            self._handle, self.listen_host, self.listen_port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        self._kill_established()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.listen_host}:{self.port}"
+
+    # ---- runtime fault control ----
+    def arm(
+        self,
+        *,
+        latency_ms: float | None = None,
+        jitter_ms: float | None = None,
+        drop_prob: float | None = None,
+        bandwidth_bps: float | None = None,
+        partitioned: bool | None = None,
+    ) -> None:
+        """Set fault knobs at runtime; ``None`` leaves a knob as-is.
+        Arming a partition also tears down established connections —
+        a partition that only blocks NEW flows is not a partition."""
+        if latency_ms is not None:
+            self.latency_ms = float(latency_ms)
+        if jitter_ms is not None:
+            self.jitter_ms = float(jitter_ms)
+        if drop_prob is not None:
+            self.drop_prob = float(drop_prob)
+        if bandwidth_bps is not None:
+            self.bandwidth_bps = float(bandwidth_bps)
+        if partitioned is not None:
+            self.partitioned = bool(partitioned)
+            if self.partitioned:
+                self._kill_established()
+
+    def heal(self) -> None:
+        """Back to transparent forwarding (partition lifted, all
+        shaping off)."""
+        self.arm(
+            latency_ms=0.0,
+            jitter_ms=0.0,
+            drop_prob=0.0,
+            bandwidth_bps=0.0,
+            partitioned=False,
+        )
+
+    def _kill_established(self) -> None:
+        for w in list(self._writers):
+            try:
+                w.transport.abort()
+            except Exception:  # noqa: BLE001 — already-dead transports
+                pass
+
+    # ---- data path ----
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self.connections_total += 1
+        if self.partitioned:
+            self.connections_refused += 1
+            writer.transport.abort()
+            return
+        try:
+            up_reader, up_writer = await asyncio.open_connection(
+                self.target_host, self.target_port
+            )
+        except OSError:
+            writer.transport.abort()
+            return
+        self._writers.update((writer, up_writer))
+        up = asyncio.ensure_future(self._pump(reader, up_writer))
+        down = asyncio.ensure_future(self._pump(up_reader, writer))
+        try:
+            # Either direction ending (EOF, fault-drop, reset) tears
+            # down the whole connection abortively: a chaos link never
+            # lingers in half-closed politeness.
+            await asyncio.wait(
+                {up, down}, return_when=asyncio.FIRST_COMPLETED
+            )
+        finally:
+            up.cancel()
+            down.cancel()
+            for w in (writer, up_writer):
+                self._writers.discard(w)
+                try:
+                    w.transport.abort()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    async def _pump(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                data = await reader.read(_CHUNK)
+                if not data:
+                    return  # EOF: _handle aborts the pair
+                if self.partitioned:
+                    self.connections_dropped += 1
+                    return
+                if self.drop_prob and self.rng.random() < self.drop_prob:
+                    self.connections_dropped += 1
+                    return
+                delay = self.latency_ms / 1e3
+                if self.jitter_ms:
+                    delay += self.rng.random() * self.jitter_ms / 1e3
+                if self.bandwidth_bps:
+                    delay += len(data) / self.bandwidth_bps
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                writer.write(data)
+                await writer.drain()
+                self.bytes_forwarded += len(data)
+        except asyncio.CancelledError:
+            raise
+        except (OSError, ConnectionResetError):
+            return
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fault-injection TCP proxy (chaos harness)"
+    )
+    ap.add_argument("--target", required=True, help="host:port to front")
+    ap.add_argument("--listen-port", type=int, default=0)
+    ap.add_argument("--listen-host", default="127.0.0.1")
+    ap.add_argument("--latency-ms", type=float, default=0.0)
+    ap.add_argument("--jitter-ms", type=float, default=0.0)
+    ap.add_argument("--drop-prob", type=float, default=0.0)
+    ap.add_argument("--bandwidth-bps", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    host, _, port = args.target.rpartition(":")
+
+    async def run() -> None:
+        proxy = ChaosProxy(
+            host or "127.0.0.1",
+            int(port),
+            listen_host=args.listen_host,
+            listen_port=args.listen_port,
+            seed=args.seed,
+        )
+        proxy.arm(
+            latency_ms=args.latency_ms,
+            jitter_ms=args.jitter_ms,
+            drop_prob=args.drop_prob,
+            bandwidth_bps=args.bandwidth_bps,
+        )
+        await proxy.start()
+        print(f"chaos proxy :{proxy.port} -> {args.target}", flush=True)
+        await asyncio.Event().wait()  # Ctrl-C to stop
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -11,6 +11,7 @@
 | VDT007 | orphan-span      | spans open via ``with`` / try-finally ``.end()`` |
 | VDT008 | unbounded-queue  | queues/deques on the request path carry a bound  |
 | VDT009 | bounded-cardinality | metric labels never derive from unbounded sources |
+| VDT010 | resilient-http   | router outbound HTTP goes through the resilience wrapper |
 """
 
 from tools.vdt_lint.checkers import (  # noqa: F401
@@ -19,6 +20,7 @@ from tools.vdt_lint.checkers import (  # noqa: F401
     env_registry,
     lock_across_await,
     orphan_span,
+    resilient_http,
     silent_except,
     thread_leak,
     unbounded_queue,

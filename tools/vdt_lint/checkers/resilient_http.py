@@ -1,0 +1,87 @@
+"""VDT010 resilient-http: outbound HTTP from the router goes through
+the resilience wrapper.
+
+The ISSUE 19 failure class: a raw ``session.get(...)`` in ``router/``
+bypasses the circuit breakers, retry budget, and adaptive deadlines —
+one forgotten call site and a partitioned replica gets hammered with
+un-budgeted retries on a fixed timeout while its breaker reads healthy.
+Every aiohttp client-session verb call (``get``/``post``/``put``/
+``delete``/``head``/``patch``/``options``/``request``/``ws_connect``)
+whose receiver is a session attribute or variable must either be routed
+through ``ResilienceManager.request`` / ``hedged`` or carry an inline
+waiver naming why it cannot be (the wrapper's own passthrough line, a
+bootstrap probe that predates the manager).
+
+Receivers are matched by name: the final dotted component is
+``session`` or ends with ``_session`` (``state.session``,
+``self.session``, ``self._kv_session``).  Calling the wrapper itself
+(``rz.request(state.session, ...)``) does not match — the session is an
+argument there, not the receiver.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.vdt_lint.astutil import dotted_name
+from tools.vdt_lint.core import Checker, FileContext, Finding, register
+
+_HTTP_VERBS = {
+    "get",
+    "post",
+    "put",
+    "delete",
+    "head",
+    "patch",
+    "options",
+    "request",
+    "ws_connect",
+}
+
+
+def _session_receiver(func: ast.expr) -> str | None:
+    """Return the dotted receiver name when ``func`` is
+    ``<receiver>.<verb>`` and the receiver looks like an aiohttp
+    session; None otherwise."""
+    if not isinstance(func, ast.Attribute) or func.attr not in _HTTP_VERBS:
+        return None
+    receiver = dotted_name(func.value)
+    if receiver is None:
+        return None
+    leaf = receiver.rsplit(".", 1)[-1]
+    if leaf == "session" or leaf.endswith("_session"):
+        return receiver
+    return None
+
+
+@register
+class ResilientHttpChecker(Checker):
+    code = "VDT010"
+    rule = "resilient-http"
+    description = (
+        "raw session HTTP call in router/ bypasses the resilience wrapper"
+    )
+    rationale = (
+        "a direct session call skips circuit breakers, the retry "
+        "budget, and adaptive deadlines — route it through "
+        "ResilienceManager.request/hedged, or waive with why it "
+        "cannot be"
+    )
+    scope = ("router/",)
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            receiver = _session_receiver(node.func)
+            if receiver is None:
+                continue
+            yield ctx.finding(
+                self,
+                node,
+                f"{receiver}.{node.func.attr}() bypasses the "
+                "resilience wrapper — use "
+                "ResilienceManager.request/hedged, or waive with the "
+                "reason it cannot apply",
+            )

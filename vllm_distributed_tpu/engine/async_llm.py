@@ -781,9 +781,13 @@ class AsyncLLM:
             lambda: self.engine.kv_transfer.release(handle)
         )
 
-    async def kv_import_begin(self, token_ids: list[int]) -> dict:
+    async def kv_import_begin(
+        self, token_ids: list[int], resume_from: str | None = None
+    ) -> dict:
         return await self._run_aux(
-            lambda: self.engine.kv_transfer.begin_import(token_ids)
+            lambda: self.engine.kv_transfer.begin_import(
+                token_ids, resume_from=resume_from
+            )
         )
 
     async def kv_import_chunk(

@@ -216,14 +216,37 @@ class KVTransferManager:
             )
         return allocator
 
-    def begin_import(self, token_ids: list[int]) -> dict:
+    def begin_import(
+        self, token_ids: list[int], resume_from: str | None = None
+    ) -> dict:
         """Reserve pages for an inbound chain.  Returns transfer_id=None
         when there is nothing importable (sub-page prompt) or the pool
         cannot spare the pages — the router then skips the transfer and
-        resumes with recompute, which is always correct."""
+        resumes with recompute, which is always correct.
+
+        With ``resume_from`` (ISSUE 19) the router lost a chunk
+        round-trip and asks which layers actually landed: if the named
+        import is still live and covers the same prompt prefix, the
+        existing reservation is returned along with its ``received``
+        layer indices so the router re-pulls only the missing ones.
+        Anything else (TTL expiry, scatter-failure abort, token
+        mismatch) returns transfer_id=None and the router falls back."""
         allocator = self._allocator()
         ps = self.scheduler.page_size
         full = len(token_ids) // ps
+        if resume_from is not None:
+            imp = self.imports.get(resume_from)
+            if imp is None or imp.token_ids != list(
+                token_ids[: len(imp.token_ids)]
+            ):
+                return {"transfer_id": None, "num_pages": 0}
+            imp.deadline_mono = time.monotonic() + self.ttl
+            return {
+                "transfer_id": imp.transfer_id,
+                "num_pages": len(imp.pages),
+                "received": sorted(imp.received),
+                "num_layers": imp.num_layers,
+            }
         if full <= 0:
             return {"transfer_id": None, "num_pages": 0}
         from vllm_distributed_tpu.engine.block_manager import (

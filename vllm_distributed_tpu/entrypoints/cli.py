@@ -858,7 +858,16 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
     # samples — a 429'd or timed-out request must never pollute the
     # percentiles of the requests the server actually served.
     request_rate = getattr(args, "request_rate", None)
-    counts = {"completed": 0, "rejected": 0, "timed_out": 0, "errors": 0}
+    # "unavailable" = 503s (breaker rejections / exhausted retry
+    # budget with no live target) — a distinct outcome from generic
+    # transport errors so a resilience A/B can read them apart.
+    counts = {
+        "completed": 0,
+        "rejected": 0,
+        "timed_out": 0,
+        "unavailable": 0,
+        "errors": 0,
+    }
 
     # Piecewise rate sweep (ISSUE 13): open-loop segments with
     # per-segment accounting, the workload an autoscaler acceptance run
@@ -896,6 +905,7 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
             "completed": 0,
             "rejected": 0,
             "timed_out": 0,
+            "unavailable": 0,
             "errors": 0,
             "ttfts": [],
             "itls": [],
@@ -910,6 +920,7 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
             "completed": 0,
             "rejected": 0,
             "timed_out": 0,
+            "unavailable": 0,
             "errors": 0,
             "ttfts": [],
             "itls": [],
@@ -964,14 +975,30 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
             "vllm:prefix_cache_queries_total",
             "vllm:prefix_cache_hits_total",
         }
+        # Router resilience counters (ISSUE 19): kept split by outcome
+        # label so retries granted/denied and hedge outcomes report as
+        # separate columns.
+        labeled = {
+            "vdt_router:retries_total",
+            "vdt_router:hedges_total",
+            "vdt_router:breaker_rejections_total",
+        }
+        import re
+
         out = {}
         for line in text.splitlines():
             if line.startswith("#"):
                 continue
             parts = line.split()
-            if len(parts) == 2 and parts[0].split("{")[0] in want:
-                key = parts[0].split("{")[0]
+            if len(parts) != 2:
+                continue
+            key = parts[0].split("{")[0]
+            if key in want:
                 out[key] = out.get(key, 0.0) + float(parts[1])
+            elif key in labeled:
+                m = re.search(r'outcome="([^"]*)"', parts[0])
+                k = f"{key}|{m.group(1)}" if m else key
+                out[k] = out.get(k, 0.0) + float(parts[1])
         return out
 
     # Per-class server counters (ISSUE 12): deltas of the labeled SLO
@@ -1069,6 +1096,17 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                         seg["rejected"] += 1
                     if ten is not None:
                         ten["rejected"] += 1
+                    await resp.read()
+                    return
+                if resp.status == 503:
+                    # Breaker rejection / no routable replica (ISSUE
+                    # 19): its own outcome column, apart from generic
+                    # transport errors.
+                    counts["unavailable"] += 1
+                    if seg is not None:
+                        seg["unavailable"] += 1
+                    if ten is not None:
+                        ten["unavailable"] += 1
                     await resp.read()
                     return
                 resp.raise_for_status()
@@ -1317,6 +1355,7 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                 "completed": t["completed"],
                 "rejected": t["rejected"],
                 "timed_out": t["timed_out"],
+                "unavailable": t["unavailable"],
                 "errors": t["errors"],
                 "ttft_s": (
                     _percentiles(t["ttfts"]) if t["ttfts"] else None
@@ -1350,6 +1389,7 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                 "completed": s["completed"],
                 "rejected": s["rejected"],
                 "timed_out": s["timed_out"],
+                "unavailable": s["unavailable"],
                 "errors": s["errors"],
                 "ttft_s": _percentiles(s["ttfts"]) if s["ttfts"] else None,
                 "itl_ms": (
@@ -1447,6 +1487,32 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
             # should match the client's rejected outcome.
             "requests_rejected": delta("vllm:requests_rejected_total"),
         }
+        # Resilience columns (ISSUE 19): present whenever the scrape
+        # target exposes the router families (i.e. --url points at a
+        # router, not a bare replica).
+        if any(k.startswith("vdt_router:") for k in after):
+            result["server_metrics"]["router_resilience"] = {
+                "retries_granted": int(
+                    delta("vdt_router:retries_total|granted")
+                ),
+                "retries_denied": int(
+                    delta("vdt_router:retries_total|denied")
+                ),
+                "hedges": int(
+                    sum(
+                        delta(k)
+                        for k in set(after) | set(before)
+                        if k.startswith("vdt_router:hedges_total|")
+                        and not k.endswith("|denied")
+                    )
+                ),
+                "hedges_denied": int(
+                    delta("vdt_router:hedges_total|denied")
+                ),
+                "breaker_rejections": int(
+                    delta("vdt_router:breaker_rejections_total")
+                ),
+            }
         queries = delta("vllm:prefix_cache_queries_total")
         hits = delta("vllm:prefix_cache_hits_total")
         if queries > 0:

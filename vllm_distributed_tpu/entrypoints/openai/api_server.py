@@ -1491,12 +1491,31 @@ async def internal_kv(request: web.Request) -> web.Response:
     - ``{"op": "commit", "transfer_id"}`` → ``{"adopted_tokens"}``
     - ``{"op": "abort", "transfer_id"}``
 
+    A ``begin`` may carry ``resume_from`` (ISSUE 19): the router lost a
+    chunk round-trip and asks for the still-live reservation plus its
+    ``received`` layer indices, then re-pulls only the missing ones.
+
     A checksum mismatch or incomplete transfer answers 409 and the
     reserved pages are freed — garbage KV can never be indexed."""
     import base64
 
     state: ServerState = request.app["state"]
     engine = state.engine
+    max_frame = envs.VDT_KV_MAX_FRAME_BYTES
+    if max_frame > 0 and (request.content_length or 0) > max_frame:
+        # Typed bound checked against Content-Length BEFORE buffering
+        # the body: an oversized (or hostile) frame costs one header
+        # read, not VDT_KV_MAX_FRAME_BYTES of router-side memory.
+        return web.json_response(
+            ErrorResponse(
+                message=(
+                    f"kv frame of {request.content_length} bytes "
+                    f"exceeds VDT_KV_MAX_FRAME_BYTES={max_frame}"
+                ),
+                code=413,
+            ).model_dump(),
+            status=413,
+        )
     try:
         d = await request.json()
         op = str(d.get("op") or "")
@@ -1519,8 +1538,16 @@ async def internal_kv(request: web.Request) -> web.Response:
                     },
                 )
             token_ids = [int(t) for t in d.get("prompt_token_ids") or ()]
+            resume_from = d.get("resume_from")
             return web.json_response(
-                await engine.kv_import_begin(token_ids)
+                await engine.kv_import_begin(
+                    token_ids,
+                    resume_from=(
+                        str(resume_from)
+                        if resume_from is not None
+                        else None
+                    ),
+                )
             )
         if op == "chunk":
             tid = str(d["transfer_id"])

@@ -308,6 +308,87 @@ environment_variables: dict[str, Callable[[], Any]] = {
     "VDT_DISAGG_EXPORT_TTL_SECONDS": lambda: float(
         os.environ.get("VDT_DISAGG_EXPORT_TTL_SECONDS", "30")
     ),
+    # Inbound /internal/kv frame-size bound (bytes): chunk frames whose
+    # Content-Length exceeds it are rejected with a typed 413 BEFORE
+    # buffering, so a misconfigured (or hostile) peer can't balloon the
+    # import side's memory.  0 disables the check.
+    "VDT_KV_MAX_FRAME_BYTES": lambda: int(
+        os.environ.get("VDT_KV_MAX_FRAME_BYTES", "67108864")
+    ),
+    # --- resilient DCN data plane (ISSUE 19) ---
+    # All default-off: with none of these set, every router->replica
+    # call keeps its fixed ClientTimeout, retries are unbounded (the
+    # pre-existing migration caps still apply), no hedges fire, and the
+    # KV transfer protocol is byte-identical to ISSUE 15.
+    # Consecutive transport failures/timeouts that trip a replica's
+    # circuit breaker open (0 = consecutive-failure trip off).  Open
+    # replicas are skipped by placement like unhealthy ones.
+    "VDT_ROUTER_BREAKER_FAILURES": lambda: int(
+        os.environ.get("VDT_ROUTER_BREAKER_FAILURES", "0")
+    ),
+    # Open -> half-open after this long; the half-open breaker admits
+    # exactly one probe request (success closes, failure re-opens).
+    "VDT_ROUTER_BREAKER_COOLDOWN_SECONDS": lambda: float(
+        os.environ.get("VDT_ROUTER_BREAKER_COOLDOWN_SECONDS", "5")
+    ),
+    # Windowed timeout-rate trip: the breaker also opens when at least
+    # this fraction of the last window's outcomes were timeouts (needs
+    # >= 10 samples in the window; 0 = rate trip off).
+    "VDT_ROUTER_BREAKER_TIMEOUT_RATE": lambda: float(
+        os.environ.get("VDT_ROUTER_BREAKER_TIMEOUT_RATE", "0")
+    ),
+    "VDT_ROUTER_BREAKER_WINDOW_SECONDS": lambda: float(
+        os.environ.get("VDT_ROUTER_BREAKER_WINDOW_SECONDS", "30")
+    ),
+    # Retry budget (global + per-replica, monotonic token accounting):
+    # a retry or hedge is granted only while granted < min + ratio *
+    # attempts, so retries can never amplify outbound load beyond the
+    # ratio plus the fixed reserve.  0 = budget off (unbounded retries,
+    # exactly as before).  Exhausted budget degrades to the existing
+    # 503/migration paths instead of retrying.
+    "VDT_ROUTER_RETRY_BUDGET_RATIO": lambda: float(
+        os.environ.get("VDT_ROUTER_RETRY_BUDGET_RATIO", "0")
+    ),
+    "VDT_ROUTER_RETRY_BUDGET_MIN": lambda: float(
+        os.environ.get("VDT_ROUTER_RETRY_BUDGET_MIN", "10")
+    ),
+    # Adaptive deadlines: per-endpoint EWMA latency quantiles replace
+    # the fixed unary ClientTimeout totals (clamped to
+    # [floor, ceiling]; ceiling 0 = the router read timeout), so a
+    # slow-but-alive replica isn't declared dead and a hung one is cut
+    # fast.  Streaming reads keep their fixed sock_read deadline.
+    "VDT_ROUTER_ADAPTIVE_DEADLINE": lambda: int(
+        os.environ.get("VDT_ROUTER_ADAPTIVE_DEADLINE", "0")
+    ),
+    "VDT_ROUTER_DEADLINE_FLOOR_SECONDS": lambda: float(
+        os.environ.get("VDT_ROUTER_DEADLINE_FLOOR_SECONDS", "1")
+    ),
+    "VDT_ROUTER_DEADLINE_CEILING_SECONDS": lambda: float(
+        os.environ.get("VDT_ROUTER_DEADLINE_CEILING_SECONDS", "0")
+    ),
+    "VDT_ROUTER_DEADLINE_MULTIPLIER": lambda: float(
+        os.environ.get("VDT_ROUTER_DEADLINE_MULTIPLIER", "3")
+    ),
+    # Hedged requests on idempotent read paths (/health, /metrics,
+    # /slo scrapes and /internal/kv/export chunk pulls): after a
+    # p95-based delay a duplicate request races the first, first winner
+    # cancels the loser, hedges draw from the retry budget.
+    "VDT_ROUTER_HEDGE": lambda: int(
+        os.environ.get("VDT_ROUTER_HEDGE", "0")
+    ),
+    # Floor under the p95-based hedge delay (a cold or very fast
+    # endpoint must not hedge instantly and double its load).
+    "VDT_ROUTER_HEDGE_MIN_DELAY_MS": lambda: float(
+        os.environ.get("VDT_ROUTER_HEDGE_MIN_DELAY_MS", "50")
+    ),
+    # Resumable KV transfer: per-chunk retry cap on the prefill->decode
+    # page stream.  A dropped connection re-pulls only the missing
+    # checksummed chunks (begin carries resume_from) instead of
+    # aborting the hand-off to recompute; retries also draw from the
+    # retry budget.  0 = single-attempt transfer, exactly as before.
+    "VDT_ROUTER_KV_CHUNK_RETRIES": lambda: int(
+        os.environ.get("VDT_ROUTER_KV_CHUNK_RETRIES", "0")
+    ),
     # --- elastic fleet (ISSUE 13) ---
     # Command template the router's ReplicaManager launches managed
     # replicas with ({port} and {replica_id} placeholders, e.g.
@@ -582,6 +663,24 @@ NON_REPLICATED_ENV_VARS = {
     "VDT_DISAGG_MIN_PROMPT_TOKENS",
     "VDT_DISAGG_CHUNK_LAYERS",
     "VDT_DISAGG_EXPORT_TTL_SECONDS",
+    # Resilient data plane (ISSUE 19): breakers, retry budgets,
+    # adaptive deadlines, hedging, and chunk-resume all configure the
+    # ROUTER process's outbound HTTP behavior — replicating them onto
+    # engine workers would be meaningless.  (VDT_KV_MAX_FRAME_BYTES is
+    # replica-side server config and DOES replicate.)
+    "VDT_ROUTER_BREAKER_FAILURES",
+    "VDT_ROUTER_BREAKER_COOLDOWN_SECONDS",
+    "VDT_ROUTER_BREAKER_TIMEOUT_RATE",
+    "VDT_ROUTER_BREAKER_WINDOW_SECONDS",
+    "VDT_ROUTER_RETRY_BUDGET_RATIO",
+    "VDT_ROUTER_RETRY_BUDGET_MIN",
+    "VDT_ROUTER_ADAPTIVE_DEADLINE",
+    "VDT_ROUTER_DEADLINE_FLOOR_SECONDS",
+    "VDT_ROUTER_DEADLINE_CEILING_SECONDS",
+    "VDT_ROUTER_DEADLINE_MULTIPLIER",
+    "VDT_ROUTER_HEDGE",
+    "VDT_ROUTER_HEDGE_MIN_DELAY_MS",
+    "VDT_ROUTER_KV_CHUNK_RETRIES",
     # Fleet lifecycle + autoscaler knobs configure the ROUTER process's
     # control loops; replicating them to engine workers (or to the
     # managed replicas themselves) would be meaningless.

@@ -46,6 +46,7 @@ from vllm_distributed_tpu.router.metrics import (
 )
 from vllm_distributed_tpu.router.pool import Replica, ReplicaPool
 from vllm_distributed_tpu.router.qos import PrefillDemand, QosRouterPolicy
+from vllm_distributed_tpu.router.resilience import ResilienceManager
 from vllm_distributed_tpu.tracing import get_tracer
 from vllm_distributed_tpu.utils import Counter
 from vllm_distributed_tpu.version import __version__
@@ -153,6 +154,16 @@ class RouterState:
             ),
         )
         self.metrics = RouterMetrics()
+        # Resilient data plane (ISSUE 19): every outbound HTTP call
+        # goes through this manager (VDT010).  With no resilience env
+        # set it is a pure passthrough — wire behavior byte-identical
+        # to the fixed-timeout router.
+        self.resilience = ResilienceManager.from_env(
+            metrics=self.metrics,
+            connect_timeout=self.connect_timeout,
+            read_timeout=self.read_timeout,
+        )
+        self.pool.resilience = self.resilience
         self.request_counter = Counter()
         # Disaggregated prefill/decode (ISSUE 15): the hand-off engages
         # only for prompts at/above the crossover AND when the pool
@@ -193,6 +204,7 @@ class RouterState:
         def _forget(replica) -> None:
             self.metrics.forget_replica(replica.replica_id)
             self.index.forget(replica.replica_id)
+            self.resilience.forget_replica(replica.replica_id)
 
         self.pool.on_remove.append(_forget)
 
@@ -201,6 +213,7 @@ class RouterState:
         (the manager needs the router's client session)."""
         self.manager = manager
         self.autoscaler = autoscaler
+        manager.resilience = self.resilience
 
     def attach_persist(self, log, recovered=None) -> None:
         """Install the durable-state WAL (ISSUE 17) and any state it
@@ -266,6 +279,18 @@ class RouterState:
         to them only when nothing else is routable — availability over
         purity."""
         cands = self.pool.candidates(exclude)
+        # Breaker state feeds placement (ISSUE 19): an open-breaker
+        # replica is skipped exactly like an unhealthy one.  No-op
+        # filter while breakers are off.
+        pre_breaker = len(cands)
+        cands = [
+            r
+            for r in cands
+            if self.resilience.replica_available(r.replica_id)
+        ]
+        if not cands and pre_breaker:
+            self.metrics.record_breaker_rejection()
+            return None, "breaker_open"
         if pool == "prefill":
             cands = [r for r in cands if r.role == "prefill"]
         else:
@@ -445,7 +470,13 @@ def _soonest_backoff_expiry(
     ]
     if not waits:
         return None
-    return min(max(min(waits) + 0.05, 0.1), 5.0)
+    # The wait cap follows the adaptive proxy deadline when adaptive
+    # deadlines are on (ISSUE 19 satellite); the historical fixed 5s
+    # otherwise.
+    cap = 5.0
+    if state.resilience.enabled:
+        cap = state.resilience.deadline("proxy") or cap
+    return min(max(min(waits) + 0.05, 0.1), cap)
 
 
 def _place_or_none(
@@ -498,8 +529,12 @@ async def _proxy_unary(
                 "no healthy replica available", 503, retry_after=5
             )
         try:
-            async with state.session.post(
+            async with await state.resilience.request(
+                state.session,
+                "POST",
                 f"{replica.url}{path}",
+                endpoint="proxy",
+                replica_id=replica.replica_id,
                 json=journal.body,
                 headers=fwd,
                 timeout=_upstream_timeout(state, streaming=False),
@@ -511,7 +546,7 @@ async def _proxy_unary(
                 served_id = resp.headers.get(
                     REPLICA_HEADER, replica.replica_id
                 )
-                retry_after = resp.headers.get("Retry-After", "1")
+                retry_after = resp.headers.get("Retry-After")
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001 — any transport failure = resubmit elsewhere
@@ -526,6 +561,15 @@ async def _proxy_unary(
                     f"replica failed and migration budget exhausted: {e}",
                     502,
                 )
+            if not state.resilience.try_spend_retry():
+                # Budget exhausted (ISSUE 19): degrade to the existing
+                # 503 path instead of amplifying the retry storm.
+                state.metrics.record_request(kind, "failed")
+                return _error(
+                    "replica failed and retry budget exhausted",
+                    503,
+                    retry_after=1,
+                )
             continue
         if status == 429:
             # Healthy but full: back the replica off for Retry-After
@@ -534,19 +578,32 @@ async def _proxy_unary(
             # to ``exclude`` — backoff expiry re-admits it (busy once
             # is not failed-for-this-request).
             try:
-                backoff = float(retry_after)
+                backoff = float(retry_after or "1")
             except ValueError:
                 backoff = 1.0
             state.pool.note_backoff(replica, backoff)
             last_429 = (
-                raw, status, {"Retry-After": retry_after},
+                raw, status, {"Retry-After": retry_after or "1"},
             )
             continue
         if status in (502, 503):
+            if state.resilience.enabled and retry_after is not None:
+                # Honor the replica's own Retry-After on 503 (ISSUE 19
+                # satellite) so other requests stop hammering it until
+                # it expects to recover, not just this one.
+                try:
+                    state.pool.note_backoff(replica, float(retry_after))
+                except ValueError:
+                    pass
             exclude.add(replica.url)
             journal.migrations += 1
             state.metrics.record_migration("dead")
             if journal.migrations > state.max_migrations:
+                state.metrics.record_request(kind, "failed")
+                break
+            if not state.resilience.try_spend_retry():
+                # Budget exhausted: surface the replica's own 5xx
+                # instead of resubmitting (the existing degraded path).
                 state.metrics.record_request(kind, "failed")
                 break
             continue
@@ -628,8 +685,12 @@ async def _proxy_stream(
                 "no healthy replica available", 503, retry_after=5
             )
         try:
-            candidate = await state.session.post(
+            candidate = await state.resilience.request(
+                state.session,
+                "POST",
                 f"{replica.url}{path}",
+                endpoint="proxy",
+                replica_id=replica.replica_id,
                 json=journal.body,
                 headers=(
                     {**fwd, DISAGG_HEADER: "prefill"}
@@ -643,6 +704,13 @@ async def _proxy_stream(
         except Exception as e:  # noqa: BLE001 — pre-stream failure: silently try the next replica
             state.pool.note_unreachable(replica, f"{type(e).__name__}: {e}")
             exclude.add(replica.url)
+            if not state.resilience.try_spend_retry():
+                state.metrics.record_request(kind, "failed")
+                return _error(
+                    "replica failed and retry budget exhausted",
+                    503,
+                    retry_after=1,
+                )
             continue
         if candidate.status == 429:
             raw = await asyncio.wait_for(
@@ -663,9 +731,26 @@ async def _proxy_stream(
                 candidate.read(), timeout=state.read_timeout
             )
             status = candidate.status
+            ra_header = candidate.headers.get("Retry-After")
             candidate.release()
             if status in (502, 503):
+                if state.resilience.enabled and ra_header is not None:
+                    # ISSUE 19 satellite: honor the replica's own
+                    # Retry-After on 503 for everyone, not just this
+                    # request's exclude set.
+                    try:
+                        state.pool.note_backoff(replica, float(ra_header))
+                    except ValueError:
+                        pass
                 exclude.add(replica.url)
+                if not state.resilience.try_spend_retry():
+                    state.metrics.record_request(kind, "failed")
+                    return web.Response(
+                        body=raw,
+                        status=status,
+                        content_type="application/json",
+                        headers={REPLICA_HEADER: replica.replica_id},
+                    )
                 continue
             state.metrics.record_request(kind, "bad_request")
             return web.Response(
@@ -747,7 +832,11 @@ async def _migrate_loop(
             # (drain): stop steering siblings toward it.  Transient
             # busy signals keep their affinity history.
             state.index.forget(victim.replica_id)
-        journal.migrations += 1
+        if mig.reason != "resume_retry":
+            # A budget-granted re-dial of the same idempotent resume
+            # is not a new migration hop: it is bounded by the retry
+            # budget, not the migration cap.
+            journal.migrations += 1
         state.metrics.record_migration(mig.reason)
         get_tracer().event(
             span.ctx,
@@ -767,6 +856,19 @@ async def _migrate_loop(
                 )
             )
             return False
+        if not state.resilience.try_spend_retry():
+            # Retry budget exhausted (ISSUE 19): the stream degrades to
+            # the existing terminal-503 path instead of re-placing.
+            await write(
+                json.dumps(
+                    {
+                        "error": "retry budget exhausted "
+                        f"(last trigger: {mig.reason})",
+                        "code": 503,
+                    }
+                )
+            )
+            return False
         target = _place_or_none(
             state, keys, exclude, span, slo_class=journal.slo_class
         )
@@ -777,6 +879,19 @@ async def _migrate_loop(
             delay = _soonest_backoff_expiry(state, exclude)
             if delay is not None:
                 await asyncio.sleep(delay)
+                target = _place_or_none(
+                    state, keys, exclude, span, slo_class=journal.slo_class
+                )
+        if target is None and state.resilience.enabled:
+            # Resilient data plane (ISSUE 19): a lossy link can leave
+            # every candidate momentarily unreachable or breaker-open;
+            # the next health tick (or breaker cooldown) usually heals
+            # it.  Re-poll placement briefly before declaring the
+            # admitted work lost — bounded, and only with the
+            # resilience stack armed.
+            deadline = time.monotonic() + 3.0
+            while target is None and time.monotonic() < deadline:
+                await asyncio.sleep(0.25)
                 target = _place_or_none(
                     state, keys, exclude, span, slo_class=journal.slo_class
                 )
@@ -942,8 +1057,12 @@ async def _forward_resumed(
 
     async def pump(choice) -> None:
         try:
-            resp = await state.session.post(
+            resp = await state.resilience.request(
+                state.session,
+                "POST",
                 f"{target.url}/internal/resume",
+                endpoint="resume",
+                replica_id=target.replica_id,
                 json=journal.resume_payload(choice),
                 headers=fwd,
                 timeout=_upstream_timeout(state, streaming=True),
@@ -1006,6 +1125,17 @@ async def _forward_resumed(
                     "target_busy", exclude=False, forget=False
                 )
             if tag == "failed":
+                # A dropped connection is not a dead replica (ISSUE
+                # 19): /internal/resume is idempotent per request id,
+                # so while the retry budget grants, re-place with the
+                # target still in the candidate set — only a denied
+                # budget (or disabled stack) writes the replica off.
+                if state.resilience.enabled and (
+                    state.resilience.try_spend_retry(target.replica_id)
+                ):
+                    raise MigrationNeeded(
+                        "resume_retry", exclude=False, forget=False
+                    )
                 raise MigrationNeeded("resume_failed")
             if tag == "eof":
                 if choice.index in open_indices:
@@ -1361,13 +1491,25 @@ async def metrics(request: web.Request) -> web.Response:
     )
 
     async def scrape(replica: Replica) -> tuple[str, str] | None:
-        try:
-            async with state.session.get(
-                f"{replica.url}/metrics", timeout=timeout
+        async def fetch() -> tuple[str, str] | None:
+            async with await state.resilience.request(
+                state.session,
+                "GET",
+                f"{replica.url}/metrics",
+                endpoint="metrics",
+                replica_id=replica.replica_id,
+                timeout=timeout,
             ) as resp:
                 if resp.status != 200:
                     return None
                 return (replica.replica_id, await resp.text())
+
+        try:
+            # Idempotent read: hedge it (ISSUE 19) — a straggling
+            # replica must not stall the whole merged exposition.
+            return await state.resilience.hedged(
+                "metrics", replica.replica_id, fetch
+            )
         except asyncio.CancelledError:
             raise
         except Exception:  # noqa: BLE001 — a dead replica just drops out of the aggregate
@@ -1416,13 +1558,24 @@ async def _fleet_slo(state: RouterState) -> dict:
     timeout = aiohttp.ClientTimeout(total=10, connect=state.connect_timeout)
 
     async def scrape(replica: Replica) -> tuple[str, dict] | None:
-        try:
-            async with state.session.get(
-                f"{replica.url}/slo?timelines=0", timeout=timeout
+        async def fetch() -> tuple[str, dict] | None:
+            async with await state.resilience.request(
+                state.session,
+                "GET",
+                f"{replica.url}/slo?timelines=0",
+                endpoint="slo",
+                replica_id=replica.replica_id,
+                timeout=timeout,
             ) as resp:
                 if resp.status != 200:
                     return None
                 return (replica.replica_id, await resp.json())
+
+        try:
+            # Idempotent read: hedged like the /metrics sweep.
+            return await state.resilience.hedged(
+                "slo", replica.replica_id, fetch
+            )
         except asyncio.CancelledError:
             raise
         except Exception:  # noqa: BLE001 — a dead replica drops out of the merge
@@ -1461,6 +1614,8 @@ async def router_state(request: web.Request) -> web.Response:
             for r in state.pool.replicas
         },
     }
+    if state.resilience.enabled:
+        body["resilience"] = state.resilience.snapshot()
     if state.manager is not None:
         body["fleet"] = {
             "target": state.manager.target,
@@ -1525,8 +1680,13 @@ async def list_models(request: web.Request) -> web.Response:
     timeout = aiohttp.ClientTimeout(total=10, connect=state.connect_timeout)
     for replica in state.pool.candidates() or state.pool.replicas:
         try:
-            async with state.session.get(
-                f"{replica.url}/v1/models", timeout=timeout
+            async with await state.resilience.request(
+                state.session,
+                "GET",
+                f"{replica.url}/v1/models",
+                endpoint="models",
+                replica_id=replica.replica_id,
+                timeout=timeout,
             ) as resp:
                 if resp.status == 200:
                     return web.json_response(await resp.json())

@@ -32,6 +32,7 @@ import json
 from dataclasses import dataclass
 
 from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.router.resilience import ResilienceManager
 from vllm_distributed_tpu.tracing import get_tracer
 
 logger = init_logger(__name__)
@@ -40,10 +41,15 @@ logger = init_logger(__name__)
 # `_test_before_transfer` is awaited after the prefill stream yields
 # its first token but before any export chunk moves;
 # `_test_after_chunk` after each export→import chunk round trip (chunk
-# index passed).  Together they make "SIGKILL the prefill replica
-# mid-hand-off / mid-export" deterministic scenarios instead of races.
+# index passed); `_test_after_chunk_failure` after a chunk round trip
+# FAILS and is about to be resumed (failure count passed) — the
+# partition soak heals the link exactly there, so "one lost chunk,
+# then resume" is deterministic regardless of event-loop contention.
+# Together they make "SIGKILL the prefill replica mid-hand-off /
+# mid-export" deterministic scenarios instead of races.
 _test_before_transfer = None
 _test_after_chunk = None
+_test_after_chunk_failure = None
 
 
 @dataclass
@@ -87,35 +93,73 @@ def plan_handoff(state, journal, keys) -> HandoffPlan | None:
     return HandoffPlan(est_prompt_tokens=est)
 
 
-async def _post_json(state, url: str, payload: dict) -> tuple[int, dict]:
-    """One bounded router→replica control POST; returns (status, body)."""
+async def _post_json(
+    state,
+    url: str,
+    payload: dict,
+    *,
+    endpoint: str = "kv",
+    replica_id: str | None = None,
+    hedge: bool = False,
+) -> tuple[int, dict]:
+    """One bounded router→replica control POST; returns (status, body).
+    Routed through the resilience manager (ISSUE 19): breaker-gated,
+    adaptive-deadline'd, and — for idempotent export pulls — hedged.
+    With no resilience envs set the manager is a pure passthrough."""
     import aiohttp
 
+    rz = getattr(state, "resilience", None) or ResilienceManager.noop()
     timeout = aiohttp.ClientTimeout(
         total=state.read_timeout, connect=state.connect_timeout
     )
-    async with state.session.post(
-        url, json=payload, timeout=timeout
-    ) as resp:
-        try:
-            body = await resp.json()
-        except Exception:  # noqa: BLE001 — a non-JSON error body still carries the status
-            body = {}
-        return resp.status, body or {}
+
+    async def fetch() -> tuple[int, dict]:
+        async with await rz.request(
+            state.session,
+            "POST",
+            url,
+            endpoint=endpoint,
+            replica_id=replica_id,
+            json=payload,
+            timeout=timeout,
+        ) as resp:
+            try:
+                body = await resp.json()
+            except Exception:  # noqa: BLE001 — a non-JSON error body still carries the status
+                body = {}
+            return resp.status, body or {}
+
+    if hedge:
+        return await rz.hedged(endpoint, replica_id, fetch)
+    return await fetch()
 
 
 async def _transfer_pages(
     state, prefill_url: str, decode_url: str, kv_handle: str,
     prompt_token_ids: list[int],
+    *,
+    prefill_id: str | None = None,
+    decode_id: str | None = None,
 ) -> int:
     """Stream the held pages prefill→decode in per-layer chunks.
     Returns the adopted token count (0 = nothing transferred, e.g. the
     decode pool declined).  Raises on any wire/checksum/commit failure
-    — the caller aborts and falls back to recompute."""
+    — the caller aborts and falls back to recompute.
+
+    With ``VDT_ROUTER_KV_CHUNK_RETRIES > 0`` the transfer is resumable
+    (ISSUE 19): a lost chunk round-trip re-begins with ``resume_from``,
+    learns which checksummed layers actually landed decode-side, and
+    re-pulls only the missing ones.  Each resume draws one token from
+    the retry budget; only an exhausted budget or chunk-retry cap falls
+    back to recompute."""
+    rz = getattr(state, "resilience", None) or ResilienceManager.noop()
+    kv_url = f"{decode_url}/internal/kv"
     status, begin = await _post_json(
         state,
-        f"{decode_url}/internal/kv",
+        kv_url,
         {"op": "begin", "prompt_token_ids": prompt_token_ids},
+        endpoint="kv_import",
+        replica_id=decode_id,
     )
     if status != 200:
         raise RuntimeError(f"kv import begin failed: HTTP {status}")
@@ -123,51 +167,132 @@ async def _transfer_pages(
     if not transfer_id:
         return 0  # nothing importable decode-side; recompute is correct
     chunk_layers = max(int(state.disagg_chunk_layers), 1)
+    chunk_retries = max(int(rz.cfg.kv_chunk_retries), 0)
+    failures = 0
+    need_sync = False
     try:
         layer = 0
         num_layers = None
         chunk_idx = 0
         while num_layers is None or layer < num_layers:
-            status, chunk = await _post_json(
-                state,
-                f"{prefill_url}/internal/kv/export",
-                {
-                    "handle": kv_handle,
-                    "layer_start": layer,
-                    "layer_count": chunk_layers,
-                },
-            )
-            if status != 200:
-                raise RuntimeError(
-                    f"kv export chunk failed: HTTP {status}"
+            try:
+                if need_sync:
+                    status, rebegin = await _post_json(
+                        state,
+                        kv_url,
+                        {
+                            "op": "begin",
+                            "prompt_token_ids": prompt_token_ids,
+                            "resume_from": transfer_id,
+                        },
+                        endpoint="kv_import",
+                        replica_id=decode_id,
+                    )
+                    if (
+                        status != 200
+                        or rebegin.get("transfer_id") != transfer_id
+                    ):
+                        # Reservation gone (TTL, scatter-failure
+                        # abort): nothing to resume onto — recompute.
+                        raise RuntimeError(
+                            "kv transfer resume rejected: "
+                            f"HTTP {status}"
+                        )
+                    received = {
+                        int(i) for i in rebegin.get("received") or ()
+                    }
+                    nl = rebegin.get("num_layers")
+                    if nl:
+                        num_layers = int(nl)
+                    # Re-pull from the first missing layer.  Layers
+                    # land in order, so the missing set is a suffix in
+                    # practice; the import-side set-add is idempotent
+                    # if it is not.
+                    layer = 0
+                    while layer in received:
+                        layer += 1
+                    need_sync = False
+                    metrics = getattr(state, "metrics", None)
+                    if metrics is not None:
+                        metrics.record_kv_resume()
+                    continue  # loop guard re-checks completion
+                status, chunk = await _post_json(
+                    state,
+                    f"{prefill_url}/internal/kv/export",
+                    {
+                        "handle": kv_handle,
+                        "layer_start": layer,
+                        "layer_count": chunk_layers,
+                    },
+                    endpoint="kv_export",
+                    replica_id=prefill_id,
+                    hedge=True,  # pure read: chunks are idempotent pulls
                 )
-            num_layers = int(chunk.get("num_layers") or 0)
-            layers = chunk.get("layers") or []
-            if not layers:
-                raise RuntimeError(
-                    f"kv export returned no layers at {layer}/{num_layers}"
+                if status != 200:
+                    raise RuntimeError(
+                        f"kv export chunk failed: HTTP {status}"
+                    )
+                num_layers = int(chunk.get("num_layers") or 0)
+                layers = chunk.get("layers") or []
+                if not layers:
+                    raise RuntimeError(
+                        f"kv export returned no layers at "
+                        f"{layer}/{num_layers}"
+                    )
+                status, _ = await _post_json(
+                    state,
+                    kv_url,
+                    {
+                        "op": "chunk",
+                        "transfer_id": transfer_id,
+                        "layers": layers,
+                    },
+                    endpoint="kv_import",
+                    replica_id=decode_id,
                 )
-            status, _ = await _post_json(
-                state,
-                f"{decode_url}/internal/kv",
-                {
-                    "op": "chunk",
-                    "transfer_id": transfer_id,
-                    "layers": layers,
-                },
-            )
-            if status != 200:
-                raise RuntimeError(
-                    f"kv import chunk failed: HTTP {status}"
+                if status != 200:
+                    raise RuntimeError(
+                        f"kv import chunk failed: HTTP {status}"
+                    )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — any lost round-trip (even a breaker rejection: the cooldown is shorter than a recompute) is resumable
+                resume_rejected = isinstance(
+                    e, RuntimeError
+                ) and "resume rejected" in str(e)
+                failures += 1
+                if (
+                    resume_rejected
+                    or failures > chunk_retries
+                    or not rz.try_spend_retry(decode_id)
+                ):
+                    raise
+                logger.warning(
+                    "kv transfer chunk failed (%s); resuming transfer "
+                    "%s (attempt %d/%d)",
+                    e,
+                    transfer_id,
+                    failures,
+                    chunk_retries,
                 )
+                if _test_after_chunk_failure is not None:
+                    await _test_after_chunk_failure(failures)
+                # Linear backoff: a partitioned link fails in
+                # microseconds — give the heal (or the breaker
+                # cooldown) a beat before re-syncing.
+                await asyncio.sleep(min(0.25 * failures, 2.0))
+                need_sync = True
+                continue
             layer += len(layers)
             chunk_idx += 1
             if _test_after_chunk is not None:
                 await _test_after_chunk(chunk_idx)
         status, commit = await _post_json(
             state,
-            f"{decode_url}/internal/kv",
+            kv_url,
             {"op": "commit", "transfer_id": transfer_id},
+            endpoint="kv_import",
+            replica_id=decode_id,
         )
         if status != 200:
             raise RuntimeError(f"kv import commit failed: HTTP {status}")
@@ -178,8 +303,10 @@ async def _transfer_pages(
         try:
             await _post_json(
                 state,
-                f"{decode_url}/internal/kv",
+                kv_url,
                 {"op": "abort", "transfer_id": transfer_id},
+                endpoint="kv_import",
+                replica_id=decode_id,
             )
         except Exception:  # noqa: BLE001 — fallback proceeds regardless
             logger.debug("kv import abort failed", exc_info=True)
@@ -318,6 +445,8 @@ async def forward_prefill_handoff(
                 target.url,
                 kv_handle,
                 list(prompt_ids),
+                prefill_id=prefill.replica_id,
+                decode_id=target.replica_id,
             )
         except Exception as e:  # noqa: BLE001 — transfer failure = recompute fallback
             logger.warning(

@@ -289,6 +289,9 @@ class ReplicaManager:
         self._spawn_gate_mono = 0.0  # no spawn before this (backoff)
         self._seq = 0
         self.session = None
+        # Installed by RouterState.attach_fleet (ISSUE 19); standalone
+        # managers (unit tests) fall back to the noop passthrough.
+        self.resilience = None
         self._task: asyncio.Task | None = None
         self._stopped = asyncio.Event()
 
@@ -622,13 +625,26 @@ class ReplicaManager:
         return adopted
 
     async def _health_identity(self, url: str) -> tuple[bool, str]:
-        """One bounded /health read: (answered-200, replica_id)."""
+        """One bounded /health read: (answered-200, replica_id).
+        Lifecycle probes pass replica_id=None to the resilience wrapper
+        on purpose: a breaker opened by the old incarnation must never
+        gate the probe that would prove the new one healthy."""
         import aiohttp
 
+        from vllm_distributed_tpu.router.resilience import (
+            ResilienceManager,
+        )
+
+        rz = self.resilience or ResilienceManager.noop()
         timeout = aiohttp.ClientTimeout(total=2, connect=2)
-        try:
-            async with self.session.get(
-                f"{url}/health", timeout=timeout
+
+        async def fetch() -> tuple[bool, str]:
+            async with await rz.request(
+                self.session,
+                "GET",
+                f"{url}/health",
+                endpoint="health",
+                timeout=timeout,
             ) as resp:
                 if resp.status != 200:
                     return False, ""
@@ -637,6 +653,9 @@ class ReplicaManager:
                 except Exception:  # noqa: BLE001 — 200 with no JSON body still proves liveness
                     body = {}
                 return True, str((body or {}).get("replica_id") or "")
+
+        try:
+            return await rz.hedged("health", None, fetch)
         except asyncio.CancelledError:
             raise
         except Exception:  # noqa: BLE001 — not answering (yet)
@@ -744,10 +763,21 @@ class ReplicaManager:
     async def _http_health(self, url: str) -> bool:
         import aiohttp
 
+        from vllm_distributed_tpu.router.resilience import (
+            ResilienceManager,
+        )
+
+        rz = self.resilience or ResilienceManager.noop()
         timeout = aiohttp.ClientTimeout(total=2, connect=2)
         try:
-            async with self.session.get(
-                f"{url}/health", timeout=timeout
+            # replica_id=None: warmup probes must never be breaker-gated
+            # (see _health_identity).
+            async with await rz.request(
+                self.session,
+                "GET",
+                f"{url}/health",
+                endpoint="health",
+                timeout=timeout,
             ) as resp:
                 return resp.status == 200
         except asyncio.CancelledError:
@@ -840,8 +870,19 @@ class ReplicaManager:
     async def _http_drain(self, url: str, timeout: float) -> None:
         import aiohttp
 
-        async with self.session.post(
+        from vllm_distributed_tpu.router.resilience import (
+            ResilienceManager,
+        )
+
+        rz = self.resilience or ResilienceManager.noop()
+        # The drain deadline is the caller's contract, not a latency
+        # estimate: keep the explicit timeout, never the adaptive one.
+        async with await rz.request(
+            self.session,
+            "POST",
             f"{url}/drain",
+            endpoint="drain",
+            adaptive=False,
             params={"timeout": str(timeout)},
             timeout=aiohttp.ClientTimeout(total=timeout + 10),
         ) as resp:

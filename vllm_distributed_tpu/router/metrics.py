@@ -121,6 +121,44 @@ class RouterMetrics:
             ["outcome"],
             registry=self.registry,
         )
+        # ---- resilient data plane (ISSUE 19) ----
+        self._retries = Counter(
+            "vdt_router:retries_total",
+            "Retry-budget decisions (granted | denied).  Denied retries "
+            "degrade to the existing 503/migration outcomes instead of "
+            "amplifying load",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self._hedges = Counter(
+            "vdt_router:hedges_total",
+            "Hedged idempotent reads by outcome (primary_won | "
+            "hedge_won | denied = retry budget refused the hedge | "
+            "both_failed)",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self._breaker_state = Gauge(
+            "vdt_router:breaker_state",
+            "Per-replica circuit breaker state (0 closed, 1 half-open, "
+            "2 open).  Open replicas are skipped by placement",
+            ["replica_id"],
+            registry=self.registry,
+        )
+        self._breaker_rejections = Counter(
+            "vdt_router:breaker_rejections_total",
+            "Outbound calls rejected by an open circuit breaker before "
+            "any I/O (placement normally skips open replicas; these are "
+            "the residual races plus breaker-filtered empty placements)",
+            registry=self.registry,
+        )
+        self._kv_resumes = Counter(
+            "vdt_router:kv_transfer_resumes",
+            "Chunk-level resumes inside prefill->decode KV transfers: "
+            "a dropped connection re-pulled only the missing chunks "
+            "instead of aborting the hand-off to recompute",
+            registry=self.registry,
+        )
         self._placements = Counter(
             "vdt_router:placements",
             "Placement decisions by deciding policy (affinity | "
@@ -221,6 +259,32 @@ class RouterMetrics:
         if self.enabled:
             self._handoffs.labels(outcome=outcome).inc()
 
+    # ---- resilient data plane (ISSUE 19) ----
+    def record_retry(self, outcome: str) -> None:
+        self.counts[f"retries.{outcome}"] += 1
+        if self.enabled:
+            self._retries.labels(outcome=outcome).inc()
+
+    def record_hedge(self, outcome: str) -> None:
+        self.counts[f"hedges.{outcome}"] += 1
+        if self.enabled:
+            self._hedges.labels(outcome=outcome).inc()
+
+    def set_breaker_state(self, replica_id: str, value: int) -> None:
+        self.counts[f"breaker.state.{replica_id}"] = value
+        if self.enabled:
+            self._breaker_state.labels(replica_id=replica_id).set(value)
+
+    def record_breaker_rejection(self) -> None:
+        self.counts["breaker.rejections"] += 1
+        if self.enabled:
+            self._breaker_rejections.inc()
+
+    def record_kv_resume(self) -> None:
+        self.counts["kv.transfer_resumes"] += 1
+        if self.enabled:
+            self._kv_resumes.inc()
+
     # ---- elastic fleet (ISSUE 13) ----
     def record_scale(self, direction: str, reason: str) -> None:
         self.counts[f"fleet.scale.{direction}"] += 1
@@ -246,9 +310,14 @@ class RouterMetrics:
         replica leaves the pool, so a scaled-down id never lingers in
         the router's own exposition (the merged replica expositions
         drop out automatically — they iterate the live pool)."""
+        self.counts.pop(f"breaker.state.{replica_id}", None)
         if not self.enabled:
             return
-        for gauge in (self._replica_up, self._replica_waiting):
+        for gauge in (
+            self._replica_up,
+            self._replica_waiting,
+            self._breaker_state,
+        ):
             try:
                 gauge.remove(replica_id)
             except KeyError:

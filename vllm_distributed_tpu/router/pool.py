@@ -23,6 +23,7 @@ import time
 from dataclasses import dataclass, field
 
 from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.router.resilience import ResilienceManager
 
 logger = init_logger(__name__)
 
@@ -144,6 +145,11 @@ class ReplicaPool:
         self.probe_timeout = probe_timeout
         self._task: asyncio.Task | None = None
         self._stopped = asyncio.Event()
+        # Resilient data plane (ISSUE 19): RouterState installs its
+        # manager here so probes share the breakers/budget/hedging with
+        # the proxy path.  A standalone pool (unit tests) gets the
+        # always-off passthrough.
+        self.resilience: ResilienceManager | None = None
         # Membership hooks (the fleet layer and the metrics exporter
         # subscribe): called with the Replica on every add/remove so
         # per-replica series can be created/forgotten in lockstep with
@@ -252,43 +258,56 @@ class ReplicaPool:
         """One deadline-bounded /health + /metrics read."""
         import aiohttp
 
+        rz = self.resilience or ResilienceManager.noop()
         timeout = aiohttp.ClientTimeout(
             total=self.probe_timeout, connect=self.connect_timeout
         )
         replica.last_probe_mono = time.monotonic()
-        try:
-            async with session.get(
-                f"{replica.url}/health", timeout=timeout
+
+        async def fetch_health() -> tuple[int, dict]:
+            async with await rz.request(
+                session,
+                "GET",
+                f"{replica.url}/health",
+                endpoint="health",
+                replica_id=replica.replica_id,
+                timeout=timeout,
             ) as resp:
-                if resp.status == 200:
-                    try:
-                        body = await resp.json()
-                    except Exception:  # noqa: BLE001 — pre-ISSUE-10 replicas answer 200 with an empty body
-                        body = {}
-                    replica.state = "healthy"
-                    replica.consecutive_failures = 0
-                    replica.last_error = ""
-                    replica.verify_deadline_mono = 0.0
-                    rid = (body or {}).get("replica_id")
-                    if rid:
-                        replica.replica_id = str(rid)
-                    role = (body or {}).get("role")
-                    if role in ("prefill", "decode", "mixed"):
-                        replica.role = role
-                else:
-                    try:
-                        body = await resp.json()
-                    except Exception:  # noqa: BLE001 — a 5xx with no JSON body is still a state signal
-                        body = {}
-                    status = str((body or {}).get("status", "dead"))
-                    replica.state = (
-                        status
-                        if status in _TRANSIENT_STATES or status == "dead"
-                        else "dead"
-                    )
-                    replica.last_error = str(
-                        (body or {}).get("error", f"HTTP {resp.status}")
-                    )
+                try:
+                    body = await resp.json()
+                except Exception:  # noqa: BLE001 — pre-ISSUE-10 replicas answer 200 with an empty body
+                    body = {}
+                return resp.status, body or {}
+
+        try:
+            # /health is the idempotent read par excellence: hedged
+            # (ISSUE 19) so one straggling answer under a lossy DCN
+            # doesn't read as a missed probe.  The half-open breaker
+            # probe also rides this path.
+            http_status, body = await rz.hedged(
+                "health", replica.replica_id, fetch_health
+            )
+            if http_status == 200:
+                replica.state = "healthy"
+                replica.consecutive_failures = 0
+                replica.last_error = ""
+                replica.verify_deadline_mono = 0.0
+                rid = body.get("replica_id")
+                if rid:
+                    replica.replica_id = str(rid)
+                role = body.get("role")
+                if role in ("prefill", "decode", "mixed"):
+                    replica.role = role
+            else:
+                status = str(body.get("status", "dead"))
+                replica.state = (
+                    status
+                    if status in _TRANSIENT_STATES or status == "dead"
+                    else "dead"
+                )
+                replica.last_error = str(
+                    body.get("error", f"HTTP {http_status}")
+                )
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001 — any transport failure = unreachable
@@ -306,22 +325,36 @@ class ReplicaPool:
             return
         if replica.state != "healthy":
             return
-        try:
-            async with session.get(
-                f"{replica.url}/metrics", timeout=timeout
+
+        async def fetch_metrics() -> str | None:
+            async with await rz.request(
+                session,
+                "GET",
+                f"{replica.url}/metrics",
+                endpoint="metrics",
+                replica_id=replica.replica_id,
+                timeout=timeout,
             ) as resp:
-                if resp.status == 200:
-                    gauges = parse_load_gauges(await resp.text())
-                    replica.waiting = gauges.get(
-                        "vllm:num_requests_waiting", replica.waiting
-                    )
-                    replica.queued_tokens = gauges.get(
-                        "vllm:admission_queued_tokens",
-                        replica.queued_tokens,
-                    )
-                    replica.running = gauges.get(
-                        "vllm:num_requests_running", replica.running
-                    )
+                if resp.status != 200:
+                    return None
+                return await resp.text()
+
+        try:
+            text = await rz.hedged(
+                "metrics", replica.replica_id, fetch_metrics
+            )
+            if text is not None:
+                gauges = parse_load_gauges(text)
+                replica.waiting = gauges.get(
+                    "vllm:num_requests_waiting", replica.waiting
+                )
+                replica.queued_tokens = gauges.get(
+                    "vllm:admission_queued_tokens",
+                    replica.queued_tokens,
+                )
+                replica.running = gauges.get(
+                    "vllm:num_requests_running", replica.running
+                )
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001 — load stats are advisory; /health already passed

@@ -1,0 +1,585 @@
+"""Resilient DCN data plane for the router (ISSUE 19).
+
+Every outbound router→replica HTTP call goes through one
+``ResilienceManager`` (``RouterState.resilience``), which layers four
+independently-gated mechanisms over the raw aiohttp session:
+
+- **Circuit breakers** (per replica): closed → open after
+  ``VDT_ROUTER_BREAKER_FAILURES`` consecutive transport
+  failures/timeouts (or a windowed timeout-rate trip), open →
+  half-open after ``VDT_ROUTER_BREAKER_COOLDOWN_SECONDS`` with exactly
+  ONE probe request allowed through, half-open → closed on probe
+  success (→ back to open on probe failure).  Breaker state feeds
+  placement: an open replica is skipped like an unhealthy one
+  (``vdt_router:breaker_state{replica_id}``: 0 closed, 1 half-open,
+  2 open).
+- **Retry budget** (global + per-replica, Finagle-style monotonic
+  token accounting): a retry/hedge is granted only while
+  ``granted < min + ratio * attempts`` — so retries can never amplify
+  offered outbound load beyond ``ratio`` (plus the fixed ``min``
+  reserve) over ANY horizon.  Exhausted budget degrades to the
+  existing 503/migration paths instead of retrying.
+- **Adaptive deadlines**: per-endpoint EWMA latency quantiles
+  (mean + 2·EWMA-absolute-deviation ≈ p95 for the exponential-ish
+  tails these calls have) replace the fixed unary ``ClientTimeout``
+  totals, clamped to [floor, ceiling].  Streaming timeouts
+  (``total=None``) are untouched — sock_read still governs and the
+  journal migrates.
+- **Hedged requests** on idempotent read paths: after a p95-based
+  delay (never below the configured floor) a second identical request
+  races the first; first winner cancels the loser, hedges are drawn
+  from the retry budget (``vdt_router:hedges_total{outcome}``).
+
+All default-off: with no resilience env set, ``request()`` is a pure
+passthrough to ``session.request`` with the caller's own timeout —
+byte-identical wire behavior to the pre-ISSUE-19 router (pinned by
+tests/test_resilience.py's A/B tests).  The clock and sleep are
+injectable so every state machine is unit-testable on synthetic time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import Counter as _TallyCounter
+from collections import deque
+from dataclasses import dataclass
+
+import aiohttp
+
+from vllm_distributed_tpu import envs
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+# Breaker states and their gauge encoding.
+CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+BREAKER_GAUGE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+# Minimum window samples before the timeout-rate trip can fire (a
+# single timeout must never open a breaker through the rate path).
+_RATE_MIN_SAMPLES = 10
+# Latency samples before an endpoint's adaptive deadline / hedge delay
+# engages (until then the caller's fixed timeout stands).
+_MIN_LATENCY_SAMPLES = 8
+
+
+class BreakerOpen(Exception):
+    """Raised by ``request()`` before any I/O when the target replica's
+    breaker rejects the call.  Call sites treat it like a transport
+    failure (the replica is already suspected)."""
+
+    def __init__(self, replica_id: str) -> None:
+        super().__init__(f"circuit breaker open for replica {replica_id}")
+        self.replica_id = replica_id
+
+
+class CircuitBreaker:
+    """One replica's breaker.  All transitions happen on the router's
+    event loop (no locking), driven by ``acquire``/``record_*``."""
+
+    def __init__(
+        self,
+        *,
+        failures: int,
+        cooldown: float,
+        timeout_rate: float,
+        window: float,
+        clock,
+    ) -> None:
+        self.failures = failures
+        self.cooldown = cooldown
+        self.timeout_rate = timeout_rate
+        self.window = window
+        self.clock = clock
+        self.state = CLOSED
+        self.consecutive = 0
+        self.opened_at = 0.0
+        self.probe_inflight = False
+        # (mono, was_timeout) outcomes inside the rate window; the
+        # time-based prune in record_failure is the real bound, maxlen
+        # backstops a clock that stops advancing.
+        self._events: deque[tuple[float, bool]] = deque(maxlen=4096)
+
+    def _trip(self, now: float) -> None:
+        self.state = OPEN
+        self.opened_at = now
+        self.probe_inflight = False
+
+    def can_route(self) -> bool:
+        """Non-mutating placement view: may a request be sent now?"""
+        if self.state == CLOSED:
+            return True
+        if self.state == OPEN:
+            return self.clock() - self.opened_at >= self.cooldown
+        return not self.probe_inflight
+
+    def acquire(self) -> bool:
+        """Mutating admission: True = go ahead (and in half-open, this
+        call IS the single probe); False = rejected."""
+        if self.state == CLOSED:
+            return True
+        now = self.clock()
+        if self.state == OPEN:
+            if now - self.opened_at < self.cooldown:
+                return False
+            self.state = HALF_OPEN
+            self.probe_inflight = True
+            return True
+        if self.probe_inflight:
+            return False
+        self.probe_inflight = True
+        return True
+
+    def record_success(self) -> None:
+        self.consecutive = 0
+        if self.state == HALF_OPEN:
+            self.state = CLOSED
+            self.probe_inflight = False
+            self._events.clear()
+
+    def record_failure(self, *, timeout: bool) -> None:
+        now = self.clock()
+        if self.state == HALF_OPEN:
+            # The probe failed: re-open and re-arm the cooldown.
+            self._trip(now)
+            return
+        if self.state == OPEN:
+            return  # a straggler launched pre-trip; already open
+        self.consecutive += 1
+        if self.failures > 0 and self.consecutive >= self.failures:
+            self._trip(now)
+            return
+        if self.timeout_rate > 0:
+            self._events.append((now, timeout))
+            while self._events and self._events[0][0] < now - self.window:
+                self._events.popleft()
+            n = len(self._events)
+            if n >= _RATE_MIN_SAMPLES:
+                rate = sum(1 for _, t in self._events if t) / n
+                if rate >= self.timeout_rate:
+                    self._trip(now)
+
+
+class LatencyTracker:
+    """EWMA mean + EWMA absolute deviation per endpoint; the p95
+    estimate ``mean + 2·dev`` feeds adaptive deadlines and hedge
+    delays."""
+
+    def __init__(self, alpha: float = 0.1) -> None:
+        self.alpha = alpha
+        self.n = 0
+        self.mean = 0.0
+        self.dev = 0.0
+
+    def observe(self, x: float) -> None:
+        self.n += 1
+        if self.n == 1:
+            self.mean = x
+            self.dev = x / 2.0
+            return
+        self.mean += self.alpha * (x - self.mean)
+        self.dev += self.alpha * (abs(x - self.mean) - self.dev)
+
+    def p95(self) -> float | None:
+        if self.n < _MIN_LATENCY_SAMPLES:
+            return None
+        return self.mean + 2.0 * self.dev
+
+
+@dataclass
+class ResilienceConfig:
+    """One knob per mechanism; the all-defaults instance means every
+    mechanism is off and the manager is a pure passthrough."""
+
+    breaker_failures: int = 0  # 0 = consecutive-failure trip off
+    breaker_cooldown: float = 5.0
+    breaker_timeout_rate: float = 0.0  # 0 = rate trip off
+    breaker_window: float = 30.0
+    retry_ratio: float = 0.0  # 0 = budget off (unbounded, as before)
+    retry_min: float = 10.0
+    adaptive_deadline: bool = False
+    deadline_floor: float = 1.0
+    deadline_ceiling: float = 0.0  # 0 = the router read timeout
+    deadline_multiplier: float = 3.0
+    hedge: bool = False
+    hedge_min_delay: float = 0.05
+    kv_chunk_retries: int = 0  # 0 = single-attempt transfer, as before
+    connect_timeout: float = 5.0
+    read_timeout: float = 600.0
+
+    @property
+    def breaker_on(self) -> bool:
+        return self.breaker_failures > 0 or self.breaker_timeout_rate > 0
+
+    @property
+    def budget_on(self) -> bool:
+        return self.retry_ratio > 0
+
+    @property
+    def enabled(self) -> bool:
+        return (
+            self.breaker_on
+            or self.budget_on
+            or self.adaptive_deadline
+            or self.hedge
+            or self.kv_chunk_retries > 0
+        )
+
+    @classmethod
+    def from_env(
+        cls,
+        *,
+        connect_timeout: float | None = None,
+        read_timeout: float | None = None,
+    ) -> "ResilienceConfig":
+        return cls(
+            breaker_failures=envs.VDT_ROUTER_BREAKER_FAILURES,
+            breaker_cooldown=envs.VDT_ROUTER_BREAKER_COOLDOWN_SECONDS,
+            breaker_timeout_rate=envs.VDT_ROUTER_BREAKER_TIMEOUT_RATE,
+            breaker_window=envs.VDT_ROUTER_BREAKER_WINDOW_SECONDS,
+            retry_ratio=envs.VDT_ROUTER_RETRY_BUDGET_RATIO,
+            retry_min=envs.VDT_ROUTER_RETRY_BUDGET_MIN,
+            adaptive_deadline=bool(envs.VDT_ROUTER_ADAPTIVE_DEADLINE),
+            deadline_floor=envs.VDT_ROUTER_DEADLINE_FLOOR_SECONDS,
+            deadline_ceiling=envs.VDT_ROUTER_DEADLINE_CEILING_SECONDS,
+            deadline_multiplier=envs.VDT_ROUTER_DEADLINE_MULTIPLIER,
+            hedge=bool(envs.VDT_ROUTER_HEDGE),
+            hedge_min_delay=envs.VDT_ROUTER_HEDGE_MIN_DELAY_MS / 1000.0,
+            kv_chunk_retries=envs.VDT_ROUTER_KV_CHUNK_RETRIES,
+            connect_timeout=(
+                envs.VDT_ROUTER_CONNECT_TIMEOUT_SECONDS
+                if connect_timeout is None
+                else connect_timeout
+            ),
+            read_timeout=(
+                envs.VDT_ROUTER_READ_TIMEOUT_SECONDS
+                if read_timeout is None
+                else read_timeout
+            ),
+        )
+
+
+class ResilienceManager:
+    """The one wrapper every outbound router HTTP call goes through
+    (vdt-lint VDT010 enforces it).  Disabled (the default) it adds
+    nothing to the wire; enabled, each mechanism engages only when its
+    own knob is set."""
+
+    _noop: "ResilienceManager | None" = None
+
+    def __init__(
+        self,
+        config: ResilienceConfig | None = None,
+        *,
+        metrics=None,
+        clock=time.monotonic,
+        sleep=asyncio.sleep,
+    ) -> None:
+        self.cfg = config or ResilienceConfig()
+        self.metrics = metrics
+        self.clock = clock
+        self._sleep = sleep
+        self.breakers: dict[str, CircuitBreaker] = {}
+        self.latency: dict[str, LatencyTracker] = {}
+        # Breaker transitions entered, keyed "replica_id:state" — the
+        # chaos harness asserts the open → half_open → closed walk.
+        self.transitions: _TallyCounter = _TallyCounter()
+        # Monotonic budget counters (global + per replica): the retry
+        # amplification bound is granted <= min + ratio * attempts.
+        self.first_attempts = 0
+        self.retries_granted = 0
+        self.retries_denied = 0
+        self.replica_attempts: _TallyCounter = _TallyCounter()
+        self.replica_retries: _TallyCounter = _TallyCounter()
+
+    @classmethod
+    def noop(cls) -> "ResilienceManager":
+        """Shared always-off passthrough for components constructed
+        without a RouterState (unit tests, standalone pools)."""
+        if cls._noop is None:
+            cls._noop = cls(ResilienceConfig())
+        return cls._noop
+
+    @classmethod
+    def from_env(
+        cls,
+        *,
+        metrics=None,
+        connect_timeout: float | None = None,
+        read_timeout: float | None = None,
+    ) -> "ResilienceManager":
+        return cls(
+            ResilienceConfig.from_env(
+                connect_timeout=connect_timeout, read_timeout=read_timeout
+            ),
+            metrics=metrics,
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.cfg.enabled
+
+    # ---- breakers ----
+    def _breaker(self, replica_id: str) -> CircuitBreaker:
+        br = self.breakers.get(replica_id)
+        if br is None:
+            br = self.breakers[replica_id] = CircuitBreaker(
+                failures=self.cfg.breaker_failures,
+                cooldown=self.cfg.breaker_cooldown,
+                timeout_rate=self.cfg.breaker_timeout_rate,
+                window=self.cfg.breaker_window,
+                clock=self.clock,
+            )
+            if self.metrics is not None:
+                self.metrics.set_breaker_state(
+                    replica_id, BREAKER_GAUGE[CLOSED]
+                )
+        return br
+
+    def _note_state(
+        self, replica_id: str, br: CircuitBreaker, before: str
+    ) -> None:
+        if br.state == before:
+            return
+        self.transitions[f"{replica_id}:{br.state}"] += 1
+        if self.metrics is not None:
+            self.metrics.set_breaker_state(
+                replica_id, BREAKER_GAUGE[br.state]
+            )
+        logger.info(
+            "breaker for %s: %s -> %s", replica_id, before, br.state
+        )
+
+    def replica_available(self, replica_id: str) -> bool:
+        """Placement filter: False while the replica's breaker rejects
+        all traffic (open pre-cooldown, or half-open with its single
+        probe already in flight)."""
+        if not self.cfg.breaker_on:
+            return True
+        br = self.breakers.get(replica_id)
+        return True if br is None else br.can_route()
+
+    def forget_replica(self, replica_id: str) -> None:
+        self.breakers.pop(replica_id, None)
+        self.replica_attempts.pop(replica_id, None)
+        self.replica_retries.pop(replica_id, None)
+
+    # ---- retry budget ----
+    def try_spend_retry(
+        self, replica_id: str | None = None, *, kind: str = "retry"
+    ) -> bool:
+        """Grant one retry/hedge from the budget.  Budget off = always
+        granted (the pre-ISSUE-19 unbounded-retry behavior)."""
+        if not self.cfg.budget_on:
+            return True
+        allowance = (
+            self.cfg.retry_min + self.cfg.retry_ratio * self.first_attempts
+        )
+        ok = self.retries_granted + 1 <= allowance
+        if ok and replica_id is not None:
+            per_min = max(1.0, self.cfg.retry_min / 4.0)
+            ok = (
+                self.replica_retries[replica_id] + 1
+                <= per_min
+                + self.cfg.retry_ratio * self.replica_attempts[replica_id]
+            )
+        if ok:
+            self.retries_granted += 1
+            if replica_id is not None:
+                self.replica_retries[replica_id] += 1
+        else:
+            self.retries_denied += 1
+        if kind == "retry" and self.metrics is not None:
+            self.metrics.record_retry("granted" if ok else "denied")
+        return ok
+
+    # ---- adaptive deadlines ----
+    def observe_latency(self, endpoint: str, seconds: float) -> None:
+        tr = self.latency.get(endpoint)
+        if tr is None:
+            tr = self.latency[endpoint] = LatencyTracker()
+        tr.observe(seconds)
+
+    def deadline(self, endpoint: str) -> float | None:
+        """Adaptive total deadline for a unary call, or None while
+        adaptive deadlines are off or the endpoint has too few
+        samples (the caller's fixed timeout stands)."""
+        if not self.cfg.adaptive_deadline:
+            return None
+        tr = self.latency.get(endpoint)
+        p95 = tr.p95() if tr is not None else None
+        if p95 is None:
+            return None
+        ceiling = self.cfg.deadline_ceiling or self.cfg.read_timeout
+        return min(
+            max(self.cfg.deadline_multiplier * p95, self.cfg.deadline_floor),
+            ceiling,
+        )
+
+    # ---- the wrapped request ----
+    async def request(
+        self,
+        session,
+        method: str,
+        url: str,
+        *,
+        endpoint: str,
+        replica_id: str | None = None,
+        counted: bool = True,
+        adaptive: bool = True,
+        timeout=None,
+        **kw,
+    ):
+        """One outbound HTTP call.  Returns the aiohttp ClientResponse
+        (usable as ``async with await ...``); raises BreakerOpen before
+        any I/O when the replica's breaker rejects, and propagates
+        transport errors unchanged (after feeding the breaker)."""
+        cfg = self.cfg
+        if not cfg.enabled:
+            # vdt-lint: disable=resilient-http — the disabled-mode passthrough IS the wrapper's byte-identical escape hatch
+            return await session.request(method, url, timeout=timeout, **kw)
+        if counted:
+            self.first_attempts += 1
+            if replica_id is not None:
+                self.replica_attempts[replica_id] += 1
+        br = None
+        if cfg.breaker_on and replica_id is not None:
+            br = self._breaker(replica_id)
+            before = br.state
+            ok = br.acquire()
+            self._note_state(replica_id, br, before)
+            if not ok:
+                if self.metrics is not None:
+                    self.metrics.record_breaker_rejection()
+                raise BreakerOpen(replica_id)
+        if (
+            adaptive
+            and cfg.adaptive_deadline
+            and timeout is not None
+            and timeout.total is not None
+        ):
+            total = self.deadline(endpoint)
+            if total is not None:
+                timeout = aiohttp.ClientTimeout(
+                    total=total,
+                    connect=timeout.connect,
+                    sock_read=timeout.sock_read,
+                )
+        t0 = self.clock()
+        try:
+            # vdt-lint: disable=resilient-http — the wrapper's single real egress point
+            resp = await session.request(method, url, timeout=timeout, **kw)
+        except asyncio.CancelledError:
+            raise
+        except asyncio.TimeoutError:
+            if br is not None:
+                before = br.state
+                br.record_failure(timeout=True)
+                self._note_state(replica_id, br, before)
+            raise
+        except Exception:
+            if br is not None:
+                before = br.state
+                br.record_failure(timeout=False)
+                self._note_state(replica_id, br, before)
+            raise
+        self.observe_latency(endpoint, self.clock() - t0)
+        if br is not None:
+            before = br.state
+            br.record_success()
+            self._note_state(replica_id, br, before)
+        return resp
+
+    # ---- hedging ----
+    def hedge_delay(self, endpoint: str) -> float | None:
+        """The p95-based hedge delay, or None while hedging is off or
+        the endpoint is cold (never hedge blind)."""
+        if not self.cfg.hedge:
+            return None
+        tr = self.latency.get(endpoint)
+        p95 = tr.p95() if tr is not None else None
+        if p95 is None:
+            return None
+        return max(p95, self.cfg.hedge_min_delay)
+
+    async def hedged(self, endpoint: str, replica_id: str | None, factory):
+        """Race two executions of ``factory`` (an idempotent fetch
+        coroutine factory) after the hedge delay; the first completion
+        wins and the loser is cancelled.  The hedge is drawn from the
+        retry budget; off/cold endpoints run the factory once,
+        unchanged."""
+        if not self.cfg.hedge:
+            return await factory()
+        delay = self.hedge_delay(endpoint)
+        if delay is None:
+            return await factory()
+        loop = asyncio.get_running_loop()
+        primary = loop.create_task(factory())
+        timer = loop.create_task(self._sleep(delay))
+        hedge = None
+        try:
+            # vdt-lint: disable=unbounded-wait — primary carries its own aiohttp ClientTimeout and timer is a bounded sleep
+            await asyncio.wait(
+                {primary, timer}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if primary.done():
+                return await primary  # vdt-lint: disable=unbounded-wait — task already done
+            if not self.try_spend_retry(replica_id, kind="hedge"):
+                self._record_hedge("denied")
+                # vdt-lint: disable=unbounded-wait — bounded by the request's own ClientTimeout
+                return await primary
+            hedge = loop.create_task(factory())
+            while True:
+                pending = {t for t in (primary, hedge) if not t.done()}
+                if pending:
+                    # vdt-lint: disable=unbounded-wait — both tasks carry their own ClientTimeout
+                    await asyncio.wait(
+                        pending, return_when=asyncio.FIRST_COMPLETED
+                    )
+                # Prefer any SUCCESSFUL completion (a failed primary
+                # must not discard a hedge that is about to succeed).
+                for task, outcome in (
+                    (primary, "primary_won"),
+                    (hedge, "hedge_won"),
+                ):
+                    if (
+                        task.done()
+                        and not task.cancelled()
+                        and task.exception() is None
+                    ):
+                        self._record_hedge(outcome)
+                        # vdt-lint: disable=async-blocking,unbounded-wait — asyncio.Task.result() on a DONE task returns immediately
+                        return task.result()
+                if primary.done() and hedge.done():
+                    self._record_hedge("both_failed")
+                    # vdt-lint: disable=async-blocking,unbounded-wait — done task; raises the primary error
+                    return primary.result()
+        finally:
+            for task in (primary, timer, hedge):
+                if task is not None and not task.done():
+                    task.cancel()
+
+    def _record_hedge(self, outcome: str) -> None:
+        if self.metrics is not None:
+            self.metrics.record_hedge(outcome)
+
+    # ---- introspection ----
+    def snapshot(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "breakers": {
+                rid: br.state for rid, br in self.breakers.items()
+            },
+            "breaker_transitions": dict(self.transitions),
+            "budget": {
+                "ratio": self.cfg.retry_ratio,
+                "min": self.cfg.retry_min,
+                "first_attempts": self.first_attempts,
+                "retries_granted": self.retries_granted,
+                "retries_denied": self.retries_denied,
+            },
+            "deadlines": {
+                ep: self.deadline(ep) for ep in sorted(self.latency)
+            },
+        }
