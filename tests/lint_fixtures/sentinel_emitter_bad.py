@@ -1,0 +1,31 @@
+"""VDT011 positive corpus: ad-hoc event-ring appends and unregistered
+timeline kinds.  Parsed, never imported."""
+
+from collections import deque
+
+
+class AdHocRing:
+    def __init__(self):
+        self.events = deque(maxlen=128)
+        self._audit_events = deque(maxlen=64)
+
+    def record(self, kind, **detail):
+        self.events.append({"kind": kind, **detail})  # EXPECT
+
+    def audit(self, entry):
+        self._audit_events.append(entry)  # EXPECT
+
+
+class BadKinds:
+    def __init__(self, log):
+        self.log = log
+        self.sentinel = None
+
+    def note(self):
+        self.log.emit("totally_made_up_kind", answer=42)  # EXPECT
+
+    def warn(self, events):
+        events.emit("another_unregistered_kind")  # EXPECT
+
+    def flag(self):
+        self.sentinel.emit("misspelled_qos_shedd", count=1)  # EXPECT

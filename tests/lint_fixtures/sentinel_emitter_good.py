@@ -1,0 +1,46 @@
+"""VDT011 negative corpus: registered-kind emissions, waived legacy
+rings, and appends that are not event rings.  Parsed, never imported."""
+
+from collections import deque
+
+
+class RegisteredKinds:
+    def __init__(self, log, sentinel):
+        self.log = log
+        self.sentinel = sentinel
+
+    def shed(self, n):
+        # Literal kind registered in engine/sentinel.py EVENT_KINDS.
+        self.log.emit("qos_shed", count=n)
+
+    def breaker(self, rid, state):
+        self.sentinel.emit("breaker_transition", replica_id=rid, state=state)
+
+    def dynamic(self, kind, **attrs):
+        # Dynamic kinds defer to SentinelLog.emit's runtime check.
+        self.log.emit(kind, **attrs)
+
+
+class WaivedLegacyRing:
+    def __init__(self):
+        self.events = deque(maxlen=128)
+
+    def record(self, kind, detail):
+        # vdt-lint: disable=sentinel-emitter — legacy ring mirrored into the sentinel by the caller
+        self.events.append((kind, detail))
+
+
+class NotAnEventRing:
+    def __init__(self):
+        self.samples = deque(maxlen=64)
+        self.pending = []
+
+    def observe(self, value):
+        # Plain data buffers are not timeline rings.
+        self.samples.append(value)
+        self.pending.append(value)
+
+
+def emitter_helper(emitter):
+    # .emit on a receiver that is not a sentinel log / timeline.
+    return emitter.emit("whatever_signal_name")

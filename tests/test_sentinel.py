@@ -1,0 +1,561 @@
+"""Fleet sentinel unit tests (ISSUE 20): the event log contract, the
+timeline-merge determinism pin (order-independent, bit-equal to a
+union recompute), the multi-window burn-rate math against synthetic
+attainment traces, and the router sentinel's anomaly scoring /
+alerting on synthetic probe scrapes.  Everything runs on fake clocks —
+no sleeps, no sockets."""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+from vllm_distributed_tpu.engine.sentinel import (
+    BURN_WINDOWS,
+    EVENT_KINDS,
+    BurnRateTracker,
+    SentinelLog,
+)
+from vllm_distributed_tpu.router.sentinel import (
+    SIGNAL_EPS,
+    SIGNALS,
+    RouterSentinel,
+    merge_timelines,
+    parse_sentinel_samples,
+    robust_zscores,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+# ---------------------------------------------------------------------
+# SentinelLog
+# ---------------------------------------------------------------------
+def test_log_emit_shape_and_seq():
+    clock, wall = FakeClock(10.0), FakeClock(1e9)
+    log = SentinelLog("engine", maxlen=8, clock=clock, wall=wall)
+    e1 = log.emit("qos_shed", count=3)
+    clock.advance(0.5)
+    e2 = log.emit("kv_handoff", replica_id="r1", trace_id="t1", pages=4)
+    assert e1["seq"] == 1 and e2["seq"] == 2
+    assert e1["source"] == "engine" and e1["kind"] == "qos_shed"
+    assert e1["attrs"] == {"count": 3}
+    assert "replica_id" not in e1  # empty ids are omitted
+    assert e2["replica_id"] == "r1" and e2["trace_id"] == "t1"
+    assert e2["ts_mono"] > e1["ts_mono"]
+    assert len(log) == 2 and [e["seq"] for e in log.snapshot()] == [1, 2]
+
+
+def test_log_bounded_ring_keeps_newest():
+    log = SentinelLog("engine", maxlen=3)
+    for i in range(10):
+        log.emit("qos_shed", count=i)
+    snap = log.snapshot()
+    assert len(snap) == 3
+    assert [e["attrs"]["count"] for e in snap] == [7, 8, 9]
+    assert snap[-1]["seq"] == 10  # seq keeps counting past evictions
+
+
+def test_log_rejects_unregistered_kind():
+    log = SentinelLog("engine", maxlen=8)
+    with pytest.raises(ValueError, match="unregistered"):
+        log.emit("definitely_not_a_kind")
+
+
+def test_log_disabled_is_inert():
+    log = SentinelLog("engine", maxlen=0)
+    assert not log.enabled
+    assert log.emit("qos_shed") is None
+    assert log.snapshot() == [] and len(log) == 0
+    # Kind validation still applies while disabled — a typo must not
+    # hide behind VDT_SENTINEL_EVENTS_SIZE=0 deployments.
+    with pytest.raises(ValueError):
+        log.emit("typo_kind")
+
+
+def test_alert_kinds_are_registered():
+    # Every alert the router raises mirrors into the timeline as
+    # alert_<kind>; the vocabulary must contain them.
+    for kind in ("slo_burn", "replica_degraded", "replica_unreachable"):
+        assert f"alert_{kind}" in EVENT_KINDS
+
+
+# ---------------------------------------------------------------------
+# Timeline merge: order-independent, bit-equal to union recompute
+# ---------------------------------------------------------------------
+def _synthetic_logs(seed: int = 7) -> dict[str, list[dict]]:
+    rng = random.Random(seed)
+    kinds = sorted(EVENT_KINDS)
+    parts: dict[str, list[dict]] = {}
+    for owner in ("router", "r1", "r2", "r3"):
+        events = []
+        for seq in range(1, 40):
+            events.append({
+                "ts_mono": round(rng.uniform(0, 100), 6),
+                # Deliberate collisions: identical ts_wall across
+                # owners must still order totally.
+                "ts_wall": round(rng.choice([1.0, 2.0, rng.uniform(0, 60)]), 6),
+                "source": "router" if owner == "router" else "engine",
+                "kind": rng.choice(kinds),
+                "seq": seq,
+                "attrs": {"n": seq},
+            })
+        parts[owner] = events
+    return parts
+
+
+def test_merge_is_order_independent_and_bit_equal():
+    parts = _synthetic_logs()
+    offsets = {"router": 0.0, "r1": 0.25, "r2": -1.5, "r3": 0.0}
+    reference = merge_timelines(parts, offsets)
+    ref_json = json.dumps(reference, sort_keys=True)
+
+    rng = random.Random(123)
+    for _ in range(10):
+        shuffled = {}
+        for owner, events in parts.items():
+            ev = [dict(e) for e in events]
+            rng.shuffle(ev)
+            shuffled[owner] = ev
+        # Present owners in a shuffled insertion order too.
+        owners = list(shuffled)
+        rng.shuffle(owners)
+        again = merge_timelines(
+            {o: shuffled[o] for o in owners}, offsets
+        )
+        assert json.dumps(again, sort_keys=True) == ref_json
+
+    # Bit-equal to recomputing from the union: re-merging the merged
+    # stream (grouped back by origin) reproduces itself.
+    regrouped: dict[str, list[dict]] = {}
+    for ev in reference:
+        item = {
+            k: v for k, v in ev.items() if k not in ("origin", "ts")
+        }
+        # The corrected ts must be reconstructible from ts_wall.
+        regrouped.setdefault(ev["origin"], []).append(item)
+    assert (
+        json.dumps(merge_timelines(regrouped, offsets), sort_keys=True)
+        == ref_json
+    )
+
+
+def test_merge_applies_clock_offsets():
+    parts = {
+        "router": [
+            {"ts_wall": 100.0, "source": "router", "kind": "spawn", "seq": 1}
+        ],
+        "r1": [
+            # r1's wall clock runs 5 s ahead of the router's: an event
+            # it stamped at 103 actually happened at 98, BEFORE the
+            # router's event.
+            {"ts_wall": 103.0, "source": "engine", "kind": "ready", "seq": 1}
+        ],
+    }
+    merged = merge_timelines(parts, {"router": 0.0, "r1": 5.0})
+    assert [e["origin"] for e in merged] == ["r1", "router"]
+    assert merged[0]["ts"] == 98.0
+    # Without offsets the raw wall order wins.
+    merged = merge_timelines(parts)
+    assert [e["origin"] for e in merged] == ["router", "r1"]
+
+
+# ---------------------------------------------------------------------
+# Burn-rate math on synthetic attainment traces
+# ---------------------------------------------------------------------
+def _drive(tracker, clock, seconds, rps, err_rate, state):
+    """Advance a cumulative (requests, goodput) trace; returns every
+    alert fired along the way."""
+    fired = []
+    for _ in range(int(seconds / 10)):
+        clock.advance(10)
+        state["req"] += rps * 10
+        state["good"] += int(rps * 10 * (1 - err_rate))
+        fired += tracker.observe("rt", state["req"], state["good"])
+    return fired
+
+
+def test_burn_zero_on_perfect_attainment():
+    clock = FakeClock()
+    tracker = BurnRateTracker(
+        objective=0.99, threshold=10.0, clock=clock
+    )
+    state = {"req": 0, "good": 0}
+    assert _drive(tracker, clock, 3600, rps=10, err_rate=0.0, state=state) == []
+    rates = tracker.burn_rates("rt")
+    assert set(rates) == {w for w, _ in BURN_WINDOWS}
+    assert all(r == 0.0 for r in rates.values())
+    assert tracker.peak == 0.0
+
+
+def test_burn_rate_value_matches_the_math():
+    clock = FakeClock()
+    tracker = BurnRateTracker(
+        objective=0.99, threshold=10.0, clock=clock
+    )
+    state = {"req": 0, "good": 0}
+    # A steady 2% error rate: burn = 0.02 / (1 - 0.99) = 2.0 on every
+    # window once the trace spans them.
+    _drive(tracker, clock, 3700, rps=10, err_rate=0.02, state=state)
+    rates = tracker.burn_rates("rt")
+    assert rates["5m"] == pytest.approx(2.0, rel=0.05)
+    assert rates["1h"] == pytest.approx(2.0, rel=0.05)
+    assert tracker.peak == pytest.approx(2.0, rel=0.1)
+
+
+def test_short_burst_alone_does_not_page():
+    clock = FakeClock()
+    tracker = BurnRateTracker(
+        objective=0.99, threshold=10.0, clock=clock
+    )
+    state = {"req": 0, "good": 0}
+    _drive(tracker, clock, 3600, rps=10, err_rate=0.0, state=state)
+    # 30 s of total failure: the 5m window burns at 10, the 1h window
+    # at ~0.8 — no alert (this is the whole point of paired windows).
+    fired = _drive(tracker, clock, 30, rps=10, err_rate=1.0, state=state)
+    assert fired == []
+    rates = tracker.burn_rates("rt")
+    assert rates["5m"] == pytest.approx(10.0, rel=0.01)
+    assert rates["1h"] < 10.0
+
+
+def test_sustained_burn_fires_once_then_rearms():
+    clock = FakeClock()
+    tracker = BurnRateTracker(
+        objective=0.99, threshold=10.0, clock=clock
+    )
+    state = {"req": 0, "good": 0}
+    _drive(tracker, clock, 3600, rps=10, err_rate=0.0, state=state)
+    # Total failure: the 1h window crosses burn 10 once >10% of its
+    # requests have failed — ~6 min in, i.e. within two short windows.
+    fired = _drive(tracker, clock, 600, rps=10, err_rate=1.0, state=state)
+    assert len(fired) == 1
+    alert = fired[0]
+    assert alert["slo_class"] == "rt"
+    assert alert["threshold"] == 10.0
+    assert set(alert["burn"]) == {w for w, _ in BURN_WINDOWS}
+    assert all(v >= 10.0 for v in alert["burn"].values())
+    # Holding the breach does not re-fire (edge, not level).
+    assert _drive(tracker, clock, 300, rps=10, err_rate=1.0, state=state) == []
+    # Recovery clears the latch; a fresh excursion fires again.
+    assert _drive(tracker, clock, 7200, rps=10, err_rate=0.0, state=state) == []
+    fired = _drive(tracker, clock, 900, rps=10, err_rate=1.0, state=state)
+    assert len(fired) == 1
+    assert tracker.peak >= 10.0
+
+
+def test_burn_snapshot_covers_all_classes():
+    clock = FakeClock()
+    tracker = BurnRateTracker(objective=0.9, threshold=10.0, clock=clock)
+    tracker.observe("a", 100, 100)
+    tracker.observe("b", 50, 40)
+    snap = tracker.snapshot()
+    assert set(snap) == {"a", "b"} and tracker.classes() == ["a", "b"]
+
+
+# ---------------------------------------------------------------------
+# Robust z-scores + scrape parsing
+# ---------------------------------------------------------------------
+def test_zscores_need_a_pool():
+    assert robust_zscores({"a": 9.0, "b": 1.0}, eps=0.1) == {
+        "a": 0.0,
+        "b": 0.0,
+    }
+
+
+def test_zscores_flag_the_outlier_even_with_zero_mad():
+    # Identical pool + one outlier: MAD is 0, the eps floor keeps the
+    # z finite while still flagging the victim.
+    values = {"a": 10.0, "b": 10.0, "c": 10.0, "sick": 500.0}
+    z = robust_zscores(values, eps=SIGNAL_EPS["itl_p99_ms"])
+    assert z["a"] == z["b"] == z["c"] == 0.0
+    assert z["sick"] == pytest.approx((500 - 10) / 5.0)
+    # ...and sub-eps jitter stays unflagged.
+    jitter = {"a": 10.0, "b": 10.0, "c": 10.0, "d": 10.4}
+    assert all(
+        abs(v) < 1.0
+        for v in robust_zscores(
+            jitter, eps=SIGNAL_EPS["itl_p99_ms"]
+        ).values()
+    )
+
+
+def _scrape(itl=20.0, roofline=0.5, compiles=3, breaks=0, queries=100,
+            host_hits=40, slo=None):
+    lines = [
+        "# HELP vllm:itl_p99_ms engine-merged p99",
+        f"vllm:itl_p99_ms {itl}",
+        f"vllm:step_roofline_frac {roofline}",
+        f'vllm:xla_compiles_total{{kind="prefill"}} {compiles}',
+        f"vllm:pipeline_breaks_total {breaks}",
+        f"vllm:prefix_cache_queries_total {queries}",
+        f'vllm:prefix_cache_hits_total{{tier="hbm"}} 50',
+        f'vllm:prefix_cache_hits_total{{tier="host"}} {host_hits}',
+    ]
+    for cls, (req, good) in (slo or {}).items():
+        lines.append(
+            f'vllm:slo_requests_total{{model_name="m",slo_class="{cls}"}} {req}'
+        )
+        lines.append(
+            f'vllm:goodput_requests_total{{model_name="m",slo_class="{cls}"}} {good}'
+        )
+    return "\n".join(lines) + "\n"
+
+
+def test_parse_sentinel_samples():
+    out = parse_sentinel_samples(
+        _scrape(itl=33.5, roofline=0.62, compiles=7, breaks=2,
+                queries=200, host_hits=80, slo={"rt": (100, 90)})
+    )
+    assert out["itl_p99_ms"] == 33.5
+    assert out["roofline_frac"] == 0.62
+    assert out["compiles"] == 7 and out["pipeline_breaks"] == 2
+    assert out["prefix_queries"] == 200
+    assert out["host_hits"] == 80  # host tier only, hbm excluded
+    assert out["slo"] == {"rt": [100.0, 90.0]}
+
+
+# ---------------------------------------------------------------------
+# RouterSentinel end-to-end on synthetic probes
+# ---------------------------------------------------------------------
+class FakeManager:
+    def __init__(self):
+        self.recommended = []
+
+    def note_recycle_recommendation(self, rid, **detail):
+        self.recommended.append((rid, detail))
+
+
+def _probe_all(sentinel, clock, itl_by_rid, **kw):
+    for rid, itl in itl_by_rid.items():
+        sentinel.note_probe(rid, _scrape(itl=itl, **kw))
+
+
+def test_anomaly_scoring_singles_out_the_degraded_replica():
+    clock = FakeClock()
+    sentinel = RouterSentinel(
+        anomaly_threshold=4.0, clock=clock, wall=FakeClock(2e9)
+    )
+    manager = FakeManager()
+    sentinel.manager = manager
+    healthy = {"r1": 20.0, "r2": 22.0, "r3": 19.0}
+    _probe_all(sentinel, clock, healthy)
+    assert sentinel.outliers() == set()
+    # r2 degrades hard: ITL p99 jumps 20ms -> 400ms.
+    clock.advance(5)
+    _probe_all(sentinel, clock, {**healthy, "r2": 400.0})
+    assert sentinel.outliers() == {"r2"}
+    assert abs(sentinel.scores["r2"]["itl_p99_ms"]) >= 4.0
+    degraded = [
+        a for a in sentinel.alerts_snapshot()
+        if a["kind"] == "replica_degraded"
+    ]
+    assert len(degraded) == 1 and degraded[0]["replica_id"] == "r2"
+    assert degraded[0]["signal"] == "itl_p99_ms"
+    assert manager.recommended and manager.recommended[0][0] == "r2"
+    # Still degraded on the next probe: edge-triggered, no new alert.
+    clock.advance(5)
+    _probe_all(sentinel, clock, {**healthy, "r2": 400.0})
+    assert len([
+        a for a in sentinel.alerts_snapshot()
+        if a["kind"] == "replica_degraded"
+    ]) == 1
+    # Recovery drops it out of the outlier set and re-arms the alert.
+    clock.advance(5)
+    _probe_all(sentinel, clock, healthy)
+    assert sentinel.outliers() == set()
+    # The timeline carries the typed alert event.
+    kinds = [e["kind"] for e in sentinel.log.snapshot()]
+    assert "alert_replica_degraded" in kinds
+
+
+def test_rate_signals_come_from_probe_deltas():
+    clock = FakeClock()
+    sentinel = RouterSentinel(
+        anomaly_threshold=4.0, clock=clock, wall=FakeClock(2e9)
+    )
+    sentinel.note_probe("r1", _scrape(compiles=10))
+    clock.advance(10)
+    sentinel.note_probe("r1", _scrape(compiles=30))
+    assert sentinel.signals["r1"]["compile_rate"] == pytest.approx(2.0)
+    assert sentinel.signals["r1"]["pipeline_break_rate"] == 0.0
+
+
+def test_fleet_burn_sums_replica_counters():
+    clock = FakeClock()
+    sentinel = RouterSentinel(
+        anomaly_threshold=4.0, clock=clock, wall=FakeClock(2e9)
+    )
+    sentinel.burn = BurnRateTracker(
+        objective=0.99, threshold=10.0, clock=clock
+    )
+    sentinel.note_probe("r1", _scrape(slo={"rt": (100, 100)}))
+    sentinel.note_probe("r2", _scrape(slo={"rt": (50, 50)}))
+    # Fleet trail saw 150/150 — now r2 fails everything for 10 min.
+    for _ in range(60):
+        clock.advance(10)
+        sentinel.note_probe("r1", _scrape(slo={"rt": (100, 100)}))
+        sentinel.note_probe(
+            "r2", _scrape(slo={"rt": (50 + 100, 50)})
+        )
+    burn_alerts = [
+        a for a in sentinel.alerts_snapshot() if a["kind"] == "slo_burn"
+    ]
+    assert len(burn_alerts) == 1
+    assert burn_alerts[0]["slo_class"] == "rt"
+    assert sentinel.burn.peak >= 10.0
+
+
+def test_state_and_breaker_hooks_alert():
+    clock = FakeClock()
+    sentinel = RouterSentinel(clock=clock, wall=FakeClock(2e9))
+    sentinel.note_replica_state("r1", "healthy", "unreachable")
+    sentinel.note_replica_state("r2", "stopping", "unreachable")  # expected
+    sentinel.note_breaker("r3", "open")
+    sentinel.note_breaker("r3", "half_open")
+    kinds = [(a["kind"], a["replica_id"]) for a in sentinel.alerts_snapshot()]
+    assert ("replica_unreachable", "r1") in kinds
+    assert all(rid != "r2" for _, rid in kinds)
+    assert ("replica_degraded", "r3") in kinds
+    timeline = [e["kind"] for e in sentinel.log.snapshot()]
+    assert timeline.count("breaker_transition") == 2
+    assert timeline.count("replica_state") == 2
+
+
+def test_forget_replica_clears_every_map():
+    clock = FakeClock()
+    sentinel = RouterSentinel(clock=clock, wall=FakeClock(2e9))
+    sentinel.note_probe("r1", _scrape(slo={"rt": (10, 10)}))
+    sentinel.forget_replica("r1")
+    assert "r1" not in sentinel.signals
+    assert "r1" not in sentinel.scores
+    assert "r1" not in sentinel._prev
+    assert "r1" not in sentinel._slo_counts
+
+
+def test_signal_catalog_matches_eps():
+    assert set(SIGNALS) == set(SIGNAL_EPS)
+
+
+def test_snapshot_shape():
+    sentinel = RouterSentinel(wall=FakeClock(2e9))
+    sentinel.note_probe("r1", _scrape())
+    snap = sentinel.snapshot()
+    assert set(snap) == {
+        "scores", "degraded", "burn", "burn_peak", "alerts", "events"
+    }
+    assert "r1" in snap["scores"]
+
+
+# ---------------------------------------------------------------------------
+# fleet_doctor: ranked diagnosis from the two sentinel endpoints.
+# ---------------------------------------------------------------------------
+
+
+def _doctor_payloads():
+    """Synthetic /router/alerts + /router/timeline dumps: r2 degraded
+    (huge itl z-score, one alert naming it), rt class burning."""
+    alerts_payload = {
+        "alerts": [
+            {
+                "ts_wall": 1000.0,
+                "kind": "replica_degraded",
+                "replica_id": "r2",
+                "signal": "itl_p99_ms",
+                "score": 97.9,
+            },
+            {
+                "ts_wall": 1010.0,
+                "kind": "slo_burn",
+                "replica_id": None,
+                "slo_class": "rt",
+                "burn": {"5m": 12.0, "1h": 11.0},
+            },
+        ],
+        "burn": {"rt": {"5m": 12.0, "1h": 11.0}, "batch": {"5m": 0.0, "1h": 0.0}},
+        "burn_peak": 12.0,
+        "anomaly_scores": {
+            "r1": {"itl_p99_ms": -0.3, "waiting": 0.1},
+            "r2": {"itl_p99_ms": 97.9, "waiting": 5.2},
+            "r3": {"itl_p99_ms": 0.2, "waiting": -0.4},
+        },
+    }
+    timeline_payload = {
+        "events": [
+            {"ts_wall": 990.0, "origin": "router", "source": "router",
+             "kind": "breaker_transition", "replica_id": "r2",
+             "attrs": {"state": "open"}, "seq": 1},
+            {"ts_wall": 995.0, "origin": "r2", "source": "engine",
+             "kind": "qos_shed", "attrs": {"count": 7}, "seq": 4},
+            {"ts_wall": 1000.1, "origin": "router", "source": "router",
+             "kind": "alert_replica_degraded", "replica_id": "r2", "seq": 2},
+            {"ts_wall": 500.0, "origin": "r1", "source": "engine",
+             "kind": "recovery_success", "seq": 9},
+        ],
+    }
+    return alerts_payload, timeline_payload
+
+
+def test_fleet_doctor_ranks_degraded_replica_first():
+    from tools.fleet_doctor import diagnose, format_report
+
+    alerts_payload, timeline_payload = _doctor_payloads()
+    diag = diagnose(alerts_payload, timeline_payload)
+
+    # r2 leads the ranking: named by an alert AND the worst |z|.
+    assert diag["replicas"][0]["replica_id"] == "r2"
+    assert diag["replicas"][0]["worst_signal"] == "itl_p99_ms"
+    assert diag["replicas"][0]["flagged"] is True
+    assert diag["flagged"] == ["r2"]
+    # Only rt burns on every window; batch stays quiet.
+    assert [cls for cls, _ in diag["burning_classes"]] == ["rt"]
+
+    report = format_report(diag)
+    assert "DEGRADED -> r2" in report
+    assert "class rt" in report
+
+
+def test_fleet_doctor_correlates_timeline_context():
+    from tools.fleet_doctor import diagnose
+
+    alerts_payload, timeline_payload = _doctor_payloads()
+    diag = diagnose(alerts_payload, timeline_payload)
+
+    degraded = next(
+        f for f in diag["findings"]
+        if f["alert"]["kind"] == "replica_degraded"
+    )
+    kinds = [ev["kind"] for ev in degraded["context"]]
+    # Nearby causes surface; the alert's own mirror and far-away
+    # events do not.
+    assert "breaker_transition" in kinds
+    assert "qos_shed" in kinds
+    assert "alert_replica_degraded" not in kinds
+    assert "recovery_success" not in kinds
+
+
+def test_fleet_doctor_healthy_fleet_is_quiet():
+    from tools.fleet_doctor import diagnose, format_report
+
+    diag = diagnose(
+        {"alerts": [], "burn": {"rt": {"5m": 0.5, "1h": 0.2}},
+         "burn_peak": 0.5,
+         "anomaly_scores": {"r1": {"waiting": 0.2}, "r2": {"waiting": -0.2}}},
+        {"events": []},
+    )
+    assert diag["flagged"] == []
+    assert diag["burning_classes"] == []
+    report = format_report(diag)
+    assert "diagnosis: healthy" in report

@@ -340,6 +340,87 @@ def test_trace_summary_overlap_view(tmp_path, capsys):
     assert "dispatch overlap" in out
 
 
+def test_stage_order_covers_every_pipeline_span():
+    """Regression (ISSUE 20 satellite): every pipeline-stage span the
+    code records must appear in trace_summary's ``_STAGE_ORDER``, so a
+    new span kind cannot silently fall off (or to the bottom of) the
+    latency table.  ``engine.kv_handoff`` and ``router.handoff`` did
+    exactly that.  Scans the package by AST for literal names passed
+    to ``span()`` / ``record_span()`` / ``_record_stage()``."""
+    import ast
+    import pathlib
+
+    from tools.trace_summary import _STAGE_ORDER
+
+    # Control-plane spans that are deliberately not in the
+    # request-pipeline table (they still print, alphabetically).
+    non_pipeline = {"router.reconnect"}
+
+    pkg = pathlib.Path(__file__).resolve().parents[1] / "vllm_distributed_tpu"
+    recorded: set[str] = set()
+    for path in pkg.rglob("*.py"):
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            attr = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if attr in ("span", "record_span"):
+                arg_idx = 0
+            elif attr == "_record_stage":
+                arg_idx = 1  # (req, name, ...)
+            else:
+                continue
+            if len(node.args) <= arg_idx:
+                continue
+            arg = node.args[arg_idx]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if "." in arg.value:
+                    recorded.add(arg.value)
+    # Marker stages recorded as instant events on a parent span.
+    recorded.add("router.handoff")
+    assert recorded, "AST scan found no recorded spans — scanner broken?"
+    missing = recorded - set(_STAGE_ORDER) - non_pipeline
+    assert not missing, (
+        f"span kinds missing from trace_summary._STAGE_ORDER: "
+        f"{sorted(missing)} — add them (or to the non_pipeline "
+        "allowlist if they are not request-pipeline stages)"
+    )
+    assert "engine.kv_handoff" in _STAGE_ORDER
+    assert "router.handoff" in _STAGE_ORDER
+
+
+def test_trace_summary_marker_stage_rows():
+    """Stages recorded as instant events (router.handoff) get a
+    count-only row instead of vanishing."""
+    from tools.trace_summary import format_table, summarize
+
+    traces = [{
+        "trace_id": "t0",
+        "spans": [
+            {"name": "router.request", "start": 0.0, "duration": 0.2},
+            {"name": "router.handoff", "start": 0.1, "duration": None},
+            {"name": "engine.preempted", "start": 0.1, "duration": None},
+        ],
+    }]
+    stats = summarize(traces)
+    assert stats["router.handoff"]["count"] == 1
+    assert stats["router.handoff"]["p50"] is None
+    # Non-stage markers stay excluded, as before.
+    assert "engine.preempted" not in stats
+    table = format_table(stats)
+    assert "router.handoff" in table
+    # The marker row renders dashes, ordered right after router.request.
+    lines = table.splitlines()
+    assert lines.index(
+        next(ln for ln in lines if ln.startswith("router.handoff"))
+    ) == lines.index(
+        next(ln for ln in lines if ln.startswith("router.request"))
+    ) + 1
+
+
 # ---------------------------------------------------------------------
 # engine no-op path + /debug/traces while disabled
 # ---------------------------------------------------------------------

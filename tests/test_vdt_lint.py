@@ -42,13 +42,17 @@ RULES = {
     "unbounded-queue": "VDT008",
     "bounded-cardinality": "VDT009",
     "resilient-http": "VDT010",
+    "sentinel-emitter": "VDT011",
 }
 
 # Rules whose scope excludes distributed/ seed into a directory where
-# they DO apply (VDT010 only checks the router's outbound data plane).
+# they DO apply (VDT010 only checks the router's outbound data plane,
+# VDT011 the engine/router timeline emitters).
 SEED_DIRS = {
     "resilient_http_bad.py": "router",
     "resilient_http_good.py": "router",
+    "sentinel_emitter_bad.py": "router",
+    "sentinel_emitter_good.py": "router",
 }
 
 

@@ -1363,6 +1363,7 @@ def run_partition_soak(
         "rejected": 0,
         "resumed_transfers": 0,
         "breaker_walks": 0,
+        "degraded_alerts": 0,
     }
     stalls: list[float] = []
 
@@ -1684,6 +1685,32 @@ def run_partition_soak(
                     f"open->half_open->closed for {rid}",
                     file=sys.stderr,
                 )
+            # The sentinel (ISSUE 20) must have singled the victim
+            # out while the partition held: a degraded/unreachable
+            # alert NAMING rid on the bounded /router/alerts feed.
+            try:
+                async with session.get(
+                    f"{router_url}/router/alerts",
+                    timeout=aiohttp.ClientTimeout(total=10),
+                ) as resp:
+                    alerts = (await resp.json()).get("alerts", [])
+            except Exception:  # noqa: BLE001 — judged via degraded_alerts below
+                alerts = []
+            named = [
+                a
+                for a in alerts
+                if a.get("replica_id") == rid
+                and a.get("kind")
+                in ("replica_degraded", "replica_unreachable")
+            ]
+            if named:
+                stats["degraded_alerts"] += 1
+            else:
+                print(
+                    f"cycle{n}: no sentinel alert named {rid} "
+                    f"(alerts={alerts})",
+                    file=sys.stderr,
+                )
 
         async with aiohttp.ClientSession() as session:
             # Clean-link warmup: the pool learns its replicas and the
@@ -1763,6 +1790,7 @@ def run_partition_soak(
                 and stats["mismatches"] == 0
                 and stats["resumed_transfers"] >= 1
                 and stats["breaker_walks"] >= min(n_partition, 1)
+                and stats["degraded_alerts"] >= min(n_partition, 1)
                 and counters.get("kv.transfer_resumes", 0) >= 1
                 and granted <= allowance
                 and (not stalls or max(stalls) <= stall_bound_s)
@@ -2184,6 +2212,73 @@ def _pid_alive(pid: int) -> bool:
     return True
 
 
+async def _stall_one_child(session, router_url: str, snap: dict) -> bool:
+    """SIGSTOP one live replica child, require a sentinel alert naming
+    it on /router/alerts, SIGCONT, and wait for it to probe healthy
+    again.  Returns whether the named alert fired."""
+    import asyncio
+    import signal
+
+    import aiohttp
+
+    victims = [
+        x
+        for x in (snap.get("replicas") or [])
+        if x.get("pid") and _pid_alive(int(x["pid"]))
+    ]
+    if not victims:
+        print("sentinel check: no live child to stall", file=sys.stderr)
+        return False
+    victim = victims[0]
+    rid, pid = victim["replica_id"], int(victim["pid"])
+    os.kill(pid, signal.SIGSTOP)
+    named = False
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not named:
+            try:
+                async with session.get(
+                    f"{router_url}/router/alerts",
+                    timeout=aiohttp.ClientTimeout(total=5),
+                ) as resp:
+                    alerts = (await resp.json()).get("alerts", [])
+            except Exception:  # noqa: BLE001 — poll until the deadline judges it
+                alerts = []
+            named = any(
+                a.get("replica_id") == rid
+                and a.get("kind")
+                in ("replica_degraded", "replica_unreachable")
+                for a in alerts
+            )
+            if not named:
+                await asyncio.sleep(0.25)
+    finally:
+        os.kill(pid, signal.SIGCONT)
+    if not named:
+        print(
+            f"sentinel check: no alert named {rid} while stalled",
+            file=sys.stderr,
+        )
+    # Thaw back to healthy so teardown drains cleanly.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            async with session.get(
+                f"{router_url}/router/state",
+                timeout=aiohttp.ClientTimeout(total=5),
+            ) as resp:
+                pool = (await resp.json()).get("replicas", [])
+            if any(
+                r.get("replica_id") == rid and r.get("state") == "healthy"
+                for r in pool
+            ):
+                break
+        except Exception:  # noqa: BLE001 — router busy; keep polling
+            pass
+        await asyncio.sleep(0.25)
+    return named
+
+
 def run_router_kill(
     *,
     cycles: int = 1,
@@ -2507,6 +2602,14 @@ def run_router_kill(
                             stats["completed"] += 1
                             if r in interrupted:
                                 stats["resumed"] += 1
+                    # Sentinel check (ISSUE 20): freeze one adopted
+                    # child (SIGSTOP — alive but silent, the degraded-
+                    # replica shape) and require the restarted router's
+                    # sentinel to raise an alert NAMING it, then thaw
+                    # and wait for it to probe healthy again.
+                    crep["degraded_alert"] = await _stall_one_child(
+                        session, router_url, snap
+                    )
                     per_cycle.append(crep)
                 # Graceful goodbye: SIGTERM drains and reaps the fleet.
                 proc.send_signal(signal.SIGTERM)
@@ -2563,6 +2666,7 @@ def run_router_kill(
                 and all(c["double_spawns"] == 0 for c in per)
                 and all(c["pids_preserved"] for c in per)
                 and all(c["killed_mid_scale_up"] for c in per)
+                and all(c.get("degraded_alert") for c in per)
                 and not out["leaked"]
             ),
         }

@@ -1,0 +1,240 @@
+"""Ranked fleet diagnosis from the sentinel surfaces (ISSUE 20).
+
+The command-line companion to ``/router/timeline`` and
+``/router/alerts``: point it at a live router (or at saved JSON dumps
+of both endpoints) and it prints a ranked diagnosis — which replica
+looks wrong, on which signal, how hard, which alerts named it, and the
+timeline events that surround each alert so the probable cause is on
+the same screen as the symptom.
+
+    python tools/fleet_doctor.py http://localhost:9000
+    python tools/fleet_doctor.py --alerts alerts.json --timeline timeline.json
+
+Exit status: 0 when nothing is flagged, 1 when at least one replica is
+degraded (anomaly score past threshold or named by an alert) or an SLO
+class is burning past threshold — so the tool doubles as a scriptable
+health check.
+
+Pure stdlib; the inputs are exactly the shapes served by the router:
+``/router/alerts`` -> {"alerts": [...], "burn": {...}, "burn_peak": x,
+"anomaly_scores": {rid: {signal: z}}} and ``/router/timeline`` ->
+{"events": [...]}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+#: Timeline events within this many wall-clock seconds of an alert are
+#: shown as correlated context under it.
+CONTEXT_WINDOW_S = 30.0
+
+#: Alert kinds that name a replica as the problem.
+_REPLICA_ALERT_KINDS = ("replica_degraded", "replica_unreachable")
+
+
+def load_json(source: str) -> dict:
+    """Read one endpoint dump from a file path, URL, or ``-`` (stdin)."""
+    if source.startswith(("http://", "https://")):
+        with urllib.request.urlopen(source, timeout=30) as resp:
+            return json.load(resp)
+    if source == "-":
+        return json.load(sys.stdin)
+    with open(source) as f:
+        return json.load(f)
+
+
+def worst_signal(per_signal: dict) -> tuple[str, float]:
+    """(signal, z) with the largest magnitude; ("", 0.0) when empty."""
+    if not per_signal:
+        return "", 0.0
+    signal = max(per_signal, key=lambda s: abs(per_signal[s]))
+    return signal, per_signal[signal]
+
+
+def rank_replicas(
+    scores: dict, alerts: list[dict], threshold: float
+) -> list[dict]:
+    """Rank replicas most-suspect first.
+
+    The rank key is (named by an alert, worst |z|): an alert is a
+    confirmed edge-triggered detection, a score is the live reading —
+    a replica that recovered keeps its alert history but its score
+    decays, and it should still outrank a mildly-noisy healthy one.
+    """
+    alert_counts: dict[str, int] = {}
+    for alert in alerts:
+        rid = alert.get("replica_id")
+        if rid and alert.get("kind") in _REPLICA_ALERT_KINDS:
+            alert_counts[rid] = alert_counts.get(rid, 0) + 1
+
+    rows = []
+    for rid in sorted(set(scores) | set(alert_counts)):
+        signal, z = worst_signal(scores.get(rid) or {})
+        rows.append({
+            "replica_id": rid,
+            "worst_signal": signal,
+            "worst_z": round(z, 2),
+            "alerts": alert_counts.get(rid, 0),
+            "flagged": abs(z) >= threshold or alert_counts.get(rid, 0) > 0,
+        })
+    rows.sort(key=lambda r: (r["alerts"] > 0, abs(r["worst_z"])), reverse=True)
+    return rows
+
+
+def burning_classes(burn: dict, threshold: float) -> list[tuple[str, dict]]:
+    """SLO classes whose burn exceeds threshold on EVERY window —
+    the same all-windows conjunction the alerting rule uses."""
+    out = []
+    for cls, windows in sorted((burn or {}).items()):
+        if windows and all(r >= threshold for r in windows.values()):
+            out.append((cls, windows))
+    return out
+
+
+def correlate(alert: dict, events: list[dict], window: float = CONTEXT_WINDOW_S) -> list[dict]:
+    """Timeline events within ``window`` seconds of the alert, the
+    alert's own ``alert_*`` mirror excluded."""
+    ts = alert.get("ts_wall")
+    if ts is None:
+        return []
+    out = []
+    for ev in events:
+        ev_ts = ev.get("ts_wall")
+        if ev_ts is None or abs(ev_ts - ts) > window:
+            continue
+        if ev.get("kind", "").startswith("alert_"):
+            continue
+        out.append(ev)
+    return out
+
+
+def diagnose(
+    alerts_payload: dict,
+    timeline_payload: dict,
+    threshold: float = 4.0,
+    burn_threshold: float = 10.0,
+) -> dict:
+    """Pure core: turn the two endpoint payloads into a diagnosis dict
+    (rendered by :func:`format_report`, asserted by tests)."""
+    alerts = alerts_payload.get("alerts") or []
+    scores = alerts_payload.get("anomaly_scores") or {}
+    events = timeline_payload.get("events") or []
+
+    replicas = rank_replicas(scores, alerts, threshold)
+    burning = burning_classes(alerts_payload.get("burn") or {}, burn_threshold)
+    findings = []
+    for alert in alerts:
+        findings.append({
+            "alert": alert,
+            "context": correlate(alert, events),
+        })
+    return {
+        "replicas": replicas,
+        "flagged": [r["replica_id"] for r in replicas if r["flagged"]],
+        "burning_classes": burning,
+        "burn_peak": alerts_payload.get("burn_peak", 0.0),
+        "findings": findings,
+        "n_events": len(events),
+    }
+
+
+def _fmt_event(ev: dict) -> str:
+    bits = [f"{ev.get('ts_wall', 0):.3f}", ev.get("origin") or ev.get("source", "?"), ev.get("kind", "?")]
+    if ev.get("replica_id"):
+        bits.append(f"replica={ev['replica_id']}")
+    attrs = ev.get("attrs") or {}
+    for key in sorted(attrs)[:4]:
+        bits.append(f"{key}={attrs[key]}")
+    return "  ".join(str(b) for b in bits)
+
+
+def format_report(diag: dict) -> str:
+    lines = ["fleet doctor", "============", ""]
+
+    if diag["burning_classes"]:
+        lines.append("SLO burn (all windows past threshold):")
+        for cls, windows in diag["burning_classes"]:
+            burns = "  ".join(f"{w}={r:.1f}x" for w, r in sorted(windows.items()))
+            lines.append(f"  class {cls}: {burns}")
+    else:
+        lines.append(f"SLO burn: no class past threshold (peak {diag['burn_peak']:.1f}x)")
+    lines.append("")
+
+    if diag["replicas"]:
+        lines.append("replica ranking (most suspect first):")
+        lines.append(f"  {'replica':<24} {'worst signal':<20} {'z':>8} {'alerts':>7}  verdict")
+        for row in diag["replicas"]:
+            verdict = "DEGRADED" if row["flagged"] else "ok"
+            lines.append(
+                f"  {row['replica_id']:<24} {row['worst_signal'] or '-':<20}"
+                f" {row['worst_z']:>8.2f} {row['alerts']:>7}  {verdict}"
+            )
+    else:
+        lines.append("replica ranking: no anomaly scores (pool too small or sentinel off)")
+    lines.append("")
+
+    if diag["findings"]:
+        lines.append(f"alerts ({len(diag['findings'])}), each with timeline context (±{CONTEXT_WINDOW_S:.0f}s):")
+        for finding in diag["findings"]:
+            alert = finding["alert"]
+            who = alert.get("replica_id") or alert.get("slo_class") or "-"
+            lines.append(f"  [{alert.get('ts_wall', 0):.3f}] {alert.get('kind', '?')} -> {who}")
+            for ev in finding["context"][-8:]:
+                lines.append(f"      {_fmt_event(ev)}")
+    else:
+        lines.append("alerts: none")
+    lines.append("")
+
+    if diag["flagged"]:
+        lines.append("diagnosis: DEGRADED -> " + ", ".join(diag["flagged"]))
+    else:
+        lines.append(f"diagnosis: healthy ({diag['n_events']} timeline events scanned)")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "router", nargs="?",
+        help="router base URL (fetches /router/alerts and /router/timeline)",
+    )
+    parser.add_argument("--alerts", help="saved /router/alerts JSON (file, URL, or -)")
+    parser.add_argument("--timeline", help="saved /router/timeline JSON (file, URL, or -)")
+    parser.add_argument(
+        "--threshold", type=float, default=4.0,
+        help="|z| past this flags a replica (default 4, matches VDT_SENTINEL_ANOMALY_THRESHOLD)",
+    )
+    parser.add_argument(
+        "--burn-threshold", type=float, default=10.0,
+        help="burn rate past this on every window flags a class (default 10)",
+    )
+    parser.add_argument("--json", action="store_true", help="emit the diagnosis as JSON")
+    args = parser.parse_args(argv)
+
+    if args.router:
+        base = args.router.rstrip("/")
+        alerts_payload = load_json(f"{base}/router/alerts")
+        timeline_payload = load_json(f"{base}/router/timeline")
+    elif args.alerts or args.timeline:
+        alerts_payload = load_json(args.alerts) if args.alerts else {}
+        timeline_payload = load_json(args.timeline) if args.timeline else {}
+    else:
+        parser.error("need a router URL or --alerts/--timeline dumps")
+
+    diag = diagnose(
+        alerts_payload, timeline_payload,
+        threshold=args.threshold, burn_threshold=args.burn_threshold,
+    )
+    if args.json:
+        print(json.dumps(diag, indent=2, sort_keys=True))
+    else:
+        print(format_report(diag))
+    return 1 if (diag["flagged"] or diag["burning_classes"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

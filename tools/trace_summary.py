@@ -27,9 +27,11 @@ import urllib.request
 # /debug/traces shows the whole path under one trace id.
 _STAGE_ORDER = [
     "router.request",
+    "router.handoff",
     "api.request",
     "engine.queue",
     "engine.kv_restore",
+    "engine.kv_handoff",
     "engine.prefill",
     "engine.decode",
     "scheduler.schedule",
@@ -68,12 +70,21 @@ def percentile(sorted_values: list[float], q: float) -> float:
 def summarize(traces: list[dict]) -> dict[str, dict[str, float]]:
     """Aggregate span durations by name: count/p50/p90/p99/max (s)."""
     by_name: dict[str, list[float]] = {}
+    marker_counts: dict[str, int] = {}
     for trace in traces:
         for span in trace.get("spans", []):
+            name = span["name"]
             duration = span.get("duration")
             if duration is None:
-                continue  # instant event (preemption/replay marker)
-            by_name.setdefault(span["name"], []).append(float(duration))
+                # Instant event.  Pipeline stages recorded as markers
+                # (router.handoff is an event on the router's request
+                # span, not a timed child) still get a count-only row —
+                # a stage listed in _STAGE_ORDER must never silently
+                # vanish from the table.
+                if name in _STAGE_ORDER:
+                    marker_counts[name] = marker_counts.get(name, 0) + 1
+                continue
+            by_name.setdefault(name, []).append(float(duration))
     stats: dict[str, dict[str, float]] = {}
     for name, durations in by_name.items():
         durations.sort()
@@ -84,6 +95,15 @@ def summarize(traces: list[dict]) -> dict[str, dict[str, float]]:
             "p99": percentile(durations, 0.99),
             "max": durations[-1],
         }
+    for name, count in marker_counts.items():
+        if name not in stats:
+            stats[name] = {
+                "count": count,
+                "p50": None,
+                "p90": None,
+                "p99": None,
+                "max": None,
+            }
     return stats
 
 
@@ -222,6 +242,13 @@ def format_table(stats: dict[str, dict[str, float]]) -> str:
     lines = [header, "-" * len(header)]
     for name in names:
         s = stats[name]
+        if s["p50"] is None:  # count-only marker stage
+            dash = f"{'-':>10}"
+            lines.append(
+                f"{name:<22} {int(s['count']):>7} {dash} {dash} "
+                f"{dash} {dash}"
+            )
+            continue
         lines.append(
             f"{name:<22} {int(s['count']):>7} {s['p50'] * 1e3:>10.2f} "
             f"{s['p90'] * 1e3:>10.2f} {s['p99'] * 1e3:>10.2f} "

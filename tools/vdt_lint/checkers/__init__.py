@@ -12,6 +12,7 @@
 | VDT008 | unbounded-queue  | queues/deques on the request path carry a bound  |
 | VDT009 | bounded-cardinality | metric labels never derive from unbounded sources |
 | VDT010 | resilient-http   | router outbound HTTP goes through the resilience wrapper |
+| VDT011 | sentinel-emitter | timeline events go through SentinelLog.emit with registered kinds |
 """
 
 from tools.vdt_lint.checkers import (  # noqa: F401
@@ -21,6 +22,7 @@ from tools.vdt_lint.checkers import (  # noqa: F401
     lock_across_await,
     orphan_span,
     resilient_http,
+    sentinel_emitter,
     silent_except,
     thread_leak,
     unbounded_queue,

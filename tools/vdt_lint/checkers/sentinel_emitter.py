@@ -1,0 +1,148 @@
+"""VDT011 sentinel-emitter: timeline events go through the sentinel
+emitter API with registered kinds.
+
+The ISSUE 20 failure class: the unified event timeline is only useful
+if it is *complete and well-typed* — one subsystem appending dicts to
+its own ad-hoc ring (instead of ``SentinelLog.emit``) produces events
+that never reach ``/debug/events`` or the fleet merge, and a free-form
+``kind`` string silently fragments the vocabulary that alerting and
+``fleet_doctor`` key on.  Two checks:
+
+* **Ad-hoc ring appends** — ``<recv>.append(...)`` where the final
+  dotted component of the receiver is ``events`` or ends with
+  ``_events`` is an event-ring append bypassing the emitter.  Legacy
+  rings that deliberately predate the timeline (the flight recorder's
+  marker ring, the fleet event deque that is mirrored into the
+  sentinel) carry inline waivers naming why.
+* **Unregistered kinds** — ``<recv>.emit("literal", ...)`` on a
+  sentinel-looking receiver (leaf ``sentinel``/``events``/``log``)
+  where the literal kind is not in ``engine/sentinel.py``'s
+  ``EVENT_KINDS``.  The vocabulary is parsed from that module by AST
+  (never imported), so the linter works on an un-importable tree.
+
+``engine/sentinel.py`` itself is exempt: it IS the emitter.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from tools.vdt_lint.astutil import dotted_name
+from tools.vdt_lint.core import Checker, FileContext, Finding, register
+
+_SENTINEL_MODULE = "engine/sentinel.py"
+_EMIT_RECEIVER_LEAVES = {"sentinel", "events", "log"}
+
+_kinds_cache: frozenset[str] | None = None
+
+
+def _registered_kinds() -> frozenset[str]:
+    """Parse EVENT_KINDS out of engine/sentinel.py without importing
+    it.  Missing module / unparseable set -> empty vocabulary, which
+    disables the kind check rather than erroring the whole lint run."""
+    global _kinds_cache
+    if _kinds_cache is not None:
+        return _kinds_cache
+    repo_root = Path(__file__).resolve().parents[3]
+    path = repo_root / "vllm_distributed_tpu" / _SENTINEL_MODULE
+    kinds: set[str] = set()
+    try:
+        tree = ast.parse(path.read_text())
+    except (OSError, SyntaxError):
+        _kinds_cache = frozenset()
+        return _kinds_cache
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        targets = [
+            t.id for t in node.targets if isinstance(t, ast.Name)
+        ]
+        if "EVENT_KINDS" not in targets:
+            continue
+        for literal in ast.walk(node.value):
+            if isinstance(literal, ast.Constant) and isinstance(
+                literal.value, str
+            ):
+                kinds.add(literal.value)
+    _kinds_cache = frozenset(kinds)
+    return _kinds_cache
+
+
+def _event_ring_receiver(func: ast.expr) -> str | None:
+    if not isinstance(func, ast.Attribute) or func.attr != "append":
+        return None
+    receiver = dotted_name(func.value)
+    if receiver is None:
+        return None
+    leaf = receiver.rsplit(".", 1)[-1]
+    if leaf == "events" or leaf.endswith("_events"):
+        return receiver
+    return None
+
+
+def _emit_kind_literal(node: ast.Call) -> str | None:
+    """The literal kind of a sentinel-receiver ``.emit("...")`` call,
+    or None when this is not one / the kind is dynamic."""
+    func = node.func
+    if not isinstance(func, ast.Attribute) or func.attr != "emit":
+        return None
+    receiver = dotted_name(func.value)
+    if receiver is None:
+        return None
+    if receiver.rsplit(".", 1)[-1] not in _EMIT_RECEIVER_LEAVES:
+        return None
+    if not node.args:
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+@register
+class SentinelEmitterChecker(Checker):
+    code = "VDT011"
+    rule = "sentinel-emitter"
+    description = (
+        "timeline events must go through the sentinel emitter API "
+        "with registered kinds"
+    )
+    rationale = (
+        "an ad-hoc event-ring append never reaches /debug/events or "
+        "the fleet timeline merge, and an unregistered kind string "
+        "fragments the vocabulary alerting keys on — emit via "
+        "SentinelLog with a kind from EVENT_KINDS, or waive with why "
+        "the ring is not a timeline"
+    )
+    scope = ("engine/", "router/")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.scope_rel == _SENTINEL_MODULE:
+            return  # the emitter's own internals
+        kinds = _registered_kinds()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            receiver = _event_ring_receiver(node.func)
+            if receiver is not None:
+                yield ctx.finding(
+                    self,
+                    node,
+                    f"{receiver}.append() bypasses the sentinel "
+                    "emitter — events appended here never reach "
+                    "/debug/events or the fleet timeline; use "
+                    "SentinelLog.emit, or waive with why this ring "
+                    "is not a timeline",
+                )
+                continue
+            kind = _emit_kind_literal(node)
+            if kind is not None and kinds and kind not in kinds:
+                yield ctx.finding(
+                    self,
+                    node,
+                    f"sentinel event kind {kind!r} is not registered "
+                    "in engine/sentinel.py EVENT_KINDS — register it "
+                    "so the timeline vocabulary stays typed",
+                )

@@ -45,9 +45,40 @@ FIELDS = (
     "pending_dispatches",
     "pipeline_breaks",
     "kv_free_pages",
+    # Data-plane health at the moment of the step (ISSUE 20 satellite):
+    # router-resilience state from the registered provider.  0 / -1.0
+    # when no ResilienceManager shares the process (the normal remote
+    # deployment; in-process harnesses wire one via
+    # set_resilience_provider).
+    "open_breakers",
+    "retry_budget_balance",
 )
 
 _KEEP_DUMPS = 16
+
+# Process-wide resilience probe (ISSUE 20 satellite): a callable
+# returning (open_breaker_count, retry_budget_balance).  The router's
+# ResilienceManager registers itself when it shares the process with an
+# engine (chaos harnesses, single-process deployments); otherwise every
+# step records the "no data-plane state visible" sentinel (0, -1.0).
+_resilience_probe = None
+
+
+def set_resilience_provider(probe) -> None:
+    """Install (or clear, with None) the (open_breakers,
+    retry_budget_balance) provider sampled on every recorded step."""
+    global _resilience_probe
+    _resilience_probe = probe
+
+
+def resilience_state() -> tuple[int, float]:
+    probe = _resilience_probe
+    if probe is None:
+        return 0, -1.0
+    try:
+        return probe()
+    except Exception:  # noqa: BLE001 — telemetry never takes the engine down
+        return 0, -1.0
 
 
 def default_dump_dir() -> str:
@@ -75,7 +106,13 @@ class FlightRecorder:
         self.enabled = size > 0
         self.dump_dir = dump_dir
         self._ring: deque[tuple] = deque(maxlen=max(size, 1))
+        # Pre-sentinel internal marker ring (interleaved into dumps);
+        # the unified timeline gets a structured event per DUMP via the
+        # attached SentinelLog, not per marker.
         self._events: deque[tuple] = deque(maxlen=64)  # (t_wall, name, detail)
+        # The engine's SentinelLog (ISSUE 20), attached by LLMEngine so
+        # every dump lands in the unified timeline.
+        self.sentinel = None
 
     def record_step(self, *values) -> None:
         """Append one step record (positional, in FIELD order — the hot
@@ -87,6 +124,7 @@ class FlightRecorder:
         """Out-of-band marker (failure, recovery, drain) interleaved
         with the step ring by timestamp in the dump."""
         if self.enabled:
+            # vdt-lint: disable=sentinel-emitter — the recorder's own marker ring feeds dumps, not /debug/events; the timeline gets one event per dump
             self._events.append((time.time(), name, detail))
 
     def snapshot(self) -> dict:
@@ -134,6 +172,13 @@ class FlightRecorder:
             path,
             reason,
         )
+        if self.sentinel is not None:
+            self.sentinel.emit(
+                "flight_recorder_dump",
+                reason=reason,
+                path=path,
+                steps=len(payload["steps"]),
+            )
         return path
 
     def _prune(self) -> None:

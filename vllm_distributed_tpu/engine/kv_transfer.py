@@ -344,6 +344,15 @@ class KVTransferManager:
                 adopted_pages=adopted,
                 bytes=imp.bytes_in,
             )
+        if self.metrics is not None:
+            self.metrics.events.emit(
+                "kv_handoff",
+                outcome="adopted",
+                transfer_id=transfer_id,
+                pages=len(imp.pages),
+                adopted_pages=adopted,
+                duration_s=round(dur, 6),
+            )
         return {
             "adopted_pages": adopted,
             "adopted_tokens": adopted * ps,
@@ -357,4 +366,11 @@ class KVTransferManager:
         if imp is None:
             return False
         self._allocator().return_pages(imp.pages)
+        if self.metrics is not None:
+            self.metrics.events.emit(
+                "kv_handoff",
+                outcome="aborted",
+                transfer_id=transfer_id,
+                pages=len(imp.pages),
+            )
         return True

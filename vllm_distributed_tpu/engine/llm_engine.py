@@ -114,11 +114,17 @@ class LLMEngine:
         # /debug/flightrecorder.
         from vllm_distributed_tpu.engine.flight_recorder import (
             FlightRecorder,
+            resilience_state,
         )
 
         self.flight_recorder = FlightRecorder(
             size=obs.flight_recorder_size
         )
+        # Unified timeline (ISSUE 20): dumps become structured events
+        # on the engine's sentinel log; step records sample the
+        # registered resilience provider (if any shares the process).
+        self.flight_recorder.sentinel = self.metrics.events
+        self._resilience_state = resilience_state
         # Device-telemetry pull cursors: event-ring position (timing
         # histogram) and cumulative per-kind compile totals already
         # counted (exact even when the bounded event ring overflows
@@ -390,6 +396,7 @@ class LLMEngine:
         """One flight-recorder record per scheduled step (positional, in
         flight_recorder.FIELDS order — tuple pack + deque append)."""
         s = self.scheduler
+        open_breakers, retry_balance = self._resilience_state()
         self.flight_recorder.record_step(
             so.step_id,
             time.time(),
@@ -406,6 +413,8 @@ class LLMEngine:
             len(self._pending),
             self.pipeline_breaks,
             s.allocator.num_free_pages,
+            open_breakers,
+            retry_balance,
         )
 
     def refresh_device_telemetry(self) -> dict | None:
@@ -459,6 +468,15 @@ class LLMEngine:
             return []
         now = time.time()
         now_mono = time.monotonic()
+        # QoS sheds enter the unified timeline (ISSUE 20): one event
+        # per drain with the reason tally, not one per request.
+        shed_reasons: dict[str, int] = {}
+        for req in reqs:
+            reason = FINISH_REASON.get(req.status) or "unknown"
+            shed_reasons[reason] = shed_reasons.get(reason, 0) + 1
+        self.metrics.events.emit(
+            "qos_shed", count=len(reqs), reasons=shed_reasons
+        )
         outputs: list[RequestOutput] = []
         for req in reqs:
             req.metrics.finished_time = now
@@ -617,6 +635,12 @@ class LLMEngine:
         if scheduler_output.kv_restore_ops:
             stall = runner_output.kv_tier_seconds
             self.metrics.record_kv_restore_seconds(stall)
+            self.metrics.events.emit(
+                "kv_restore",
+                pages=len(scheduler_output.kv_restore_ops),
+                stall_s=round(stall, 6),
+                step_id=scheduler_output.step_id,
+            )
             if self.tracer.enabled:
                 self.tracer.record_span(
                     "engine.kv_restore",

@@ -1,0 +1,280 @@
+"""Fleet sentinel primitives (ISSUE 20): the unified event timeline
+and multi-window SLO burn-rate math shared by the engine and router.
+
+Three pieces live here because both processes need them:
+
+* ``EVENT_KINDS`` — the registered vocabulary of timeline event kinds.
+  Every emission goes through :meth:`SentinelLog.emit`, which rejects
+  unregistered kinds; vdt-lint rule VDT011 enforces the same contract
+  statically (no ad-hoc appends to event rings, literal kinds must be
+  registered here).
+* :class:`SentinelLog` — a bounded, monotonic-stamped structured event
+  log.  Each event carries ``ts_mono`` (in-process causal order),
+  ``ts_wall`` (cross-replica merge, corrected by the router's
+  heartbeat-RTT clock offsets), a per-log ``seq`` (total-order
+  tiebreak), ``source``, ``kind``, optional ``replica_id``/``trace_id``
+  and free-form ``attrs``.  Served per-replica at ``GET /debug/events``
+  and merged fleet-wide at ``GET /router/timeline``.
+* :class:`BurnRateTracker` — SRE-style multi-window SLO burn rate over
+  the per-class attainment counters (ISSUE 12).  Burn rate is
+  ``error_rate / (1 - objective)``; an alert fires only when EVERY
+  window (5m and 1h by default) exceeds the threshold, which is the
+  standard fast-burn/slow-burn pairing: the short window gives fast
+  detection, the long window keeps one bad minute from paging.
+
+Everything is observe-only and default-on-but-inert: with no SLO
+targets configured the burn tracker sees goodput == requests and burns
+0; with nothing emitting, the log is empty.  ``VDT_SENTINEL_EVENTS_SIZE=0``
+disables event collection entirely (seed behavior).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+# ---------------------------------------------------------------------------
+# Registered event kinds.  VDT011 checks literal kinds passed to
+# ``.emit("...")`` against this set; SentinelLog.emit re-checks at
+# runtime so dynamically-built kinds can't sneak past the linter.
+# ---------------------------------------------------------------------------
+EVENT_KINDS = frozenset({
+    # ---- engine-side emitters ----
+    "flight_recorder_dump",   # flight recorder wrote a post-mortem artifact
+    "recovery_begin",         # supervisor started an in-process rebuild
+    "recovery_attempt",       # one rebuild attempt (attrs: attempt)
+    "recovery_success",       # engine recovered
+    "recovery_failed",        # supervisor gave up (engine dead)
+    "qos_shed",               # scheduler shed expired/overload requests
+    "kv_handoff",             # prefill->decode KV hand-off outcome
+    "kv_restore",             # decode-side KV restore outcome
+    # ---- router-side emitters ----
+    "breaker_transition",     # circuit breaker state change (attrs: state)
+    "autoscale_decision",     # autoscaler chose a target
+    "wal_compaction",         # router WAL rotated onto a fresh snapshot
+    "replica_state",          # pool probe observed a state transition
+    "router_handoff",         # disaggregated prefill hand-off outcome
+    # ---- alerts (also appended to the bounded /router/alerts feed) ----
+    "alert_slo_burn",         # multi-window burn-rate breach for a class
+    "alert_replica_degraded", # anomaly score / breaker singled a replica out
+    "alert_replica_unreachable",  # healthy replica stopped answering probes
+    # ---- fleet lifecycle (ReplicaManager.record_event forwards) ----
+    "spawn", "crash", "adopt", "adopt_dead", "adopt_verified",
+    "adopt_identity_mismatch", "adopt_verify_timeout", "ready",
+    "drain", "drained", "drain_failed", "abort_warmup", "stopped",
+    "scale", "scale_role", "restart_budget_exhausted", "warmup_failed",
+    "shutdown_drain", "recycle_recommended",
+})
+
+
+class SentinelLog:
+    """Bounded structured event log, one per component (engine metrics
+    object, router state).  Thread-safe: engines emit from the engine
+    thread while ``/debug/events`` reads from the event loop.
+    """
+
+    def __init__(
+        self,
+        source: str,
+        maxlen: int | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        wall: Callable[[], float] = time.time,
+    ) -> None:
+        if maxlen is None:
+            from vllm_distributed_tpu import envs
+
+            maxlen = envs.VDT_SENTINEL_EVENTS_SIZE
+        self.source = source
+        self.enabled = maxlen > 0
+        self._clock = clock
+        self._wall = wall
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._events: deque[dict] = deque(maxlen=max(maxlen, 1))
+
+    def emit(
+        self,
+        kind: str,
+        replica_id: str = "",
+        trace_id: str = "",
+        **attrs,
+    ) -> dict | None:
+        """Append one event; returns it (or None when disabled)."""
+        if kind not in EVENT_KINDS:
+            raise ValueError(
+                f"unregistered sentinel event kind {kind!r} — add it to "
+                "engine/sentinel.py EVENT_KINDS (VDT011)"
+            )
+        if not self.enabled:
+            return None
+        event = {
+            "ts_mono": round(self._clock(), 6),
+            "ts_wall": round(self._wall(), 6),
+            "source": self.source,
+            "kind": kind,
+        }
+        if replica_id:
+            event["replica_id"] = replica_id
+        if trace_id:
+            event["trace_id"] = trace_id
+        if attrs:
+            event["attrs"] = attrs
+        with self._lock:
+            self._seq += 1
+            event["seq"] = self._seq
+            self._events.append(event)
+        return event
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return [dict(ev) for ev in self._events]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+
+# ---------------------------------------------------------------------------
+# Multi-window SLO burn rate.
+# ---------------------------------------------------------------------------
+
+#: Paired alerting windows: (label, seconds).  An alert requires EVERY
+#: window to burn past the threshold simultaneously.
+BURN_WINDOWS: tuple[tuple[str, float], ...] = (("5m", 300.0), ("1h", 3600.0))
+
+# Samples closer together than this coalesce in place, bounding the
+# per-class deque to ~window/seconds entries regardless of request rate.
+_SAMPLE_COALESCE_S = 1.0
+
+
+class BurnRateTracker:
+    """Burn rate over cumulative per-class (requests, goodput) counters.
+
+    ``observe`` takes the *cumulative* totals (monotone non-decreasing;
+    the engine feeds its own SLO accounting, the router feeds the
+    fleet-summed scrape) and keeps a bounded trail of samples per class.
+    The burn over a window is::
+
+        error_rate = (d_requests - d_goodput) / d_requests
+        burn       = error_rate / (1 - objective)
+
+    where the deltas span from the newest sample at-or-before the
+    window start (fallback: oldest retained) to now.  burn == 1.0 means
+    the error budget is being spent exactly at the sustainable rate;
+    burn >= threshold on every window simultaneously fires the alert
+    (rising-edge: one alert per excursion per class).
+    """
+
+    def __init__(
+        self,
+        objective: float | None = None,
+        threshold: float | None = None,
+        windows: tuple[tuple[str, float], ...] = BURN_WINDOWS,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        from vllm_distributed_tpu import envs
+
+        if objective is None:
+            objective = envs.VDT_SLO_OBJECTIVE
+        if threshold is None:
+            threshold = envs.VDT_SENTINEL_BURN_THRESHOLD
+        # Clamp away degenerate objectives (1.0 would divide by zero).
+        self.objective = min(max(objective, 0.0), 0.9999)
+        self.threshold = threshold
+        self.windows = windows
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._max_window = max(sec for _, sec in windows)
+        self._samples: dict[str, deque[tuple[float, int, int]]] = {}
+        self._alerting: set[str] = set()
+        self.peak: float = 0.0  # high-water fleet/replica burn (any window)
+
+    def observe(
+        self,
+        cls: str,
+        requests: int,
+        goodput: int,
+        now: float | None = None,
+    ) -> list[dict]:
+        """Record cumulative totals for ``cls``; returns newly-fired
+        alert descriptors (empty on no edge)."""
+        if now is None:
+            now = self._clock()
+        fired: list[dict] = []
+        with self._lock:
+            trail = self._samples.get(cls)
+            if trail is None:
+                # vdt-lint: disable=unbounded-queue — coalescing (1 sample/s) plus the horizon prune below bound this to ~max_window entries
+                trail = self._samples[cls] = deque()
+            sample = (now, int(requests), int(goodput))
+            if trail and now - trail[-1][0] < _SAMPLE_COALESCE_S:
+                trail[-1] = sample
+            else:
+                trail.append(sample)
+            horizon = now - self._max_window - 2 * _SAMPLE_COALESCE_S
+            # Keep one sample beyond the horizon as the long-window base.
+            while len(trail) > 1 and trail[1][0] <= horizon:
+                trail.popleft()
+            rates = self._burn_rates_locked(cls, now)
+            if rates:
+                self.peak = max(self.peak, max(rates.values()))
+            breaching = bool(rates) and all(
+                r >= self.threshold for r in rates.values()
+            )
+            if breaching and cls not in self._alerting:
+                self._alerting.add(cls)
+                fired.append({
+                    "slo_class": cls,
+                    "threshold": self.threshold,
+                    "burn": {w: round(r, 3) for w, r in rates.items()},
+                })
+            elif not breaching:
+                self._alerting.discard(cls)
+        return fired
+
+    def _burn_rates_locked(self, cls: str, now: float) -> dict[str, float]:
+        trail = self._samples.get(cls)
+        if not trail:
+            return {}
+        _, cur_req, cur_good = trail[-1]
+        rates: dict[str, float] = {}
+        for label, seconds in self.windows:
+            start = now - seconds
+            base = trail[0]
+            for sample in trail:
+                if sample[0] <= start:
+                    base = sample
+                else:
+                    break
+            d_req = cur_req - base[1]
+            d_good = cur_good - base[2]
+            if d_req <= 0:
+                rates[label] = 0.0
+                continue
+            error_rate = max(cur_req - base[1] - d_good, 0) / d_req
+            rates[label] = error_rate / (1.0 - self.objective)
+        return rates
+
+    def burn_rates(self, cls: str, now: float | None = None) -> dict[str, float]:
+        """Current per-window burn rates for one class (empty if the
+        class has never been observed)."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            return self._burn_rates_locked(cls, now)
+
+    def classes(self) -> list[str]:
+        with self._lock:
+            return sorted(self._samples)
+
+    def snapshot(self, now: float | None = None) -> dict[str, dict[str, float]]:
+        """{class: {window: burn}} for every observed class."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            return {
+                cls: self._burn_rates_locked(cls, now)
+                for cls in sorted(self._samples)
+            }

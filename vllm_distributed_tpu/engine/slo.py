@@ -315,6 +315,31 @@ class SloAccounting:
             )
         return ttft_ok, itl_ok, good
 
+    def class_counts(self, cls: str) -> tuple[int, int]:
+        """Cumulative (requests, goodput) for one class — the burn
+        tracker's input (ISSUE 20)."""
+        with self._lock:
+            st = self.classes.get(cls)
+            if st is None:
+                return 0, 0
+            return st.requests, st.goodput
+
+    def itl_p99_ms(self) -> float | None:
+        """p99 ITL across every class (merged log-bucket histograms):
+        the ``vllm:itl_p99_ms`` gauge the router's anomaly scoring
+        scrapes (ISSUE 20).  None until any interval is observed."""
+        with self._lock:
+            merged = None
+            for st in self.classes.values():
+                merged = (
+                    st.itl_hist
+                    if merged is None
+                    else merged.merge(st.itl_hist)
+                )
+            if merged is None:
+                return None
+            return merged.percentile_ms(0.99)
+
     # ---- views (event loop) ----
     def snapshot(self, include_timelines: bool = True) -> dict:
         """JSON-ready replica view, served at ``/slo`` and merged by the

@@ -330,6 +330,14 @@ class EngineSupervisor:
             "recovery",
             extra=failure.to_dict() if failure is not None else None,
         )
+        # Recovery transitions enter the unified timeline (ISSUE 20) on
+        # the metrics object's log — it survives the engine swap.
+        metrics.events.emit(
+            "recovery_begin",
+            cause=(
+                failure.describe() if failure is not None else str(cause)
+            ),
+        )
         t0 = time.monotonic()
         try:
             # Settle the event loop first: outputs dispatched before the
@@ -347,10 +355,20 @@ class EngineSupervisor:
                         self.policy.max_restarts,
                         self.policy.window,
                     )
+                    metrics.events.emit(
+                        "recovery_failed",
+                        reason="crash_loop",
+                        restarts=self.policy.max_restarts,
+                    )
                     return False
                 self._record_attempt(now)
                 self.restarts_total += 1
                 metrics.record_restart()
+                metrics.events.emit(
+                    "recovery_attempt",
+                    attempt=attempt + 1,
+                    max_restarts=self.policy.max_restarts,
+                )
                 delay = self.policy.backoff(attempt)
                 self._current_backoff = delay
                 logger.warning(
@@ -403,6 +421,12 @@ class EngineSupervisor:
                     elapsed,
                     self.restarts_total,
                     replayed,
+                )
+                metrics.events.emit(
+                    "recovery_success",
+                    elapsed_s=round(elapsed, 3),
+                    replayed=replayed,
+                    restarts=self.restarts_total,
                 )
                 # The incident is closed: a LATER unrelated death must
                 # not inherit this attribution via the failure_info

@@ -974,6 +974,10 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
             # keeps per-replica labels; the sum is what A/B needs).
             "vllm:prefix_cache_queries_total",
             "vllm:prefix_cache_hits_total",
+            # Fleet sentinel (ISSUE 20): alert count summed across
+            # kinds, plus the burn-rate high-water gauge.
+            "vdt_router:alerts_total",
+            "vdt_router:fleet_slo_burn_rate_peak",
         }
         # Router resilience counters (ISSUE 19): kept split by outcome
         # label so retries granted/denied and hedge outcomes report as
@@ -1513,6 +1517,15 @@ async def _bench_serve_async(args: argparse.Namespace) -> dict:
                     delta("vdt_router:breaker_rejections_total")
                 ),
             }
+            # Sentinel columns (ISSUE 20): alerts fired over the run
+            # window and the burn-rate high-water mark (a gauge — the
+            # end-of-run value IS the peak, no delta).
+            result["server_metrics"]["alerts_fired"] = int(
+                delta("vdt_router:alerts_total")
+            )
+            result["server_metrics"]["peak_fleet_slo_burn_rate"] = round(
+                after.get("vdt_router:fleet_slo_burn_rate_peak", 0.0), 3
+            )
         queries = delta("vllm:prefix_cache_queries_total")
         hits = delta("vllm:prefix_cache_hits_total")
         if queries > 0:

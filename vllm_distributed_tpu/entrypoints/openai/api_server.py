@@ -127,14 +127,19 @@ class ServerState:
 # Endpoints that stay open without an API key (probes + scrapers), the
 # same split vLLM's build_app auth middleware makes.  /slo is a scraper
 # surface like /metrics (the router's fleet merge pulls it).
-_UNAUTHENTICATED = {"/health", "/ping", "/version", "/metrics", "/slo"}
+_UNAUTHENTICATED = {
+    "/health", "/ping", "/version", "/metrics", "/slo",
+    # The router's timeline merge scrapes this like /slo and /metrics:
+    # same operational-telemetry sensitivity, same auth posture.
+    "/debug/events",
+}
 
 # Probe/scrape endpoints never open a root span (they would drown the
 # trace ring in noise and trace nothing request-shaped).  /drain can
 # block for the full drain timeout — a span that long is noise too.
 _UNTRACED = {
     "/health", "/ping", "/version", "/metrics", "/slo", "/debug/traces",
-    "/debug/flightrecorder", "/debug/profile", "/drain",
+    "/debug/flightrecorder", "/debug/events", "/debug/profile", "/drain",
 }
 
 
@@ -427,7 +432,10 @@ async def health(request: web.Request) -> web.Response:
             status=503,
             headers={"Retry-After": str(envs.VDT_RETRY_AFTER_SECONDS)},
         )
-    body = {"status": "ok"}
+    # Wall-clock in the body (ISSUE 20): the router pairs it with its
+    # own send/recv stamps to estimate this replica's clock offset for
+    # /router/timeline correction (heartbeat-RTT style, ISSUE 4).
+    body = {"status": "ok", "now": time.time()}
     if state.replica_id:
         body["replica_id"] = state.replica_id
     if state.role and state.role != "mixed":
@@ -1053,6 +1061,29 @@ async def debug_flightrecorder(request: web.Request) -> web.Response:
     return web.json_response(body)
 
 
+async def debug_events(request: web.Request) -> web.Response:
+    """This replica's slice of the unified event timeline (ISSUE 20):
+    the engine's bounded SentinelLog (flight-recorder dumps, recovery
+    transitions, QoS sheds, KV hand-off/restore outcomes), each event
+    carrying both monotonic and wall stamps so the router can merge it
+    fleet-wide at /router/timeline with clock-offset correction."""
+    state: ServerState = request.app["state"]
+    log = state.engine.engine.metrics.events
+    if not log.enabled:
+        return _error(
+            "event timeline disabled (VDT_SENTINEL_EVENTS_SIZE=0)", 404
+        )
+    body = {
+        "source": log.source,
+        "now_wall": time.time(),
+        "now_mono": time.monotonic(),
+        "events": log.snapshot(),
+    }
+    if state.replica_id:
+        body["replica_id"] = state.replica_id
+    return web.json_response(body)
+
+
 async def debug_profile(request: web.Request) -> web.Response:
     """Gated server-side jax.profiler capture (ISSUE 12):
     ``POST /debug/profile?seconds=N`` records a trace into the
@@ -1608,6 +1639,7 @@ def build_app(state: ServerState) -> web.Application:
     app.router.add_get("/slo", slo)
     app.router.add_get("/debug/traces", debug_traces)
     app.router.add_get("/debug/flightrecorder", debug_flightrecorder)
+    app.router.add_get("/debug/events", debug_events)
     app.router.add_post("/debug/profile", debug_profile)
     app.router.add_post("/internal/resume", internal_resume)
     app.router.add_post("/internal/kv", internal_kv)

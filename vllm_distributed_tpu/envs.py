@@ -557,6 +557,36 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # with the SDK present.
     "VDT_TRACE_OTLP": lambda: os.environ.get("VDT_TRACE_OTLP", "1")
     not in ("0", "false"),
+    # --- fleet sentinel (ISSUE 20) ---
+    # Unified event timeline (engine/sentinel.py): events kept per
+    # component log (engine ring served at /debug/events, router ring
+    # merged into /router/timeline).  0 disables event collection.
+    "VDT_SENTINEL_EVENTS_SIZE": lambda: int(
+        os.environ.get("VDT_SENTINEL_EVENTS_SIZE", "512")
+    ),
+    # SLO objective for burn-rate math: the target attainment ratio.
+    # burn = error_rate / (1 - objective); at 0.99 a burn of 1.0 means
+    # exactly 1% of requests are missing their targets.
+    "VDT_SLO_OBJECTIVE": lambda: float(
+        os.environ.get("VDT_SLO_OBJECTIVE", "0.99")
+    ),
+    # Multi-window burn-rate alert threshold: an alert fires when the
+    # burn exceeds this on EVERY window (5m and 1h) simultaneously.
+    "VDT_SENTINEL_BURN_THRESHOLD": lambda: float(
+        os.environ.get("VDT_SENTINEL_BURN_THRESHOLD", "10")
+    ),
+    # Robust-z (median/MAD, sigma units) past which a replica's signal
+    # marks it degraded (router/sentinel.py anomaly scoring).
+    "VDT_SENTINEL_ANOMALY_THRESHOLD": lambda: float(
+        os.environ.get("VDT_SENTINEL_ANOMALY_THRESHOLD", "4")
+    ),
+    # Let anomaly scores influence placement: outlier replicas are
+    # DEPRIORITIZED (chosen only when no in-band replica can take the
+    # request), never ejected.  Default off: scoring is observe-only.
+    "VDT_SENTINEL_PLACEMENT": lambda: os.environ.get(
+        "VDT_SENTINEL_PLACEMENT", "0"
+    ).lower()
+    not in ("", "0", "false", "off"),
     # --- per-host test/operator hooks (never replicated) ---
     # Install the deterministic FaultInjector on this process's RPC
     # transports (tests/test_fault_injection.py arms it over RPC).
@@ -655,6 +685,11 @@ NON_REPLICATED_ENV_VARS = {
     "VDT_ROUTER_STATE_CKPT_INTERVAL_SECONDS",
     "VDT_ROUTER_STATE_VERIFY_WINDOW_SECONDS",
     "VDT_ROUTER_STATE_RECOVERY_TTL_SECONDS",
+    # Sentinel placement (ISSUE 20) is a router-process decision: the
+    # anomaly scores live in the router; replicas have no pool to
+    # deprioritize against.  (The other sentinel knobs DO replicate —
+    # objective/threshold/log size are fleet-wide policy.)
+    "VDT_SENTINEL_PLACEMENT",
     # Disaggregation (ISSUE 15): the role is per-replica identity like
     # VDT_REPLICA_ID; the crossover/chunking knobs configure the ROUTER
     # process's hand-off orchestration; export holds are driver-engine

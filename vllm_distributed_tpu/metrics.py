@@ -150,6 +150,9 @@ DOCUMENTED_METRICS = (
     "vllm:xla_compile_seconds",
     "vllm:hbm_live_bytes",
     "vllm:step_roofline_frac",
+    # ---- fleet sentinel (ISSUE 20) ----
+    "vllm:slo_burn_rate",
+    "vllm:itl_p99_ms",
 )
 
 
@@ -157,8 +160,19 @@ class EngineMetrics:
     """Engine-loop instruments; every method is a no-op when disabled."""
 
     def __init__(self, model_name: str, enabled: bool = True) -> None:
+        from vllm_distributed_tpu.engine.sentinel import (
+            BurnRateTracker,
+            SentinelLog,
+        )
+
         self.enabled = enabled
         self.registry = None
+        # Fleet sentinel (ISSUE 20): the engine's slice of the unified
+        # event timeline (served at /debug/events) and its own
+        # multi-window SLO burn tracker.  Both live even when the
+        # prometheus exposition is disabled — events are not metrics.
+        self.events = SentinelLog("engine")
+        self.burn = BurnRateTracker()
         if not enabled:
             return
         try:
@@ -524,6 +538,22 @@ class EngineMetrics:
             "Last step's estimated bytes-touched/second over the "
             "device's peak HBM bandwidth (0 when unknown)",
         )
+        # ---- fleet sentinel (ISSUE 20) ----
+        self._slo_burn = Gauge(
+            "vllm:slo_burn_rate",
+            "SLO error-budget burn rate per class and window "
+            "(error_rate / (1 - VDT_SLO_OBJECTIVE)); refreshed on "
+            "every /metrics render",
+            ["model_name", "slo_class", "window"],
+            registry=self.registry,
+        )
+        self.itl_p99_ms = gauge(
+            "vllm:itl_p99_ms",
+            "p99 inter-token latency across all SLO classes (merged "
+            "log-bucket histograms, bucket-representative ms) — the "
+            "router's sentinel scrapes this as a per-replica condition "
+            "signal",
+        )
         from vllm_distributed_tpu.engine.slo import SloAccounting
 
         self.slo = SloAccounting()
@@ -786,6 +816,12 @@ class EngineMetrics:
             self._slo_itl_attained.labels(**labels).inc()
         if good:
             self._goodput_requests.labels(**labels).inc()
+        # Fleet sentinel (ISSUE 20): cumulative (requests, goodput)
+        # feeds the multi-window burn tracker; a paired-window breach
+        # enters the timeline.
+        requests, goodput = self.slo.class_counts(cls)
+        for fired in self.burn.observe(cls, requests, goodput):
+            self.events.emit("alert_slo_burn", **fired)
 
     # ---- XLA/device telemetry hooks (ISSUE 12), fed by
     # LLMEngine.refresh_device_telemetry from worker snapshots ----
@@ -834,4 +870,17 @@ class EngineMetrics:
             return b"# metrics disabled (--disable-log-stats)\n"
         from prometheus_client import generate_latest
 
+        # Sentinel gauges (ISSUE 20) are scrape-time views of the SLO
+        # accounting, not event-driven — refresh them per render so the
+        # burn decays as the windows slide even with no new requests.
+        p99 = self.slo.itl_p99_ms()
+        if p99 is not None:
+            self.itl_p99_ms.set(p99)
+        for cls, rates in self.burn.snapshot().items():
+            for window, value in rates.items():
+                self._slo_burn.labels(
+                    model_name=self._model_name,
+                    slo_class=cls,
+                    window=window,
+                ).set(value)
         return generate_latest(self.registry)

@@ -47,6 +47,10 @@ from vllm_distributed_tpu.router.metrics import (
 from vllm_distributed_tpu.router.pool import Replica, ReplicaPool
 from vllm_distributed_tpu.router.qos import PrefillDemand, QosRouterPolicy
 from vllm_distributed_tpu.router.resilience import ResilienceManager
+from vllm_distributed_tpu.router.sentinel import (
+    RouterSentinel,
+    merge_timelines,
+)
 from vllm_distributed_tpu.tracing import get_tracer
 from vllm_distributed_tpu.utils import Counter
 from vllm_distributed_tpu.version import __version__
@@ -164,6 +168,15 @@ class RouterState:
             read_timeout=self.read_timeout,
         )
         self.pool.resilience = self.resilience
+        # Fleet sentinel (ISSUE 20): unified timeline + burn-rate
+        # alerting + per-replica anomaly scoring.  Observe-only unless
+        # VDT_SENTINEL_PLACEMENT opts placement in.
+        self.sentinel = RouterSentinel(
+            metrics=self.metrics, resilience=self.resilience
+        )
+        self.pool.sentinel = self.sentinel
+        self.resilience.sentinel = self.sentinel
+        self.sentinel_placement = envs.VDT_SENTINEL_PLACEMENT
         self.request_counter = Counter()
         # Disaggregated prefill/decode (ISSUE 15): the hand-off engages
         # only for prompts at/above the crossover AND when the pool
@@ -205,6 +218,7 @@ class RouterState:
             self.metrics.forget_replica(replica.replica_id)
             self.index.forget(replica.replica_id)
             self.resilience.forget_replica(replica.replica_id)
+            self.sentinel.forget_replica(replica.replica_id)
 
         self.pool.on_remove.append(_forget)
 
@@ -214,6 +228,12 @@ class RouterState:
         self.manager = manager
         self.autoscaler = autoscaler
         manager.resilience = self.resilience
+        # Fleet lifecycle events forward into the unified timeline, and
+        # the sentinel's recycle recommendations flow back (advisory).
+        manager.sentinel = self.sentinel
+        self.sentinel.manager = manager
+        if autoscaler is not None:
+            autoscaler.sentinel = self.sentinel
 
     def attach_persist(self, log, recovered=None) -> None:
         """Install the durable-state WAL (ISSUE 17) and any state it
@@ -223,6 +243,7 @@ class RouterState:
         import os
 
         self.persist = log
+        log.sentinel = self.sentinel
         self.recovered = recovered
         self.recovery_ttl = envs.VDT_ROUTER_STATE_RECOVERY_TTL_SECONDS
         self.rid_prefix = f"rtr-{os.getpid()}"
@@ -305,6 +326,17 @@ class RouterState:
             cands = self.qos.filter(cands, slo_class)
         if not cands:
             return None, "none"
+        # Sentinel deprioritization (ISSUE 20, VDT_SENTINEL_PLACEMENT):
+        # anomaly-scored outliers are picked only when nothing in-band
+        # can take the request — deprioritized, never ejected.
+        if self.sentinel_placement and len(cands) > 1:
+            outliers = self.sentinel.outliers()
+            if outliers:
+                in_band = [
+                    r for r in cands if r.replica_id not in outliers
+                ]
+                if in_band:
+                    cands = in_band
         if self.policy == "round_robin":
             self._rr += 1
             return cands[self._rr % len(cands)], "round_robin"
@@ -1602,6 +1634,82 @@ async def router_slo(request: web.Request) -> web.Response:
     return web.json_response(await _fleet_slo(state))
 
 
+async def router_timeline(request: web.Request) -> web.Response:
+    """Fleet-wide unified event timeline (ISSUE 20): every replica's
+    /debug/events merged with the router's own sentinel log, each
+    replica's wall stamps corrected by its probe-derived clock offset.
+    The merge is a pure sort with a total-order tiebreak — bit-equal to
+    recomputing from any partition of the union (pinned by tests)."""
+    import aiohttp
+
+    state: RouterState = request.app["router_state"]
+    timeout = aiohttp.ClientTimeout(total=10, connect=state.connect_timeout)
+
+    async def scrape(replica: Replica) -> tuple[str, list] | None:
+        async def fetch() -> tuple[str, list] | None:
+            async with await state.resilience.request(
+                state.session,
+                "GET",
+                f"{replica.url}/debug/events",
+                endpoint="events",
+                replica_id=replica.replica_id,
+                timeout=timeout,
+            ) as resp:
+                if resp.status != 200:
+                    return None
+                body = await resp.json()
+                return (replica.replica_id, body.get("events") or [])
+
+        try:
+            # Idempotent read: hedged like the /slo and /metrics sweeps.
+            return await state.resilience.hedged(
+                "events", replica.replica_id, fetch
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — an unreachable replica's slice just drops out
+            return None
+
+    parts = await asyncio.wait_for(
+        asyncio.gather(*(scrape(r) for r in state.pool.replicas)),
+        timeout=15,
+    )
+    logs: dict[str, list] = {"router": state.sentinel.log.snapshot()}
+    offsets: dict[str, float] = {"router": 0.0}
+    for part in parts:
+        if part is None:
+            continue
+        rid, events = part
+        logs[rid] = events
+        rep = state.pool.by_id(rid)
+        if rep is not None and rep.clock_rtt >= 0:
+            offsets[rid] = rep.clock_offset
+    return web.json_response(
+        {
+            "events": merge_timelines(logs, offsets),
+            "merged": sorted(logs),
+            "clock_offsets": {
+                k: round(v, 6) for k, v in offsets.items()
+            },
+        }
+    )
+
+
+async def router_alerts(request: web.Request) -> web.Response:
+    """Bounded sentinel alert feed (ISSUE 20): burn-rate breaches and
+    degraded/unreachable replica detections, newest last.  Every alert
+    also entered the timeline as an ``alert_*`` event."""
+    state: RouterState = request.app["router_state"]
+    return web.json_response(
+        {
+            "alerts": state.sentinel.alerts_snapshot(),
+            "burn": state.sentinel.burn.snapshot(),
+            "burn_peak": round(state.sentinel.burn.peak, 3),
+            "anomaly_scores": state.sentinel.snapshot()["scores"],
+        }
+    )
+
+
 async def router_state(request: web.Request) -> web.Response:
     """Introspection: pool snapshot, tally counters, affinity stats."""
     state: RouterState = request.app["router_state"]
@@ -1613,6 +1721,7 @@ async def router_state(request: web.Request) -> web.Response:
             r.replica_id: state.index.num_blocks(r.replica_id)
             for r in state.pool.replicas
         },
+        "sentinel": state.sentinel.snapshot(),
     }
     if state.resilience.enabled:
         body["resilience"] = state.resilience.snapshot()
@@ -1840,6 +1949,8 @@ def build_router_app(state: RouterState) -> web.Application:
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/router/state", router_state)
     app.router.add_get("/router/slo", router_slo)
+    app.router.add_get("/router/timeline", router_timeline)
+    app.router.add_get("/router/alerts", router_alerts)
     app.router.add_get("/router/fleet", router_fleet)
     app.router.add_post("/router/scale", router_scale)
     app.router.add_get("/v1/models", list_models)

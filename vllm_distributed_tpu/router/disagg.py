@@ -52,6 +52,18 @@ _test_after_chunk = None
 _test_after_chunk_failure = None
 
 
+def _emit_handoff_event(state, outcome: str, **attrs) -> None:
+    """Mirror a hand-off outcome into the sentinel timeline (ISSUE 20);
+    best-effort — the data path never blocks on observability."""
+    sentinel = getattr(state, "sentinel", None)
+    if sentinel is None:
+        return
+    try:
+        sentinel.emit("router_handoff", outcome=outcome, **attrs)
+    except Exception:  # noqa: BLE001 — observability must not break the stream
+        logger.exception("sentinel router_handoff event failed")
+
+
 @dataclass
 class HandoffPlan:
     est_prompt_tokens: int
@@ -399,6 +411,11 @@ async def forward_prefill_handoff(
                 if kv_handle:
                     await _release_hold(state, prefill.url, kv_handle)
                 state.metrics.record_handoff("finished_at_prefill")
+                _emit_handoff_event(
+                    state,
+                    "finished_at_prefill",
+                    from_replica=prefill.replica_id,
+                )
                 return True
             if handoff_now:
                 break
@@ -429,6 +446,9 @@ async def forward_prefill_handoff(
             )
         )
         state.metrics.record_handoff("fallback")
+        _emit_handoff_event(
+            state, "fallback", from_replica=prefill.replica_id
+        )
         return False
 
     # ---- stream the KV pages across (best-effort) ----
@@ -465,6 +485,13 @@ async def forward_prefill_handoff(
         await _release_hold(state, prefill.url, kv_handle)
     outcome = "planned" if adopted > 0 else "fallback"
     state.metrics.record_handoff(outcome)
+    _emit_handoff_event(
+        state,
+        outcome,
+        from_replica=prefill.replica_id,
+        to_replica=target.replica_id,
+        adopted_tokens=adopted,
+    )
     tracer.event(
         span.ctx,
         "router.handoff",

@@ -292,6 +292,13 @@ class ReplicaManager:
         # Installed by RouterState.attach_fleet (ISSUE 19); standalone
         # managers (unit tests) fall back to the noop passthrough.
         self.resilience = None
+        # Fleet sentinel (ISSUE 20), installed by attach_fleet: every
+        # lifecycle event forwards into the unified timeline, and the
+        # sentinel's degraded-replica recycle recommendations land in
+        # ``recycle_recommended`` (advisory — the manager records them
+        # for the operator/autoscaler; it never kills on its own).
+        self.sentinel = None
+        self.recycle_recommended: dict[str, dict] = {}
         self._task: asyncio.Task | None = None
         self._stopped = asyncio.Event()
 
@@ -335,6 +342,10 @@ class ReplicaManager:
 
     # ---- introspection ----
     def record_event(self, kind: str, replica_id: str = "", **detail) -> None:
+        # The manager's own bounded ring predates the sentinel and
+        # feeds /router/fleet; the unified timeline gets the same event
+        # through the emitter API below.
+        # vdt-lint: disable=sentinel-emitter — legacy /router/fleet ring, mirrored into the sentinel right below
         self.events.append(
             {
                 "mono": round(time.monotonic(), 4),
@@ -343,6 +354,23 @@ class ReplicaManager:
                 **detail,
             }
         )
+        if self.sentinel is not None:
+            try:
+                self.sentinel.emit(kind, replica_id=replica_id, **detail)
+            except Exception:  # noqa: BLE001 — the timeline must never break fleet supervision
+                logger.exception("sentinel fleet-event forward failed")
+
+    def note_recycle_recommendation(
+        self, replica_id: str, **detail
+    ) -> None:
+        """Advisory sink for the sentinel's degraded-replica verdicts
+        (ISSUE 20): recorded in the event log and surfaced in
+        ``snapshot()`` — the manager deliberately does NOT act on it."""
+        self.recycle_recommended[replica_id] = {
+            "mono": round(time.monotonic(), 4),
+            **detail,
+        }
+        self.record_event("recycle_recommended", replica_id, **detail)
 
     def active(self, role: str | None = None) -> list[ManagedReplica]:
         """Replicas counting toward the target (starting or serving),
@@ -367,6 +395,10 @@ class ReplicaManager:
             "restarts_total": self.restarts_total,
             "replicas": [r.snapshot() for r in self.replicas],
             "events": list(self.events),
+            "recycle_recommended": {
+                rid: dict(detail)
+                for rid, detail in self.recycle_recommended.items()
+            },
         }
 
     # ---- scaling entry points ----
@@ -1187,6 +1219,7 @@ class Autoscaler:
         self.last_up = -float("inf")
         self.last_down = -float("inf")
         self.decisions: deque[dict] = deque(maxlen=128)
+        self.sentinel = None  # RouterSentinel (wired by app.attach_fleet)
         self._last_rejects = 0.0
         self._last_tick_mono = 0.0
         self._task: asyncio.Task | None = None
@@ -1277,6 +1310,21 @@ class Autoscaler:
                     "itl_p99_ms": signals.itl_p99_ms,
                 }
             )
+            if self.sentinel is not None:
+                try:
+                    self.sentinel.emit(
+                        "autoscale_decision",
+                        from_target=self.manager.target,
+                        to=new_target,
+                        reason=reason,
+                        waiting_per_replica=round(
+                            signals.waiting_per_replica, 3
+                        ),
+                        reject_rate=round(signals.reject_rate, 3),
+                        itl_p99_ms=signals.itl_p99_ms,
+                    )
+                except Exception:  # noqa: BLE001 — observability must not block scaling
+                    logger.exception("sentinel autoscale event failed")
             self.manager.scale_to(new_target, reason=f"autoscale:{reason}")
         self._tick_prefill(signals, now)
         return new_target, reason
@@ -1306,6 +1354,18 @@ class Autoscaler:
                 "prefill_rate": round(signals.prefill_rate, 3),
             }
         )
+        if self.sentinel is not None:
+            try:
+                self.sentinel.emit(
+                    "autoscale_decision",
+                    role="prefill",
+                    from_target=current,
+                    to=want,
+                    reason="prefill_demand",
+                    prefill_rate=round(signals.prefill_rate, 3),
+                )
+            except Exception:  # noqa: BLE001 — observability must not block scaling
+                logger.exception("sentinel autoscale event failed")
         self.manager.scale_role_to(
             "prefill", want, reason="autoscale:prefill_demand"
         )

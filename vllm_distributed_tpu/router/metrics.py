@@ -238,6 +238,36 @@ class RouterMetrics:
             ["slo_class"],
             registry=self.registry,
         )
+        # ---- fleet sentinel (ISSUE 20) ----
+        self._burn_rate = Gauge(
+            "vdt_router:fleet_slo_burn_rate",
+            "Fleet SLO error-budget burn rate per class and window "
+            "(1.0 = burning exactly at the sustainable rate; an alert "
+            "fires when every window breaches the threshold at once)",
+            ["slo_class", "window"],
+            registry=self.registry,
+        )
+        self._burn_peak = Gauge(
+            "vdt_router:fleet_slo_burn_rate_peak",
+            "High-water fleet burn rate over any class/window since "
+            "router start (the bench serve summary column)",
+            registry=self.registry,
+        )
+        self._anomaly_score = Gauge(
+            "vdt_router:replica_anomaly_score",
+            "Robust z-score (median/MAD over the live pool) of one "
+            "replica's condition signal; |z| past the threshold marks "
+            "the replica degraded",
+            ["replica_id", "signal"],
+            registry=self.registry,
+        )
+        self._alerts = Counter(
+            "vdt_router:alerts_total",
+            "Sentinel alerts raised, by kind (slo_burn | "
+            "replica_degraded | replica_unreachable)",
+            ["kind"],
+            registry=self.registry,
+        )
 
     def record_request(self, kind: str, outcome: str) -> None:
         self.counts[f"requests.{kind}.{outcome}"] += 1
@@ -311,6 +341,12 @@ class RouterMetrics:
         the router's own exposition (the merged replica expositions
         drop out automatically — they iterate the live pool)."""
         self.counts.pop(f"breaker.state.{replica_id}", None)
+        for key in [
+            k
+            for k in self.counts
+            if k.startswith(f"anomaly.{replica_id}.")
+        ]:
+            self.counts.pop(key, None)
         if not self.enabled:
             return
         for gauge in (
@@ -320,6 +356,13 @@ class RouterMetrics:
         ):
             try:
                 gauge.remove(replica_id)
+            except KeyError:
+                pass
+        from vllm_distributed_tpu.router.sentinel import SIGNALS
+
+        for signal in SIGNALS:
+            try:
+                self._anomaly_score.remove(replica_id, signal)
             except KeyError:
                 pass
 
@@ -349,6 +392,35 @@ class RouterMetrics:
                 value = d.get(key)
                 if value is not None:
                     gauge.labels(slo_class=cls).set(value)
+
+    # ---- fleet sentinel (ISSUE 20) ----
+    def record_alert(self, kind: str) -> None:
+        self.counts[f"alerts.{kind}"] += 1
+        if self.enabled:
+            self._alerts.labels(kind=kind).inc()
+
+    def set_anomaly_score(
+        self, replica_id: str, signal: str, score: float
+    ) -> None:
+        self.counts[f"anomaly.{replica_id}.{signal}"] = score
+        if self.enabled:
+            self._anomaly_score.labels(
+                replica_id=replica_id, signal=signal
+            ).set(score)
+
+    def update_burn(self, burn, now: float | None = None) -> None:
+        """Refresh the per-class/window burn gauges and the high-water
+        peak from one BurnRateTracker."""
+        for cls, rates in burn.snapshot(now).items():
+            for window, value in rates.items():
+                self.counts[f"burn.{cls}.{window}"] = value
+                if self.enabled:
+                    self._burn_rate.labels(
+                        slo_class=cls, window=window
+                    ).set(value)
+        self.counts["burn.peak"] = burn.peak
+        if self.enabled:
+            self._burn_peak.set(burn.peak)
 
     def update_replicas(self, pool) -> None:
         if not self.enabled:

@@ -260,6 +260,7 @@ class RouterStateLog:
             else envs.VDT_ROUTER_STATE_CKPT_INTERVAL_SECONDS
         )
         self._clock = clock
+        self.sentinel = None  # RouterSentinel (wired by app.attach_persist)
         self._f = None
         self._seq = 0
         self._size = 0
@@ -461,3 +462,15 @@ class RouterStateLog:
         self._size = os.path.getsize(new_path)
         self._last_fsync = self._clock()
         self._dirty = False
+        if self.sentinel is not None:
+            try:
+                self.sentinel.emit(
+                    "wal_compaction",
+                    from_seq=old_seq,
+                    to_seq=new_seq,
+                    snapshot_bytes=self._size,
+                    replicas=len(self._replicas),
+                    journals=len(self._journals),
+                )
+            except Exception:  # noqa: BLE001 — observability must not block the WAL
+                logger.exception("sentinel wal_compaction event failed")

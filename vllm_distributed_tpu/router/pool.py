@@ -61,6 +61,23 @@ class Replica:
     last_probe_mono: float = 0.0
     # Monotonic deadline of the "verifying" grace window; 0 = none.
     verify_deadline_mono: float = 0.0
+    # Clock-offset estimate from /health round trips (ISSUE 20):
+    # replica_wall - router_wall at the request midpoint, kept when its
+    # RTT beats the stored best (same accept/decay rule as tracing.py's
+    # heartbeat offsets).  clock_rtt < 0 = no sample yet.
+    clock_offset: float = 0.0
+    clock_rtt: float = -1.0
+
+    def note_clock_sample(self, offset: float, rtt: float) -> None:
+        if rtt < 0:
+            return
+        if self.clock_rtt < 0 or rtt <= self.clock_rtt * 1.25:
+            self.clock_offset = offset
+            self.clock_rtt = rtt
+        else:
+            # Slow decay so a temporarily-congested link can't pin a
+            # stale offset forever.
+            self.clock_rtt *= 1.05
 
     @property
     def verifying(self) -> bool:
@@ -150,6 +167,10 @@ class ReplicaPool:
         # the proxy path.  A standalone pool (unit tests) gets the
         # always-off passthrough.
         self.resilience: ResilienceManager | None = None
+        # Fleet sentinel (ISSUE 20): RouterState installs its
+        # RouterSentinel here; probes feed it state transitions, clock
+        # offsets, and the scraped signal gauges.
+        self.sentinel = None
         # Membership hooks (the fleet layer and the metrics exporter
         # subscribe): called with the Replica on every add/remove so
         # per-replica series can be created/forgotten in lockstep with
@@ -241,12 +262,25 @@ class ReplicaPool:
 
     # ---- request-path feedback ----
     def note_unreachable(self, replica: Replica, error: str) -> None:
+        old = replica.state
         replica.state = "unreachable"
         replica.consecutive_failures += 1
         replica.last_error = error
+        self._note_transition(replica, old)
         logger.warning(
             "replica %s unreachable: %s", replica.replica_id, error
         )
+
+    def _note_transition(self, replica: Replica, old: str) -> None:
+        """Feed observed state changes into the sentinel timeline."""
+        if self.sentinel is None or replica.state == old:
+            return
+        try:
+            self.sentinel.note_replica_state(
+                replica.replica_id, old, replica.state
+            )
+        except Exception:  # noqa: BLE001 — the timeline is observe-only
+            logger.exception("sentinel state hook failed")
 
     def note_backoff(self, replica: Replica, retry_after: float) -> None:
         """429 from a healthy-but-full replica: eject from placement for
@@ -264,7 +298,11 @@ class ReplicaPool:
         )
         replica.last_probe_mono = time.monotonic()
 
-        async def fetch_health() -> tuple[int, dict]:
+        async def fetch_health() -> tuple[int, dict, float, float]:
+            # Wall-clock stamps around the round trip: with the
+            # replica's own "now" in the body this doubles as a clock
+            # offset sample (ISSUE 20 timeline correction).
+            t_send = time.time()
             async with await rz.request(
                 session,
                 "GET",
@@ -277,16 +315,23 @@ class ReplicaPool:
                     body = await resp.json()
                 except Exception:  # noqa: BLE001 — pre-ISSUE-10 replicas answer 200 with an empty body
                     body = {}
-                return resp.status, body or {}
+                return resp.status, body or {}, t_send, time.time()
 
+        prev_state = replica.state
         try:
             # /health is the idempotent read par excellence: hedged
             # (ISSUE 19) so one straggling answer under a lossy DCN
             # doesn't read as a missed probe.  The half-open breaker
             # probe also rides this path.
-            http_status, body = await rz.hedged(
+            http_status, body, t_send, t_recv = await rz.hedged(
                 "health", replica.replica_id, fetch_health
             )
+            remote_now = body.get("now")
+            if isinstance(remote_now, (int, float)):
+                replica.note_clock_sample(
+                    float(remote_now) - (t_send + t_recv) / 2.0,
+                    t_recv - t_send,
+                )
             if http_status == 200:
                 replica.state = "healthy"
                 replica.consecutive_failures = 0
@@ -323,6 +368,7 @@ class ReplicaPool:
                 return
             self.note_unreachable(replica, f"{type(e).__name__}: {e}")
             return
+        self._note_transition(replica, prev_state)
         if replica.state != "healthy":
             return
 
@@ -355,6 +401,8 @@ class ReplicaPool:
                 replica.running = gauges.get(
                     "vllm:num_requests_running", replica.running
                 )
+                if self.sentinel is not None:
+                    self.sentinel.note_probe(replica.replica_id, text)
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001 — load stats are advisory; /health already passed

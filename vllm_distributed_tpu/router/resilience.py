@@ -151,6 +151,7 @@ class CircuitBreaker:
             self._trip(now)
             return
         if self.timeout_rate > 0:
+            # vdt-lint: disable=sentinel-emitter — breaker timeout-rate sample window, not a timeline event ring
             self._events.append((now, timeout))
             while self._events and self._events[0][0] < now - self.window:
                 self._events.popleft()
@@ -292,6 +293,27 @@ class ResilienceManager:
         self.retries_denied = 0
         self.replica_attempts: _TallyCounter = _TallyCounter()
         self.replica_retries: _TallyCounter = _TallyCounter()
+        # Fleet sentinel (ISSUE 20): RouterState installs its
+        # RouterSentinel here so breaker transitions enter the unified
+        # timeline (and open transitions raise degraded-replica alerts).
+        self.sentinel = None
+
+    def open_breaker_count(self) -> int:
+        """Breakers currently OPEN — flight-recorder step context
+        (ISSUE 20 satellite: data-plane health at the moment of
+        failure)."""
+        return sum(1 for br in self.breakers.values() if br.state == OPEN)
+
+    def retry_budget_balance(self) -> float:
+        """Retries still grantable under the amplification bound
+        (granted <= min + ratio * first_attempts); -1.0 while the
+        budget is off (unbounded)."""
+        if not self.cfg.budget_on:
+            return -1.0
+        allowance = (
+            self.cfg.retry_min + self.cfg.retry_ratio * self.first_attempts
+        )
+        return max(allowance - self.retries_granted, 0.0)
 
     @classmethod
     def noop(cls) -> "ResilienceManager":
@@ -347,6 +369,11 @@ class ResilienceManager:
             self.metrics.set_breaker_state(
                 replica_id, BREAKER_GAUGE[br.state]
             )
+        if self.sentinel is not None:
+            try:
+                self.sentinel.note_breaker(replica_id, br.state)
+            except Exception:  # noqa: BLE001 — timeline is observe-only; never fail the data plane
+                logger.exception("sentinel breaker hook failed")
         logger.info(
             "breaker for %s: %s -> %s", replica_id, before, br.state
         )

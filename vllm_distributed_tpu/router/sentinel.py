@@ -1,0 +1,415 @@
+"""Router-side fleet sentinel (ISSUE 20): timeline merging, fleet SLO
+burn-rate alerting, and per-replica anomaly scoring.
+
+The router already touches every replica a few times a second (the
+pool's health/metrics probes, ISSUE 4/7); the sentinel rides those
+probes instead of adding traffic:
+
+* **Clock offsets** — each ``/health`` round trip doubles as an NTP-ish
+  sample (the replica's wall clock vs the midpoint of the router's
+  send/recv stamps), kept when its RTT beats the stored best (the same
+  accept/decay rule as tracing.py's heartbeat offsets).  ``/router/
+  timeline`` uses them to correct every replica's ``ts_wall`` onto the
+  router's clock before the merge.
+* **Anomaly scoring** — the probe's ``/metrics`` text is re-parsed for
+  the sentinel signals (ITL p99, roofline fraction, compile rate,
+  pipeline breaks, KV host-tier hit rate, retry rate) and each signal
+  is scored as a robust z (median/MAD over the live pool): immune to a
+  single sick replica dragging the baseline, unlike mean/stddev.
+  Scores export as ``vdt_router:replica_anomaly_score{replica_id,
+  signal}``; a replica whose worst |z| crosses the threshold raises a
+  ``replica_degraded`` alert and (with ``VDT_SENTINEL_PLACEMENT=1``) is
+  deprioritized — never ejected — by placement.
+* **Fleet burn rate** — the per-class ``vllm:slo_requests_total`` /
+  ``vllm:goodput_requests_total`` counters from the same scrape are
+  summed across replicas and fed to a shared
+  :class:`~vllm_distributed_tpu.engine.sentinel.BurnRateTracker`;
+  multi-window breaches raise ``slo_burn`` alerts.
+
+``merge_timelines`` is a pure function of (per-log event lists, clock
+offsets): sorted by corrected timestamp with a total-order tiebreak, so
+the merge is order-independent and bit-equal to recomputing from any
+partition of the union — the same determinism contract as the ISSUE 12
+SLO merge, pinned by tests.
+"""
+
+from __future__ import annotations
+
+import re
+import statistics
+import time
+from collections import deque
+from typing import Callable
+
+from vllm_distributed_tpu.engine.sentinel import (
+    BurnRateTracker,
+    SentinelLog,
+)
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+#: Per-replica condition signals scored by the sentinel.  Rates are
+#: per-second deltas between consecutive probes of the same replica.
+SIGNALS = (
+    "itl_p99_ms",          # vllm:itl_p99_ms (engine-merged p99)
+    "roofline_frac",       # vllm:step_roofline_frac
+    "compile_rate",        # d(vllm:xla_compiles_total)/dt
+    "pipeline_break_rate", # d(vllm:pipeline_breaks_total)/dt
+    "kv_host_hit_rate",    # d(host-tier hits)/d(prefix-cache queries)
+    "retry_rate",          # d(granted retries targeting the replica)/dt
+)
+
+#: Minimum MAD-derived scale per signal: deviations smaller than this
+#: are noise, not anomalies, even when the pool is otherwise identical
+#: (MAD of a near-constant pool is ~0, which would make any jitter an
+#: infinite z).
+SIGNAL_EPS = {
+    "itl_p99_ms": 5.0,
+    "roofline_frac": 0.05,
+    "compile_rate": 0.1,
+    "pipeline_break_rate": 0.1,
+    "kv_host_hit_rate": 0.05,
+    "retry_rate": 0.1,
+}
+
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+# 1/0.6745: scales MAD to the stddev of a normal distribution, so the
+# anomaly threshold reads in familiar sigma units.
+_MAD_TO_SIGMA = 1.4826
+
+
+def robust_zscores(
+    values: dict[str, float], eps: float
+) -> dict[str, float]:
+    """Median/MAD z-score per key; all-zero when fewer than 3 samples
+    (an outlier is undefined without a pool to stand out from)."""
+    if len(values) < 3:
+        return {k: 0.0 for k in values}
+    med = statistics.median(values.values())
+    mad = statistics.median(abs(v - med) for v in values.values())
+    scale = max(_MAD_TO_SIGMA * mad, eps)
+    return {k: (v - med) / scale for k, v in values.items()}
+
+
+def parse_sentinel_samples(text: str) -> dict:
+    """Pull the sentinel's signal inputs out of one replica's
+    Prometheus exposition (single pass, labels parsed only for the few
+    families that need them)."""
+    out: dict = {
+        "compiles": 0.0,
+        "pipeline_breaks": 0.0,
+        "prefix_queries": 0.0,
+        "host_hits": 0.0,
+        "slo": {},  # cls -> [requests, goodput]
+    }
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, rest = line.partition(" ")
+        if not rest:
+            continue
+        family, _, labelpart = name.partition("{")
+        try:
+            value = float(rest.split()[0])
+        except ValueError:
+            continue
+        if family == "vllm:itl_p99_ms":
+            out["itl_p99_ms"] = value
+        elif family == "vllm:step_roofline_frac":
+            out["roofline_frac"] = value
+        elif family == "vllm:xla_compiles_total":
+            out["compiles"] += value
+        elif family == "vllm:pipeline_breaks_total":
+            out["pipeline_breaks"] += value
+        elif family == "vllm:prefix_cache_queries_total":
+            out["prefix_queries"] += value
+        elif family == "vllm:prefix_cache_hits_total":
+            labels = dict(_LABEL_RE.findall(labelpart))
+            if labels.get("tier") == "host":
+                out["host_hits"] += value
+        elif family in (
+            "vllm:slo_requests_total",
+            "vllm:goodput_requests_total",
+        ):
+            labels = dict(_LABEL_RE.findall(labelpart))
+            cls = labels.get("slo_class")
+            if not cls:
+                continue
+            slot = out["slo"].setdefault(cls, [0, 0])
+            slot[0 if family == "vllm:slo_requests_total" else 1] += value
+    return out
+
+
+def merge_timelines(
+    parts: dict[str, list[dict]],
+    offsets: dict[str, float] | None = None,
+) -> list[dict]:
+    """Merge per-log event lists into one fleet timeline.
+
+    ``parts`` maps the log OWNER (replica id, or "router") to its
+    ``/debug/events`` list; ``offsets`` maps owner -> (owner_wall -
+    router_wall) so each event's ``ts_wall`` is corrected onto the
+    router's clock: ``ts = ts_wall - offset``.  Events sort by
+    ``(ts, origin, source, seq)`` — ``(origin, source, seq)`` is unique
+    per event, making the order total: merging any shuffling or
+    partition of the union yields a bit-identical result.
+    """
+    offsets = offsets or {}
+    merged: list[dict] = []
+    for owner, events in parts.items():
+        offset = offsets.get(owner, 0.0)
+        for ev in events:
+            out = dict(ev)
+            out["origin"] = owner
+            out["ts"] = round(float(ev.get("ts_wall", 0.0)) - offset, 6)
+            merged.append(out)
+    merged.sort(
+        key=lambda e: (
+            e["ts"],
+            e["origin"],
+            e.get("source", ""),
+            e.get("seq", 0),
+        )
+    )
+    return merged
+
+
+class RouterSentinel:
+    """The router's sentinel state: its own event log, the bounded
+    alerts feed, the fleet burn tracker, and per-replica anomaly
+    scores.  All mutation happens on the router's event loop (probe
+    callbacks and request handlers share it)."""
+
+    def __init__(
+        self,
+        metrics=None,
+        resilience=None,
+        anomaly_threshold: float | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        wall: Callable[[], float] = time.time,
+    ) -> None:
+        from vllm_distributed_tpu import envs
+
+        if anomaly_threshold is None:
+            anomaly_threshold = envs.VDT_SENTINEL_ANOMALY_THRESHOLD
+        self.log = SentinelLog("router", clock=clock, wall=wall)
+        self.alerts: deque[dict] = deque(maxlen=256)
+        self.burn = BurnRateTracker(clock=clock)
+        self.metrics = metrics
+        self.resilience = resilience
+        self.manager = None  # ReplicaManager, attached with the fleet
+        self.anomaly_threshold = anomaly_threshold
+        self._clock = clock
+        self._wall = wall
+        # rid -> signal -> latest value / score.
+        self.signals: dict[str, dict[str, float]] = {}
+        self.scores: dict[str, dict[str, float]] = {}
+        # rid -> previous cumulative counters (for rate deltas).
+        self._prev: dict[str, dict] = {}
+        # rid -> cls -> (requests, goodput): per-replica last-seen SLO
+        # counters, summed into the fleet burn tracker.
+        self._slo_counts: dict[str, dict[str, tuple[float, float]]] = {}
+        # rids currently in the degraded-alert state (edge-triggered).
+        self._degraded: set[str] = set()
+
+    # ---- emission ----
+    def emit(self, kind: str, replica_id: str = "", **attrs) -> None:
+        self.log.emit(kind, replica_id=replica_id, **attrs)
+
+    def alert(self, kind: str, replica_id: str = "", **attrs) -> None:
+        """Append to the bounded alerts feed, mirror into the timeline
+        (as ``alert_<kind>``), and count."""
+        entry = {
+            "ts_wall": round(self._wall(), 3),
+            "kind": kind,
+            "replica_id": replica_id or None,
+            **attrs,
+        }
+        self.alerts.append(entry)
+        self.log.emit(f"alert_{kind}", replica_id=replica_id, **attrs)
+        if self.metrics is not None:
+            self.metrics.record_alert(kind)
+        logger.warning("sentinel alert: %s", entry)
+
+    def alerts_snapshot(self) -> list[dict]:
+        return list(self.alerts)
+
+    # ---- probe feedback (pool hooks) ----
+    def note_replica_state(self, replica_id: str, old: str, new: str) -> None:
+        self.emit(
+            "replica_state", replica_id=replica_id, old=old, new=new
+        )
+        if new == "unreachable" and old in (
+            "healthy", "verifying", "unknown"
+        ):
+            self.alert(
+                "replica_unreachable", replica_id=replica_id, was=old
+            )
+
+    def note_breaker(self, replica_id: str, state: str) -> None:
+        self.emit("breaker_transition", replica_id=replica_id, state=state)
+        if state == "open":
+            self.alert(
+                "replica_degraded",
+                replica_id=replica_id,
+                reason="breaker_open",
+            )
+
+    def note_probe(
+        self, replica_id: str, metrics_text: str, now: float | None = None
+    ) -> None:
+        """Digest one replica's /metrics scrape: refresh its signal
+        values, the fleet burn tracker, and the pool-wide anomaly
+        scores."""
+        if now is None:
+            now = self._clock()
+        samples = parse_sentinel_samples(metrics_text)
+        sig = self.signals.setdefault(replica_id, {})
+        if "itl_p99_ms" in samples:
+            sig["itl_p99_ms"] = samples["itl_p99_ms"]
+        if "roofline_frac" in samples:
+            sig["roofline_frac"] = samples["roofline_frac"]
+        retries = 0.0
+        if self.resilience is not None:
+            retries = float(
+                self.resilience.replica_retries.get(replica_id, 0)
+            )
+        prev = self._prev.get(replica_id)
+        if prev is not None and now > prev["t"]:
+            dt = now - prev["t"]
+            sig["compile_rate"] = max(
+                samples["compiles"] - prev["compiles"], 0.0
+            ) / dt
+            sig["pipeline_break_rate"] = max(
+                samples["pipeline_breaks"] - prev["pipeline_breaks"], 0.0
+            ) / dt
+            d_queries = samples["prefix_queries"] - prev["prefix_queries"]
+            if d_queries > 0:
+                sig["kv_host_hit_rate"] = (
+                    max(samples["host_hits"] - prev["host_hits"], 0.0)
+                    / d_queries
+                )
+            sig["retry_rate"] = max(retries - prev["retries"], 0.0) / dt
+        self._prev[replica_id] = {
+            "t": now,
+            "compiles": samples["compiles"],
+            "pipeline_breaks": samples["pipeline_breaks"],
+            "prefix_queries": samples["prefix_queries"],
+            "host_hits": samples["host_hits"],
+            "retries": retries,
+        }
+        if samples["slo"]:
+            self._slo_counts[replica_id] = {
+                cls: (req, good)
+                for cls, (req, good) in samples["slo"].items()
+            }
+            self._observe_fleet_burn(now)
+        self._rescore()
+
+    def _observe_fleet_burn(self, now: float) -> None:
+        """Sum the per-replica cumulative SLO counters into fleet
+        totals and feed the multi-window burn tracker."""
+        fleet: dict[str, list[float]] = {}
+        for per_cls in self._slo_counts.values():
+            for cls, (req, good) in per_cls.items():
+                slot = fleet.setdefault(cls, [0.0, 0.0])
+                slot[0] += req
+                slot[1] += good
+        for cls, (req, good) in fleet.items():
+            for fired in self.burn.observe(cls, int(req), int(good), now):
+                self.alert("slo_burn", **fired)
+        if self.metrics is not None:
+            self.metrics.update_burn(self.burn, now)
+
+    def _rescore(self) -> None:
+        """Recompute robust z-scores for every signal over the pool and
+        re-evaluate the degraded set (edge-triggered alerts)."""
+        scores: dict[str, dict[str, float]] = {
+            rid: {} for rid in self.signals
+        }
+        for signal in SIGNALS:
+            values = {
+                rid: sig[signal]
+                for rid, sig in self.signals.items()
+                if signal in sig
+            }
+            for rid, z in robust_zscores(
+                values, SIGNAL_EPS[signal]
+            ).items():
+                scores[rid][signal] = round(z, 3)
+        self.scores = scores
+        if self.metrics is not None:
+            for rid, per_sig in scores.items():
+                for signal, z in per_sig.items():
+                    self.metrics.set_anomaly_score(rid, signal, z)
+        for rid, per_sig in scores.items():
+            worst = max(
+                per_sig.items(),
+                key=lambda kv: abs(kv[1]),
+                default=(None, 0.0),
+            )
+            score = abs(worst[1])
+            if score >= self.anomaly_threshold:
+                if rid not in self._degraded:
+                    self._degraded.add(rid)
+                    self.alert(
+                        "replica_degraded",
+                        replica_id=rid,
+                        signal=worst[0],
+                        score=round(worst[1], 3),
+                        reason="anomaly",
+                    )
+                    self._recommend_recycle(rid, worst[0], worst[1])
+            elif score < self.anomaly_threshold * 0.8:
+                # Hysteresis: re-arm only once clearly back in band.
+                self._degraded.discard(rid)
+
+    def _recommend_recycle(
+        self, replica_id: str, signal: str, score: float
+    ) -> None:
+        """Advisory only: surface a recycle recommendation to the
+        ReplicaManager (it records, never actuates — ISSUE 20 keeps the
+        sentinel's hands off the replica lifecycle)."""
+        if self.manager is None:
+            return
+        try:
+            self.manager.note_recycle_recommendation(
+                replica_id, signal=signal, score=round(score, 3)
+            )
+        except Exception:  # noqa: BLE001 — a recommendation must never break the probe path
+            logger.exception("recycle recommendation failed")
+
+    # ---- placement + fleet queries ----
+    def outliers(self) -> set[str]:
+        """Replica ids currently scoring past the anomaly threshold —
+        what VDT_SENTINEL_PLACEMENT deprioritizes."""
+        out = set()
+        for rid, per_sig in self.scores.items():
+            if per_sig and max(abs(z) for z in per_sig.values()) >= (
+                self.anomaly_threshold
+            ):
+                out.add(rid)
+        return out
+
+    def forget_replica(self, replica_id: str) -> None:
+        self.signals.pop(replica_id, None)
+        self.scores.pop(replica_id, None)
+        self._prev.pop(replica_id, None)
+        self._slo_counts.pop(replica_id, None)
+        self._degraded.discard(replica_id)
+
+    def snapshot(self) -> dict:
+        """Debug view for /router/state."""
+        return {
+            "scores": {
+                rid: dict(per_sig)
+                for rid, per_sig in sorted(self.scores.items())
+            },
+            "degraded": sorted(self._degraded),
+            "burn": self.burn.snapshot(),
+            "burn_peak": round(self.burn.peak, 3),
+            "alerts": len(self.alerts),
+            "events": len(self.log),
+        }
